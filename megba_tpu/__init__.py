@@ -1,0 +1,45 @@
+"""megba_tpu — a TPU-native distributed Bundle Adjustment framework.
+
+A brand-new JAX/XLA implementation with the capabilities of MegBA
+(MegviiRobot/MegBA): large-scale BA via Levenberg-Marquardt with a
+distributed Schur-complement PCG solver, vectorised per-edge residual and
+forward-mode Jacobian evaluation (autodiff and analytical), explicit and
+implicit (matrix-free) Hessian modes, and edge-axis sharding over a TPU
+device mesh with `jax.lax.psum` collectives in place of the reference's
+NCCL allreduces.
+
+This is an idiomatic TPU-first design, not a port: the reference's
+JetVector operator layer (reference include/operator/jet_vector.h),
+CUDA memory pool (reference src/resource/memory_pool.cu) and
+CSR/cuSPARSE machinery (reference src/linear_system, src/solver)
+collapse into vmapped, jitted, mesh-sharded pure functions.
+"""
+
+from megba_tpu.common import (
+    AlgoKind,
+    AlgoOption,
+    ComputeKind,
+    Device,
+    JacobianMode,
+    LinearSystemKind,
+    ProblemOption,
+    SolverKind,
+    SolverOption,
+)
+from megba_tpu.core.types import BALData, BAState
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AlgoKind",
+    "AlgoOption",
+    "BALData",
+    "BAState",
+    "ComputeKind",
+    "Device",
+    "JacobianMode",
+    "LinearSystemKind",
+    "ProblemOption",
+    "SolverKind",
+    "SolverOption",
+]

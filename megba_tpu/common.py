@@ -1,0 +1,157 @@
+"""Common enums and option structs.
+
+TPU-native equivalents of the reference's `include/common.h`: the enum set
+(common.h:17-25) and the option structs with identical field names and
+defaults (`SolverOption` common.h:27-33, `AlgoOption` common.h:35-42,
+`ProblemOption` common.h:44-53), so that configurations written against the
+reference map 1:1.  Device here selects the JAX backend platform instead of
+CPU/CUDA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import numpy as np
+
+
+class Device(enum.Enum):
+    """Execution backend (reference common.h:17)."""
+
+    CPU = "cpu"
+    TPU = "tpu"
+
+
+class AlgoKind(enum.Enum):
+    """Nonlinear algorithm kind (reference common.h:19)."""
+
+    BASE_ALGO = 0
+    LM = 1
+
+
+class LinearSystemKind(enum.Enum):
+    """Linear system kind (reference common.h:21)."""
+
+    BASE_LINEAR_SYSTEM = 0
+    SCHUR = 1
+
+
+class ComputeKind(enum.Enum):
+    """Hessian materialisation strategy (reference common.h:23).
+
+    EXPLICIT precomputes the per-edge camera-point coupling blocks
+    W_e = Jc_e^T Jp_e once per linearisation; IMPLICIT recomputes the
+    Schur matvec from the stored Jacobians each PCG iteration
+    (matrix-free, lower memory — reference README.md:19).
+    """
+
+    EXPLICIT = 0
+    IMPLICIT = 1
+
+
+class SolverKind(enum.Enum):
+    """Linear solver kind (reference common.h:25)."""
+
+    BASE_SOLVER = 0
+    PCG = 1
+
+
+class JacobianMode(enum.Enum):
+    """How per-edge Jacobians are produced.
+
+    AUTODIFF = forward-mode `jax.jacfwd` under `jax.vmap` (the TPU-native
+    equivalent of the reference's JetVector operator layer).
+    ANALYTICAL = hand-derived closed-form Jacobian (the equivalent of
+    reference src/geo/analytical_derivatives.cu).
+    """
+
+    AUTODIFF = 0
+    ANALYTICAL = 1
+
+
+@dataclasses.dataclass
+class SolverOption:
+    """Inner (PCG) solver options — reference common.h:27-33 defaults."""
+
+    solver_kind: SolverKind = SolverKind.PCG
+    max_iter: int = 100
+    tol: float = 1e-1
+    refuse_ratio: float = 1.0
+
+
+@dataclasses.dataclass
+class AlgoOption:
+    """Outer (LM) loop options — reference common.h:35-42 defaults."""
+
+    algo_kind: AlgoKind = AlgoKind.LM
+    max_iter: int = 20
+    initial_region: float = 1e3  # "tau"; trust region radius
+    epsilon1: float = 1.0
+    epsilon2: float = 1e-10
+
+
+@dataclasses.dataclass
+class ProblemOption:
+    """Problem-level options — reference common.h:44-53.
+
+    `world_size` replaces the reference's `deviceUsed` GPU list: the number
+    of mesh devices the edge axis is sharded over.  `dtype` replaces the
+    float/double template parameter (SPECIALIZE_STRUCT, common.h:9-11);
+    note TPU float64 is emulated, so float64 runs are typically pinned to
+    the CPU backend for verification.
+    """
+
+    use_schur: bool = True
+    device: Device = Device.TPU
+    world_size: int = 1
+    N: int = -1  # grad width (cameraDim + pointDim); derived if -1
+    n_item: int = -1  # number of edges/observations; derived if -1
+    dtype: np.dtype = np.float64
+    algo_kind: AlgoKind = AlgoKind.LM
+    linear_system_kind: LinearSystemKind = LinearSystemKind.SCHUR
+    compute_kind: ComputeKind = ComputeKind.IMPLICIT
+    jacobian_mode: JacobianMode = JacobianMode.AUTODIFF
+    solver_option: SolverOption = dataclasses.field(default_factory=SolverOption)
+    algo_option: AlgoOption = dataclasses.field(default_factory=AlgoOption)
+    # bf16 inner PCG vectors with fp32 reductions (BASELINE.md config 5).
+    mixed_precision_pcg: bool = False
+
+    def __post_init__(self) -> None:
+        if self.world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {self.world_size}")
+        if not self.use_schur:
+            # Parity note: the reference also only implements the Schur path
+            # (every useSchur=false branch is a TODO, base_problem.cpp:112-123).
+            raise NotImplementedError("only the Schur path is implemented")
+
+
+@dataclasses.dataclass
+class AlgoStatus:
+    """Mutable LM status — reference common.h:55-60."""
+
+    region: float = 1e3
+    recover_diag: bool = False
+
+
+DTYPE_TO_JAX = {
+    np.dtype(np.float32): "float32",
+    np.dtype(np.float64): "float64",
+}
+
+
+def validate_options(option: ProblemOption) -> None:
+    """Cross-check algo/linear-system/solver kinds.
+
+    Mirrors the ctor-time validation in reference base_problem.cpp:66-73 and
+    base_linear_system.cpp:22-25.
+    """
+    if option.algo_kind != AlgoKind.LM:
+        raise ValueError("only AlgoKind.LM is supported")
+    if option.linear_system_kind != LinearSystemKind.SCHUR:
+        raise ValueError("only LinearSystemKind.SCHUR is supported")
+    if option.solver_option.solver_kind != SolverKind.PCG:
+        raise ValueError("only SolverKind.PCG is supported")
+    if np.dtype(option.dtype) not in DTYPE_TO_JAX:
+        raise ValueError(f"unsupported dtype {option.dtype}")

@@ -1,0 +1,3 @@
+from megba_tpu.core.types import BALData, BAState
+
+__all__ = ["BALData", "BAState"]

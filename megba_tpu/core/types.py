@@ -1,0 +1,113 @@
+"""Core pytree data model.
+
+The TPU-native replacement for the reference's object-graph problem
+representation (BaseVertex/BaseEdge/EdgeVector SoA,
+reference include/vertex/base_vertex.h:153-171 and
+include/edge/base_edge.h:69-163): a flat struct-of-arrays pytree.  Cameras
+and points are dense parameter arrays; edges are index pairs into them plus
+per-edge observations — `jnp.take` gathers replace the reference's
+positionContainer machinery (reference src/edge/base_edge.cpp:224-262) and
+`segment_sum` scatter-reduces replace its atomicAdd kernels
+(reference src/edge/build_linear_system.cu:88-146).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BALData:
+    """A vectorised BA problem instance (static topology + dynamic params).
+
+    Attributes:
+      cameras: [num_cameras, camera_dim] parameter blocks (BAL: 9 =
+        angle-axis(3) + translation(3) + f + k1 + k2).
+      points:  [num_points, point_dim] parameter blocks (BAL: 3).
+      obs:     [n_edge, obs_dim] per-edge measurements (BAL: 2).
+      cam_idx: [n_edge] int32 camera index of each edge.
+      pt_idx:  [n_edge] int32 point index of each edge.
+      mask:    [n_edge] weight, 1.0 for real edges, 0.0 for padding edges
+        (the TPU equivalent of the reference's remainder-shard handling,
+        memory_pool.h:48-63 — shards must be equal-size static shapes).
+      sqrt_info: optional [n_edge, obs_dim, obs_dim] square-root information
+        matrices (reference BaseEdge information matrix semantics,
+        build_linear_system.cu:148-239); None means identity.
+      cam_fixed: optional [num_cameras] bool, True = frozen (reference
+        BaseVertex::fixed, base_vertex.h:48-50).
+      pt_fixed: optional [num_points] bool.
+    """
+
+    cameras: jax.Array
+    points: jax.Array
+    obs: jax.Array
+    cam_idx: jax.Array
+    pt_idx: jax.Array
+    mask: jax.Array
+    sqrt_info: Optional[jax.Array] = None
+    cam_fixed: Optional[jax.Array] = None
+    pt_fixed: Optional[jax.Array] = None
+
+    @property
+    def num_cameras(self) -> int:
+        return self.cameras.shape[0]
+
+    @property
+    def num_points(self) -> int:
+        return self.points.shape[0]
+
+    @property
+    def n_edge(self) -> int:
+        return self.obs.shape[0]
+
+    @property
+    def camera_dim(self) -> int:
+        return self.cameras.shape[1]
+
+    @property
+    def point_dim(self) -> int:
+        return self.points.shape[1]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BAState:
+    """The parameter state carried through the LM loop.
+
+    The functional replacement for the reference's backup/rollback device
+    copies (base_edge.cu:17-44, schur_LM_linear_system.cu:187-209): LM
+    accept/reject simply selects which pytree to carry forward.
+    """
+
+    cameras: jax.Array
+    points: jax.Array
+
+
+def pad_edges(
+    obs: np.ndarray,
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    multiple: int,
+    dtype: Any = np.float64,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pad the edge axis to a multiple of `multiple` with masked-out edges.
+
+    Padding edges point at index 0 with weight 0 so gathers stay in bounds
+    and segment_sums contribute nothing.  This replaces the reference's
+    uneven remainder shard (MemoryPool::getItemNum, memory_pool.h:48-63)
+    with the static equal shapes XLA sharding requires.
+    """
+    n = obs.shape[0]
+    n_pad = (-n) % multiple
+    mask = np.ones(n + n_pad, dtype=dtype)
+    if n_pad:
+        mask[n:] = 0.0
+        obs = np.concatenate([obs, np.zeros((n_pad,) + obs.shape[1:], obs.dtype)])
+        cam_idx = np.concatenate([cam_idx, np.zeros(n_pad, cam_idx.dtype)])
+        pt_idx = np.concatenate([pt_idx, np.zeros(n_pad, pt_idx.dtype)])
+    return obs, cam_idx, pt_idx, mask

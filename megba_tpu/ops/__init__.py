@@ -1,0 +1,13 @@
+from megba_tpu.ops import geo
+from megba_tpu.ops.residuals import (
+    bal_residual,
+    make_residual_jacobian_fn,
+    make_residual_fn,
+)
+
+__all__ = [
+    "geo",
+    "bal_residual",
+    "make_residual_fn",
+    "make_residual_jacobian_fn",
+]

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Runs everything on the CPU backend with 8 virtual devices (the
+multi-device story the reference could never test — SURVEY.md §4) and
+float64 enabled for numerical verification.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+# The axon TPU plugin's register() overrides jax_platforms to "axon,cpu" at
+# interpreter startup (sitecustomize), stealing the default device and —
+# when the remote TPU tunnel is busy — hanging backend init.  Backends
+# initialize lazily, so forcing CPU here (before any device query) keeps
+# the whole test suite off the TPU: unit tests are deterministic float64.
+jax.config.update("jax_platforms", "cpu")
+
+_cpus = jax.devices("cpu")
+jax.config.update("jax_default_device", _cpus[0])
+
+
+def cpu_devices(n: int):
+    assert len(_cpus) >= n, f"need {n} cpu devices, have {len(_cpus)}"
+    return _cpus[:n]

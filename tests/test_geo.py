@@ -1,0 +1,101 @@
+"""Geo op unit tests: golden values + finite differences (SURVEY.md §4b)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.ops import geo
+
+
+def rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def test_angle_axis_rotate_matches_matrix():
+    r = rng(1)
+    for _ in range(10):
+        w = jnp.asarray(r.normal(size=3))
+        x = jnp.asarray(r.normal(size=3))
+        R = geo.angle_axis_to_rotation_matrix(w)
+        np.testing.assert_allclose(
+            geo.angle_axis_rotate_point(w, x), R @ x, rtol=1e-12, atol=1e-12
+        )
+
+
+def test_rotation_matrix_orthonormal():
+    r = rng(2)
+    w = jnp.asarray(r.normal(size=3))
+    R = geo.angle_axis_to_rotation_matrix(w)
+    np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-12)
+    np.testing.assert_allclose(np.linalg.det(R), 1.0, rtol=1e-12)
+
+
+def test_small_angle_branch():
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    for scale in [0.0, 1e-10, 1e-7]:
+        w = jnp.asarray([scale, -scale, scale * 0.5])
+        got = geo.angle_axis_rotate_point(w, x)
+        expect = x + jnp.cross(w, x)
+        np.testing.assert_allclose(got, expect, atol=1e-12)
+        # And no NaNs in the gradient at exactly zero.
+        J = jax.jacfwd(geo.angle_axis_rotate_point)(w, x)
+        assert np.all(np.isfinite(J))
+
+
+def test_rotate_known_90deg():
+    # 90 degrees about z: x-axis -> y-axis.
+    w = jnp.asarray([0.0, 0.0, np.pi / 2])
+    x = jnp.asarray([1.0, 0.0, 0.0])
+    np.testing.assert_allclose(
+        geo.angle_axis_rotate_point(w, x), [0.0, 1.0, 0.0], atol=1e-12
+    )
+
+
+def test_rotation2d():
+    th = jnp.asarray(0.3)
+    R = geo.rotation2d_to_matrix(th)
+    np.testing.assert_allclose(R @ R.T, np.eye(2), atol=1e-12)
+    np.testing.assert_allclose(R[0, 0], np.cos(0.3))
+
+
+def test_radial_distortion_zero_k():
+    p = jnp.asarray([0.3, -0.2])
+    out = geo.radial_distortion(p, jnp.asarray(500.0), jnp.asarray(0.0), jnp.asarray(0.0))
+    np.testing.assert_allclose(out, 500.0 * p)
+
+
+def test_quaternion_roundtrip():
+    r = rng(3)
+    for _ in range(20):
+        w = jnp.asarray(r.normal(size=3))
+        R = geo.angle_axis_to_rotation_matrix(w)
+        q = geo.rotation_matrix_to_quaternion(R)
+        R2 = geo.quaternion_to_rotation_matrix(q)
+        np.testing.assert_allclose(R2, R, atol=1e-9)
+
+
+def test_drotated_dangle_axis_vs_autodiff():
+    r = rng(4)
+    for scale in [1.0, 1e-3, 1e-9, 0.0]:
+        w = jnp.asarray(r.normal(size=3) * scale)
+        x = jnp.asarray(r.normal(size=3))
+        got = geo.drotated_dangle_axis(w, x)
+        expect = jax.jacfwd(geo.angle_axis_rotate_point)(w, x)
+        np.testing.assert_allclose(got, expect, rtol=1e-8, atol=1e-10)
+
+
+def test_drotated_finite_difference():
+    r = rng(5)
+    w = jnp.asarray(r.normal(size=3))
+    x = jnp.asarray(r.normal(size=3))
+    J = np.asarray(geo.drotated_dangle_axis(w, x))
+    eps = 1e-6
+    for i in range(3):
+        dw = np.zeros(3)
+        dw[i] = eps
+        fd = (
+            np.asarray(geo.angle_axis_rotate_point(w + dw, x))
+            - np.asarray(geo.angle_axis_rotate_point(w - dw, x))
+        ) / (2 * eps)
+        np.testing.assert_allclose(J[:, i], fd, rtol=1e-6, atol=1e-8)

@@ -1,0 +1,89 @@
+"""Residual/Jacobian engine tests: analytical vs autodiff vs finite diff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megba_tpu.common import JacobianMode
+from megba_tpu.ops.residuals import (
+    apply_sqrt_info,
+    bal_residual,
+    bal_residual_jacobian_analytical,
+    make_residual_jacobian_fn,
+)
+
+
+def random_edge(r):
+    # A sane BAL-like camera: point in front of camera after transform.
+    w = r.normal(size=3) * 0.1
+    t = r.normal(size=3) * 0.5 + np.array([0, 0, 5.0])
+    cam = np.concatenate([w, t, [500.0 + r.normal() * 10, 1e-7, 1e-13]])
+    pt = r.normal(size=3) + np.array([0, 0, -10.0])
+    obs = r.normal(size=2) * 100
+    return jnp.asarray(cam), jnp.asarray(pt), jnp.asarray(obs)
+
+
+def test_analytical_matches_autodiff():
+    r = np.random.default_rng(0)
+    for _ in range(20):
+        cam, pt, obs = random_edge(r)
+        res_a, Jc_a, Jp_a = bal_residual_jacobian_analytical(cam, pt, obs)
+        res = bal_residual(cam, pt, obs)
+        Jc, Jp = jax.jacfwd(bal_residual, argnums=(0, 1))(cam, pt, obs)
+        np.testing.assert_allclose(res_a, res, rtol=1e-12)
+        np.testing.assert_allclose(Jc_a, Jc, rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(Jp_a, Jp, rtol=1e-9, atol=1e-9)
+
+
+def test_jacobian_finite_difference():
+    r = np.random.default_rng(1)
+    cam, pt, obs = random_edge(r)
+    _, Jc, Jp = bal_residual_jacobian_analytical(cam, pt, obs)
+    eps = 1e-6
+    for i in range(9):
+        d = np.zeros(9)
+        d[i] = eps
+        fd = (
+            np.asarray(bal_residual(cam + d, pt, obs))
+            - np.asarray(bal_residual(cam - d, pt, obs))
+        ) / (2 * eps)
+        np.testing.assert_allclose(Jc[:, i], fd, rtol=1e-4, atol=1e-4)
+    for i in range(3):
+        d = np.zeros(3)
+        d[i] = eps
+        fd = (
+            np.asarray(bal_residual(cam, pt + d, obs))
+            - np.asarray(bal_residual(cam, pt - d, obs))
+        ) / (2 * eps)
+        np.testing.assert_allclose(Jp[:, i], fd, rtol=1e-4, atol=1e-4)
+
+
+def test_vectorised_modes_agree():
+    r = np.random.default_rng(2)
+    edges = [random_edge(r) for _ in range(16)]
+    cams = jnp.stack([e[0] for e in edges])
+    pts = jnp.stack([e[1] for e in edges])
+    obs = jnp.stack([e[2] for e in edges])
+    fa = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    fb = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    ra, Jca, Jpa = jax.jit(fa)(cams, pts, obs)
+    rb, Jcb, Jpb = jax.jit(fb)(cams, pts, obs)
+    assert ra.shape == (16, 2) and Jca.shape == (16, 2, 9) and Jpa.shape == (16, 2, 3)
+    np.testing.assert_allclose(ra, rb, rtol=1e-12)
+    np.testing.assert_allclose(Jca, Jcb, rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(Jpa, Jpb, rtol=1e-9, atol=1e-9)
+
+
+def test_sqrt_info_weighting():
+    r = np.random.default_rng(3)
+    cam, pt, obs = random_edge(r)
+    res, Jc, Jp = bal_residual_jacobian_analytical(cam, pt, obs)
+    res, Jc, Jp = res[None], Jc[None], Jp[None]
+    L = jnp.asarray(np.array([[[2.0, 0.0], [1.0, 3.0]]]))
+    rw, Jcw, Jpw = apply_sqrt_info(res, Jc, Jp, L)
+    np.testing.assert_allclose(rw[0], L[0] @ res[0])
+    np.testing.assert_allclose(Jcw[0], L[0] @ Jc[0])
+    np.testing.assert_allclose(Jpw[0], L[0] @ Jp[0])
+    # Identity passthrough.
+    r2, _, _ = apply_sqrt_info(res, Jc, Jp, None)
+    assert r2 is res
