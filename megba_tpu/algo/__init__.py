@@ -1,0 +1,3 @@
+from megba_tpu.algo.lm import LMResult, lm_solve
+
+__all__ = ["LMResult", "lm_solve"]

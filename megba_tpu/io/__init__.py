@@ -1,0 +1,3 @@
+from megba_tpu.io.synthetic import make_synthetic_bal
+
+__all__ = ["make_synthetic_bal"]

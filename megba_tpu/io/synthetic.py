@@ -1,0 +1,114 @@
+"""Synthetic BAL-like problem generator.
+
+Stands in for the public BAL datasets (which the reference's examples
+load from text files, examples/BAL_Double.cpp:74-139) in tests and
+benchmarks — this sandbox has no network egress, so problems of any size
+are generated procedurally with known ground truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticBAL:
+    """Ground-truth + perturbed initial parameters for a synthetic scene."""
+
+    cameras_gt: np.ndarray  # [Nc, 9]
+    points_gt: np.ndarray  # [Np, 3]
+    cameras0: np.ndarray  # perturbed initial cameras
+    points0: np.ndarray  # perturbed initial points
+    obs: np.ndarray  # [nE, 2]
+    cam_idx: np.ndarray  # [nE] int32
+    pt_idx: np.ndarray  # [nE] int32
+
+
+def _project(camera: np.ndarray, point: np.ndarray) -> np.ndarray:
+    """NumPy twin of ops.residuals.bal_residual's projection (one edge)."""
+    w, t = camera[0:3], camera[3:6]
+    f, k1, k2 = camera[6], camera[7], camera[8]
+    theta = np.linalg.norm(w)
+    if theta > 1e-12:
+        k = w / theta
+        RX = (
+            point * np.cos(theta)
+            + np.cross(k, point) * np.sin(theta)
+            + k * np.dot(k, point) * (1 - np.cos(theta))
+        )
+    else:
+        RX = point + np.cross(w, point)
+    P = RX + t
+    p = -P[0:2] / P[2]
+    n = p @ p
+    return f * (1 + k1 * n + k2 * n * n) * p
+
+
+def make_synthetic_bal(
+    num_cameras: int = 4,
+    num_points: int = 24,
+    obs_per_point: int = 3,
+    pixel_noise: float = 0.5,
+    param_noise: float = 1e-2,
+    seed: int = 0,
+    dtype: np.dtype = np.float64,
+) -> SyntheticBAL:
+    """Build a well-posed synthetic scene.
+
+    Points live in a unit ball at the origin; cameras sit ~5 units up the
+    +z axis with small random rotations, looking down (BAL convention:
+    scene depth is negative in the camera frame, matching the -P/P.z
+    projection).  Each point is observed by `obs_per_point` distinct
+    cameras; every camera gets at least one observation.
+    """
+    r = np.random.default_rng(seed)
+    obs_per_point = min(obs_per_point, num_cameras)
+
+    points_gt = r.uniform(-1.0, 1.0, size=(num_points, 3))
+    cameras_gt = np.zeros((num_cameras, 9))
+    cameras_gt[:, 0:3] = r.normal(scale=0.05, size=(num_cameras, 3))  # small tilt
+    cameras_gt[:, 3:5] = r.normal(scale=0.2, size=(num_cameras, 2))  # x/y offset
+    cameras_gt[:, 5] = -5.0 + r.normal(scale=0.2, size=num_cameras)  # z: scene in front
+    cameras_gt[:, 6] = 500.0 + r.normal(scale=5.0, size=num_cameras)  # focal
+    cameras_gt[:, 7] = r.normal(scale=1e-4, size=num_cameras)  # k1
+    cameras_gt[:, 8] = r.normal(scale=1e-6, size=num_cameras)  # k2
+
+    cam_idx, pt_idx, obs = [], [], []
+    for j in range(num_points):
+        cams = r.choice(num_cameras, size=obs_per_point, replace=False)
+        for c in cams:
+            cam_idx.append(c)
+            pt_idx.append(j)
+            uv = _project(cameras_gt[c], points_gt[j])
+            obs.append(uv + r.normal(scale=pixel_noise, size=2))
+    # Guarantee every camera appears (choice may miss one on tiny scenes).
+    seen = set(cam_idx)
+    for c in range(num_cameras):
+        if c not in seen:
+            j = int(r.integers(num_points))
+            cam_idx.append(c)
+            pt_idx.append(j)
+            obs.append(_project(cameras_gt[c], points_gt[j]) + r.normal(scale=pixel_noise, size=2))
+
+    order = np.argsort(np.asarray(cam_idx), kind="stable")  # BAL files are cam-sorted
+    cam_idx = np.asarray(cam_idx, dtype=np.int32)[order]
+    pt_idx = np.asarray(pt_idx, dtype=np.int32)[order]
+    obs = np.asarray(obs, dtype=dtype)[order]
+
+    cameras0 = cameras_gt + r.normal(scale=param_noise, size=cameras_gt.shape) * np.array(
+        [1, 1, 1, 1, 1, 1, 100.0, 1e-3, 1e-5]
+    )
+    points0 = points_gt + r.normal(scale=param_noise, size=points_gt.shape)
+
+    return SyntheticBAL(
+        cameras_gt=cameras_gt.astype(dtype),
+        points_gt=points_gt.astype(dtype),
+        cameras0=cameras0.astype(dtype),
+        points0=points0.astype(dtype),
+        obs=obs,
+        cam_idx=cam_idx,
+        pt_idx=pt_idx,
+    )

@@ -1,0 +1,15 @@
+from megba_tpu.linear_system.builder import (
+    SchurSystem,
+    build_schur_system,
+    damp_blocks,
+    undamped_diag,
+    weight_system_inputs,
+)
+
+__all__ = [
+    "SchurSystem",
+    "build_schur_system",
+    "damp_blocks",
+    "undamped_diag",
+    "weight_system_inputs",
+]

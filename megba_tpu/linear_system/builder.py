@@ -1,0 +1,184 @@
+"""Block-structured Schur linear system assembly.
+
+TPU-native replacement for the reference's Hessian assembly + CSR
+machinery: the `makeHSchur` atomicAdd kernels
+(reference src/edge/build_linear_system.cu:88-146), the CSR skeleton
+builders (reference src/linear_system/schur_LM_linear_system.cpp:20-84)
+and the positionContainer relativePosition indexing
+(reference src/edge/base_edge.cpp:224-262) all collapse into
+`jax.ops.segment_sum` over gather indices on block-dense arrays:
+
+  Hpp [num_cameras, cd, cd]   block-diagonal camera Hessian
+  Hll [num_points,  pd, pd]   block-diagonal point Hessian
+  g   ([num_cameras, cd], [num_points, pd])   gradient -J^T r
+
+The camera-point coupling Hpl is either materialised as per-edge blocks
+W_e = Jc_e^T Jp_e (EXPLICIT — the analog of the reference's Hpl/Hlp CSR,
+schur_linear_system.h:22-29) or recomputed from the stored Jacobians at
+every matvec (IMPLICIT — the analog of
+reference src/solver/implicit_schur_pcg_solver.cu:20-90).  In both modes
+Hpl stays shard-local when the edge axis is sharded: only the
+block-diagonals and the gradient are psum-reduced, mirroring the
+reference's allreduce set (build_linear_system.cu:403-422, where Hpl/Hlp
+are deliberately NOT reduced).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.common import ComputeKind
+from megba_tpu.ops.residuals import apply_sqrt_info
+
+# Hessian contractions (J^T J outer products, batched small matmuls) always
+# run at full float32: on TPU the default bf16 matmul precision would
+# corrupt the normal equations.  bf16 is an explicit opt-in for the PCG
+# matvecs only (ProblemOption.mixed_precision_pcg).
+HI = jax.lax.Precision.HIGHEST
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SchurSystem:
+    """The assembled (undamped) normal equations in Schur block form.
+
+    Equivalent of the reference's SchurLMLinearSystem containers
+    (include/linear_system/schur_linear_system.h:22-29): csrVal[2]=Hpp,
+    csrVal[3]=Hll, g — plus the per-edge W blocks in EXPLICIT mode
+    (csrVal[0]/csrVal[1]=Hpl/Hlp there).  Undamped; LM damping is applied
+    functionally by `damp_blocks` (the reference's in-place
+    processDiag/recoverDiag save-restore dance,
+    schur_LM_linear_system.cu:112-185, is unnecessary in functional form).
+    """
+
+    Hpp: jax.Array  # [Nc, cd, cd], psum-reduced (replicated across shards)
+    Hll: jax.Array  # [Np, pd, pd], psum-reduced
+    g_cam: jax.Array  # [Nc, cd], psum-reduced
+    g_pt: jax.Array  # [Np, pd], psum-reduced
+    W: Optional[jax.Array] = None  # [nE_local, cd, pd], shard-local (EXPLICIT)
+
+
+def weight_system_inputs(
+    r: jax.Array,
+    Jc: jax.Array,
+    Jp: jax.Array,
+    cam_idx: jax.Array,
+    pt_idx: jax.Array,
+    mask: jax.Array,
+    sqrt_info: Optional[jax.Array] = None,
+    cam_fixed: Optional[jax.Array] = None,
+    pt_fixed: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Apply sqrt-information, padding mask and fixed-vertex masks ONCE.
+
+    The returned (r, Jc, Jp) are what both `build_schur_system` and the
+    PCG matvecs consume, so masking can never be double-applied.  Covers
+    the reference's JMulInfo pre-weighting
+    (build_linear_system.cu:148-239) and its gradShape=0 exclusion of
+    fixed vertices (base_vertex.h:48-50).  mask is 0/1 so H = J^T J picks
+    up mask^2 = mask and g = -J^T r picks up mask^2 as well — padding
+    edges contribute exactly nothing.
+    """
+    r, Jc, Jp = apply_sqrt_info(r, Jc, Jp, sqrt_info)
+    r = r * mask[:, None]
+    Jc = Jc * mask[:, None, None]
+    Jp = Jp * mask[:, None, None]
+    if cam_fixed is not None:
+        Jc = jnp.where(cam_fixed[cam_idx][:, None, None], 0.0, Jc)
+    if pt_fixed is not None:
+        Jp = jnp.where(pt_fixed[pt_idx][:, None, None], 0.0, Jp)
+    return r, Jc, Jp
+
+
+def build_schur_system(
+    r: jax.Array,
+    Jc: jax.Array,
+    Jp: jax.Array,
+    cam_idx: jax.Array,
+    pt_idx: jax.Array,
+    num_cameras: int,
+    num_points: int,
+    compute_kind: ComputeKind = ComputeKind.IMPLICIT,
+    axis_name: Optional[str] = None,
+    cam_fixed: Optional[jax.Array] = None,
+    pt_fixed: Optional[jax.Array] = None,
+) -> SchurSystem:
+    """Assemble the Schur-form normal equations from per-edge Jacobians.
+
+    Args:
+      r: [nE, od] residuals, Jc: [nE, od, cd], Jp: [nE, od, pd] — all
+        already weighted by `weight_system_inputs`.
+      cam_idx / pt_idx: [nE] int32 gather indices.
+      axis_name: mesh axis to psum over when the edge axis is sharded
+        (the reference's ncclAllReduce of Hpp/Hll/g,
+        build_linear_system.cu:403-422); None on a single device.
+      cam_fixed / pt_fixed: optional bool masks; fixed vertices get an
+        identity Hessian block and zero gradient so their update is
+        exactly zero.
+    """
+    # Per-edge outer products, then scatter-reduce by vertex — the
+    # race-free functional form of the reference's atomicAdd makeHpp /
+    # makeHll (build_linear_system.cu:116-134).
+    hpp_e = jnp.einsum("eoi,eoj->eij", Jc, Jc, precision=HI)
+    hll_e = jnp.einsum("eoi,eoj->eij", Jp, Jp, precision=HI)
+    g_cam_e = -jnp.einsum("eoi,eo->ei", Jc, r, precision=HI)
+    g_pt_e = -jnp.einsum("eoi,eo->ei", Jp, r, precision=HI)
+
+    Hpp = jax.ops.segment_sum(hpp_e, cam_idx, num_segments=num_cameras)
+    Hll = jax.ops.segment_sum(hll_e, pt_idx, num_segments=num_points)
+    g_cam = jax.ops.segment_sum(g_cam_e, cam_idx, num_segments=num_cameras)
+    g_pt = jax.ops.segment_sum(g_pt_e, pt_idx, num_segments=num_points)
+
+    if axis_name is not None:
+        Hpp, Hll, g_cam, g_pt = jax.lax.psum((Hpp, Hll, g_cam, g_pt), axis_name)
+
+    # Fixed vertices: identity block + zero gradient pins delta to zero.
+    eye_c = jnp.eye(Hpp.shape[-1], dtype=Hpp.dtype)
+    eye_p = jnp.eye(Hll.shape[-1], dtype=Hll.dtype)
+    if cam_fixed is not None:
+        Hpp = jnp.where(cam_fixed[:, None, None], eye_c, Hpp)
+        g_cam = jnp.where(cam_fixed[:, None], 0.0, g_cam)
+    if pt_fixed is not None:
+        Hll = jnp.where(pt_fixed[:, None, None], eye_p, Hll)
+        g_pt = jnp.where(pt_fixed[:, None], 0.0, g_pt)
+
+    # Edge-less vertices (possible in filtered real datasets) would leave a
+    # zero block that stays singular through multiplicative damping and
+    # NaN-poisons the Cholesky in block_inv.  J^T J is PSD, so a zero
+    # trace identifies exactly the empty blocks; give them an identity
+    # (their gradient is already zero, so their update is exactly zero).
+    empty_c = jnp.trace(Hpp, axis1=-2, axis2=-1) == 0.0
+    empty_p = jnp.trace(Hll, axis1=-2, axis2=-1) == 0.0
+    Hpp = jnp.where(empty_c[:, None, None], eye_c, Hpp)
+    Hll = jnp.where(empty_p[:, None, None], eye_p, Hll)
+
+    W = None
+    if compute_kind == ComputeKind.EXPLICIT:
+        # Shard-local coupling blocks (NOT reduced — the distributed
+        # matvec psums the product instead, mirroring the reference's
+        # beta=1/worldSize trick + product allreduce,
+        # schur_pcg_solver.cu:478-509).
+        W = jnp.einsum("eoi,eoj->eij", Jc, Jp, precision=HI)
+    return SchurSystem(Hpp=Hpp, Hll=Hll, g_cam=g_cam, g_pt=g_pt, W=W)
+
+
+def damp_blocks(H: jax.Array, region: jax.Array) -> jax.Array:
+    """LM damping: scale block-diagonal entries by (1 + 1/region).
+
+    The multiplicative damping of the reference's
+    extractOldAndApplyNewDiag kernel (schur_LM_linear_system.cu:112-160);
+    being functional, there is nothing to save or recover on reject.
+    """
+    d = H.shape[-1]
+    eye = jnp.eye(d, dtype=H.dtype)
+    factor = 1.0 + eye / region
+    return H * factor
+
+
+def undamped_diag(H: jax.Array) -> jax.Array:
+    """Extract block diagonals [*, d] from block array [*, d, d]."""
+    return jnp.diagonal(H, axis1=-2, axis2=-1)

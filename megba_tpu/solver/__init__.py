@@ -1,0 +1,10 @@
+from megba_tpu.solver.pcg import PCGResult, block_inv, block_matvec, schur_pcg_solve
+from megba_tpu.solver.dense import dense_reference_solve
+
+__all__ = [
+    "PCGResult",
+    "block_inv",
+    "block_matvec",
+    "dense_reference_solve",
+    "schur_pcg_solve",
+]

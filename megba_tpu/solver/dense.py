@@ -1,0 +1,55 @@
+"""Dense reference solver for validation.
+
+Builds the full damped normal-equations matrix from the Schur blocks and
+solves it directly — the ground truth the PCG solver is unit-tested
+against (SURVEY.md §4c: "Schur/PCG unit tests vs dense np.linalg.solve on
+tiny synthetic BA problems").  Test-scale only: O((Nc*cd + Np*pd)^2)
+memory.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
+
+
+def dense_reference_solve(
+    system: SchurSystem,
+    Jc: jax.Array,
+    Jp: jax.Array,
+    cam_idx: jax.Array,
+    pt_idx: jax.Array,
+    region: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Direct solve of the damped system H dx = g; returns (dx_cam, dx_pt)."""
+    Nc, cd, _ = system.Hpp.shape
+    Np, pd, _ = system.Hll.shape
+    n = Nc * cd + Np * pd
+
+    Hpp_d = damp_blocks(system.Hpp, region)
+    Hll_d = damp_blocks(system.Hll, region)
+
+    H = jnp.zeros((n, n), dtype=system.Hpp.dtype)
+    # Diagonal blocks.
+    for i in range(Nc):
+        H = H.at[i * cd : (i + 1) * cd, i * cd : (i + 1) * cd].set(Hpp_d[i])
+    off = Nc * cd
+    for j in range(Np):
+        H = H.at[off + j * pd : off + (j + 1) * pd, off + j * pd : off + (j + 1) * pd].set(Hll_d[j])
+    # Coupling: W_e = Jc_e^T Jp_e accumulated at (camera row, point col).
+    W = jnp.einsum("eoc,eop->ecp", Jc, Jp)
+    for e in range(Jc.shape[0]):
+        ci = int(cam_idx[e])
+        pi = int(pt_idx[e])
+        rows = slice(ci * cd, (ci + 1) * cd)
+        cols = slice(off + pi * pd, off + (pi + 1) * pd)
+        H = H.at[rows, cols].add(W[e])
+        H = H.at[cols, rows].add(W[e].T)
+
+    g = jnp.concatenate([system.g_cam.reshape(-1), system.g_pt.reshape(-1)])
+    dx = jnp.linalg.solve(H, g)
+    return dx[: Nc * cd].reshape(Nc, cd), dx[Nc * cd :].reshape(Np, pd)

@@ -1,0 +1,131 @@
+"""Linear system + Schur-PCG tests vs dense direct solve (SURVEY.md §4c)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.common import ComputeKind, JacobianMode
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.linear_system import build_schur_system, damp_blocks, weight_system_inputs
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.solver import dense_reference_solve, schur_pcg_solve
+
+
+def build_test_system(seed=0, num_cameras=3, num_points=12, compute_kind=ComputeKind.IMPLICIT,
+                      cam_fixed=None, pt_fixed=None):
+    s = make_synthetic_bal(num_cameras=num_cameras, num_points=num_points, seed=seed)
+    cams = jnp.asarray(s.cameras0)
+    pts = jnp.asarray(s.points0)
+    cam_idx = jnp.asarray(s.cam_idx)
+    pt_idx = jnp.asarray(s.pt_idx)
+    obs = jnp.asarray(s.obs)
+    mask = jnp.ones(obs.shape[0])
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+    r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx, mask,
+                                     cam_fixed=cam_fixed, pt_fixed=pt_fixed)
+    system = build_schur_system(
+        r, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
+        compute_kind=compute_kind, cam_fixed=cam_fixed, pt_fixed=pt_fixed)
+    return system, r, Jc, Jp, cam_idx, pt_idx
+
+
+def test_hessian_blocks_match_dense_assembly():
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system()
+    # Assemble J^T J brute-force per camera from the edge list.
+    nE = r.shape[0]
+    for c in range(3):
+        H = np.zeros((9, 9))
+        g = np.zeros(9)
+        for e in range(nE):
+            if int(cam_idx[e]) == c:
+                H += np.asarray(Jc[e]).T @ np.asarray(Jc[e])
+                g -= np.asarray(Jc[e]).T @ np.asarray(r[e])
+        np.testing.assert_allclose(system.Hpp[c], H, rtol=1e-10, atol=1e-12)
+        np.testing.assert_allclose(system.g_cam[c], g, rtol=1e-10, atol=1e-12)
+
+
+def test_damping():
+    system, *_ = build_test_system()
+    region = jnp.asarray(10.0)
+    damped = damp_blocks(system.Hpp, region)
+    expect = np.asarray(system.Hpp).copy()
+    for i in range(expect.shape[0]):
+        np.fill_diagonal(expect[i], np.diag(expect[i]) * 1.1)
+    np.testing.assert_allclose(damped, expect, rtol=1e-12)
+
+
+@pytest.mark.parametrize("compute_kind", [ComputeKind.IMPLICIT, ComputeKind.EXPLICIT])
+def test_pcg_matches_dense(compute_kind):
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system(compute_kind=compute_kind)
+    region = jnp.asarray(100.0)
+    dx_cam_d, dx_pt_d = dense_reference_solve(system, Jc, Jp, cam_idx, pt_idx, region)
+    out = schur_pcg_solve(
+        system, Jc, Jp, cam_idx, pt_idx, region,
+        max_iter=500, tol=1e-14, refuse_ratio=1e30, compute_kind=compute_kind)
+    np.testing.assert_allclose(out.dx_cam, dx_cam_d, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(out.dx_pt, dx_pt_d, rtol=1e-6, atol=1e-8)
+
+
+def test_pcg_jit_compiles():
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system()
+    f = jax.jit(
+        lambda sys_, Jc_, Jp_, region: schur_pcg_solve(
+            sys_, Jc_, Jp_, cam_idx, pt_idx, region, max_iter=50, tol=1e-10,
+        )
+    )
+    out = f(system, Jc, Jp, jnp.asarray(50.0))
+    assert np.all(np.isfinite(out.dx_cam)) and np.all(np.isfinite(out.dx_pt))
+    assert int(out.iterations) > 0
+
+
+def test_fixed_camera_gets_zero_update():
+    cam_fixed = jnp.asarray([True, False, False])
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system(cam_fixed=cam_fixed)
+    out = schur_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, jnp.asarray(100.0),
+                          max_iter=300, tol=1e-13, refuse_ratio=1e30)
+    np.testing.assert_allclose(out.dx_cam[0], np.zeros(9), atol=1e-12)
+    assert float(jnp.max(jnp.abs(out.dx_cam[1:]))) > 0
+
+
+def test_edgeless_vertex_is_inert_not_nan():
+    # A point with no observations (possible in filtered real datasets)
+    # must get a zero update, not NaN-poison the solve.
+    s = make_synthetic_bal(num_cameras=3, num_points=12, seed=2)
+    cams, pts0 = jnp.asarray(s.cameras0), np.asarray(s.points0)
+    pts = jnp.asarray(np.concatenate([pts0, [[9.0, 9.0, 9.0]]]))  # orphan point 12
+    cam_idx, pt_idx, obs = jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.asarray(s.obs)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+    r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx, jnp.ones(len(s.obs)))
+    system = build_schur_system(r, Jc, Jp, cam_idx, pt_idx, 3, 13)
+    out = schur_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, jnp.asarray(100.0),
+                          max_iter=300, tol=1e-13, refuse_ratio=1e30)
+    assert np.all(np.isfinite(out.dx_cam)) and np.all(np.isfinite(out.dx_pt))
+    np.testing.assert_allclose(out.dx_pt[12], np.zeros(3), atol=1e-14)
+
+
+def test_padding_edges_are_inert():
+    # Same system with 5 extra masked edges must produce identical blocks.
+    s = make_synthetic_bal(num_cameras=3, num_points=12, seed=1)
+    cams, pts = jnp.asarray(s.cameras0), jnp.asarray(s.points0)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    def build(cam_idx, pt_idx, obs, mask):
+        r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+        r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx, mask)
+        return build_schur_system(r, Jc, Jp, cam_idx, pt_idx, 3, 12)
+
+    base = build(jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.asarray(s.obs),
+                 jnp.ones(len(s.obs)))
+    pad = 5
+    cam_idx_p = jnp.concatenate([jnp.asarray(s.cam_idx), jnp.zeros(pad, jnp.int32)])
+    pt_idx_p = jnp.concatenate([jnp.asarray(s.pt_idx), jnp.zeros(pad, jnp.int32)])
+    obs_p = jnp.concatenate([jnp.asarray(s.obs), jnp.full((pad, 2), 123.0)])
+    mask_p = jnp.concatenate([jnp.ones(len(s.obs)), jnp.zeros(pad)])
+    padded = build(cam_idx_p, pt_idx_p, obs_p, mask_p)
+    np.testing.assert_allclose(padded.Hpp, base.Hpp, rtol=1e-12)
+    np.testing.assert_allclose(padded.Hll, base.Hll, rtol=1e-12)
+    np.testing.assert_allclose(padded.g_cam, base.g_cam, rtol=1e-12)
+    np.testing.assert_allclose(padded.g_pt, base.g_pt, rtol=1e-12)
