@@ -1,0 +1,108 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures LM iterations/second on a synthetic Venice-1778-scale problem
+(1778 cameras, ~1M observations — the BASELINE.md config 3 shape) with
+the analytical Jacobian and the implicit (matrix-free) Schur PCG, float32,
+on whatever accelerator JAX provides (the real TPU chip under the driver).
+
+The reference repo publishes no absolute numbers (BASELINE.md); the
+`vs_baseline` field is computed against ASSUMED_BASELINE_LM_ITERS_PER_SEC,
+an order-of-magnitude estimate of the reference's per-LM-iteration rate
+on its 2-GPU Venice demo config (README.md:56-58) — to be replaced when a
+measured reference number exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import os
+
+ASSUMED_BASELINE_LM_ITERS_PER_SEC = 10.0
+
+# MEGBA_BENCH_SCALE in (0, 1] shrinks the problem for smoke tests.
+_SCALE = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
+NUM_CAMERAS = max(8, int(1778 * _SCALE))
+NUM_POINTS = max(64, int(99_392 * _SCALE))  # ~Venice/10 point count; obs count matches
+OBS_PER_POINT = 10  # ~994k observations at full scale — Venice-1778's edge count
+LM_ITERS = 8
+PCG_ITERS = 30
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from megba_tpu.common import (
+        AlgoOption,
+        ComputeKind,
+        JacobianMode,
+        ProblemOption,
+        SolverOption,
+    )
+    from megba_tpu.algo import lm_solve
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    dtype = np.float32
+    s = make_synthetic_bal(
+        num_cameras=NUM_CAMERAS,
+        num_points=NUM_POINTS,
+        obs_per_point=OBS_PER_POINT,
+        seed=0,
+        param_noise=1e-2,
+        pixel_noise=0.5,
+        dtype=dtype,
+    )
+    n_edge = s.obs.shape[0]
+
+    option = ProblemOption(
+        dtype=dtype,
+        compute_kind=ComputeKind.IMPLICIT,
+        jacobian_mode=JacobianMode.ANALYTICAL,
+        algo_option=AlgoOption(max_iter=LM_ITERS, epsilon1=1e-12, epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=PCG_ITERS, tol=1e-10, refuse_ratio=1e30),
+    )
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    args = (
+        jnp.asarray(s.cameras0),
+        jnp.asarray(s.points0),
+        jnp.asarray(s.obs),
+        jnp.asarray(s.cam_idx),
+        jnp.asarray(s.pt_idx),
+        jnp.ones(n_edge, dtype=dtype),
+    )
+
+    solve = jax.jit(
+        lambda cams, pts, obs, ci, pi, m: lm_solve(f, cams, pts, obs, ci, pi, m, option)
+    )
+
+    # Warmup (compile) — not timed.
+    res = solve(*args)
+    jax.block_until_ready(res.cost)
+    iters = int(res.iterations)
+
+    t0 = time.perf_counter()
+    res = solve(*args)
+    jax.block_until_ready(res.cost)
+    elapsed = time.perf_counter() - t0
+
+    lm_iters_per_sec = iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"LM iters/sec, synthetic Venice-1778 scale ({n_edge} edges), f32 analytical implicit, 1 chip",
+                "value": round(lm_iters_per_sec, 3),
+                "unit": "LM iters/s",
+                "vs_baseline": round(lm_iters_per_sec / ASSUMED_BASELINE_LM_ITERS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -119,7 +119,8 @@ def lm_solve(
             s["system"], s["Jc"], s["Jp"], cam_idx, pt_idx, s["region"],
             max_iter=solver_opt.max_iter, tol=solver_opt.tol,
             refuse_ratio=solver_opt.refuse_ratio,
-            compute_kind=compute_kind, axis_name=axis_name)
+            compute_kind=compute_kind, axis_name=axis_name,
+            mixed_precision=option.mixed_precision_pcg)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply

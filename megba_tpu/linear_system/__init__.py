@@ -2,7 +2,6 @@ from megba_tpu.linear_system.builder import (
     SchurSystem,
     build_schur_system,
     damp_blocks,
-    undamped_diag,
     weight_system_inputs,
 )
 
@@ -10,6 +9,5 @@ __all__ = [
     "SchurSystem",
     "build_schur_system",
     "damp_blocks",
-    "undamped_diag",
     "weight_system_inputs",
 ]

@@ -179,6 +179,3 @@ def damp_blocks(H: jax.Array, region: jax.Array) -> jax.Array:
     return H * factor
 
 
-def undamped_diag(H: jax.Array) -> jax.Array:
-    """Extract block diagonals [*, d] from block array [*, d, d]."""
-    return jnp.diagonal(H, axis1=-2, axis2=-1)

@@ -1,0 +1,8 @@
+from megba_tpu.parallel.mesh import (
+    EDGE_AXIS,
+    distributed_lm_solve,
+    make_mesh,
+    shard_edge_arrays,
+)
+
+__all__ = ["EDGE_AXIS", "distributed_lm_solve", "make_mesh", "shard_edge_arrays"]

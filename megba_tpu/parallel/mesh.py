@@ -1,0 +1,128 @@
+"""Device mesh + edge-axis sharding.
+
+The TPU-native replacement for the reference's entire distribution
+machinery: the host-side `for (i < worldSize) cudaSetDevice(i)` loops
+(reference src/edge/base_edge.cu:20-25, src/solver/schur_pcg_solver.cu:
+164-197), the per-device contiguous edge partition
+(MemoryPool::getItemNum, memory_pool.h:48-63; base_problem.cpp:59-74) and
+the NCCL allreduce set (SURVEY.md §2.3) become: a 1-D
+`jax.sharding.Mesh` over axis "edges", `jax.shard_map` with edge arrays
+split on their leading axis (the same contiguous partition, but
+equal-size via padding), and `jax.lax.psum` inside the jitted solve.
+
+Unlike the reference (single-process, single-node, ncclCommInitAll —
+handle_manager.cpp:17-22), the same code runs multi-host: under
+`jax.distributed`, the Mesh spans all hosts' devices, XLA routes the
+psums over ICI within a slice and DCN across slices, and nothing here
+changes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.common import ProblemOption
+from megba_tpu.core.types import pad_edges
+
+EDGE_AXIS = "edges"
+
+
+def make_mesh(
+    world_size: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the 1-D edge-sharding mesh.
+
+    `world_size` plays the role of the reference's ProblemOption::deviceUsed
+    GPU count (common.h:47, validated against the device count at
+    memory_pool.cu:50-56).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if world_size > len(devices):
+        raise ValueError(
+            f"world_size {world_size} exceeds available devices {len(devices)}"
+        )
+    return Mesh(np.asarray(devices[:world_size]), (EDGE_AXIS,))
+
+
+def shard_edge_arrays(
+    obs: np.ndarray,
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    world_size: int,
+    dtype=np.float64,
+):
+    """Pad the edge axis to a multiple of world_size; returns (+mask)."""
+    return pad_edges(obs, cam_idx, pt_idx, world_size, dtype=dtype)
+
+
+def distributed_lm_solve(
+    residual_jac_fn,
+    cameras: jax.Array,
+    points: jax.Array,
+    obs: jax.Array,
+    cam_idx: jax.Array,
+    pt_idx: jax.Array,
+    mask: jax.Array,
+    option: ProblemOption,
+    mesh: Mesh,
+    sqrt_info: Optional[jax.Array] = None,
+    cam_fixed: Optional[jax.Array] = None,
+    pt_fixed: Optional[jax.Array] = None,
+    verbose: bool = False,
+) -> LMResult:
+    """Run the full LM solve SPMD over the mesh's edge axis.
+
+    Parameter state (cameras/points, Hessian diagonals, PCG vectors) is
+    replicated — the reference's layout exactly (base_problem.cu:21-29,
+    base_linear_system.h:33-34) — while every per-edge array lives only on
+    its shard.  The entire LM loop, PCG included, is ONE jitted SPMD
+    program; per-iteration synchronisation is the psum set documented in
+    builder.py/pcg.py.
+    """
+    n_edge = obs.shape[0]
+    if n_edge % mesh.devices.size != 0:
+        raise ValueError(
+            f"edge count {n_edge} not divisible by mesh size "
+            f"{mesh.devices.size}; pad with shard_edge_arrays first"
+        )
+
+    edge = P(EDGE_AXIS)
+    rep = P()
+
+    solve = functools.partial(
+        lm_solve,
+        residual_jac_fn,
+        option=option,
+        axis_name=EDGE_AXIS,
+        verbose=verbose,
+    )
+
+    # Optional operands can't be None inside shard_map specs; pass the
+    # present ones positionally with matching specs.
+    args = [cameras, points, obs, cam_idx, pt_idx, mask]
+    in_specs = [rep, rep, edge, edge, edge, edge]
+    optional = [
+        ("sqrt_info", sqrt_info, edge),
+        ("cam_fixed", cam_fixed, rep),
+        ("pt_fixed", pt_fixed, rep),
+    ]
+    keys = [k for k, v, _ in optional if v is not None]
+    args += [v for _, v, _ in optional if v is not None]
+    in_specs += [spec for _, v, spec in optional if v is not None]
+
+    def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
+        return solve(cameras, points, obs, cam_idx, pt_idx, mask,
+                     **dict(zip(keys, extras)))
+
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=rep)
+
+    with jax.default_device(mesh.devices.flat[0]):
+        return jax.jit(sharded)(*args)

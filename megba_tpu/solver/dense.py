@@ -41,7 +41,7 @@ def dense_reference_solve(
     for j in range(Np):
         H = H.at[off + j * pd : off + (j + 1) * pd, off + j * pd : off + (j + 1) * pd].set(Hll_d[j])
     # Coupling: W_e = Jc_e^T Jp_e accumulated at (camera row, point col).
-    W = jnp.einsum("eoc,eop->ecp", Jc, Jp)
+    W = jnp.einsum("eoc,eop->ecp", Jc, Jp, precision=jax.lax.Precision.HIGHEST)
     for e in range(Jc.shape[0]):
         ci = int(cam_idx[e])
         pi = int(pt_idx[e])

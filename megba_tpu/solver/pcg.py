@@ -79,7 +79,7 @@ def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
 
 
 def make_coupling_matvecs(
-    system: SchurSystem,
+    W: Optional[jax.Array],
     Jc: jax.Array,
     Jp: jax.Array,
     cam_idx: jax.Array,
@@ -88,40 +88,58 @@ def make_coupling_matvecs(
     num_points: int,
     compute_kind: ComputeKind,
     axis_name: Optional[str] = None,
+    mixed_precision: bool = False,
 ) -> Tuple[Callable[[jax.Array], jax.Array], Callable[[jax.Array], jax.Array]]:
     """Build hpl(q_pt)->[Nc,cd] and hlp(p_cam)->[Np,pd] matvec closures.
 
-    Edge arrays are shard-local; outputs are psum-reduced to replicated.
+    EXPLICIT mode reads only `W` (per-edge coupling blocks); IMPLICIT mode
+    reads only `Jc`/`Jp`.  Edge arrays are shard-local; outputs are
+    psum-reduced to replicated.
+
+    `mixed_precision` (BASELINE.md config 5) expects the used operands to
+    be pre-equilibrated and bf16-cast (see schur_pcg_solve) and
+    accumulates in float32 (`preferred_element_type`) — the coupling
+    products are the PCG's bandwidth-dominant work, so this halves HBM
+    traffic while the Krylov vectors, reductions and preconditioner stay
+    float32.
     """
+    ed = jnp.bfloat16 if mixed_precision else None
+
+    def cast(x):
+        return x.astype(ed) if ed is not None else x
+
+    def ee(spec, a, b):
+        if mixed_precision:
+            return jnp.einsum(spec, a, b, preferred_element_type=jnp.float32)
+        return jnp.einsum(spec, a, b, precision=HI)
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
     if compute_kind == ComputeKind.EXPLICIT:
-        W = system.W  # [nE, cd, pd]
 
         def hlp(p_cam: jax.Array) -> jax.Array:
-            pe = jnp.take(p_cam, cam_idx, axis=0)  # [nE, cd]
-            te = jnp.einsum("ecp,ec->ep", W, pe, precision=HI)
+            pe = cast(jnp.take(p_cam, cam_idx, axis=0))  # [nE, cd]
+            te = ee("ecp,ec->ep", W, pe)
             return psum(jax.ops.segment_sum(te, pt_idx, num_segments=num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
-            qe = jnp.take(q_pt, pt_idx, axis=0)  # [nE, pd]
-            te = jnp.einsum("ecp,ep->ec", W, qe, precision=HI)
+            qe = cast(jnp.take(q_pt, pt_idx, axis=0))  # [nE, pd]
+            te = ee("ecp,ep->ec", W, qe)
             return psum(jax.ops.segment_sum(te, cam_idx, num_segments=num_cameras))
 
     else:
 
         def hlp(p_cam: jax.Array) -> jax.Array:
-            pe = jnp.take(p_cam, cam_idx, axis=0)
-            u = jnp.einsum("eoc,ec->eo", Jc, pe, precision=HI)  # Jc p
-            te = jnp.einsum("eop,eo->ep", Jp, u, precision=HI)  # Jp^T (Jc p)
+            pe = cast(jnp.take(p_cam, cam_idx, axis=0))
+            u = ee("eoc,ec->eo", Jc, pe)  # Jc p
+            te = ee("eop,eo->ep", Jp, cast(u))  # Jp^T (Jc p)
             return psum(jax.ops.segment_sum(te, pt_idx, num_segments=num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
-            qe = jnp.take(q_pt, pt_idx, axis=0)
-            u = jnp.einsum("eop,ep->eo", Jp, qe, precision=HI)  # Jp q
-            te = jnp.einsum("eoc,eo->ec", Jc, u, precision=HI)  # Jc^T (Jp q)
+            qe = cast(jnp.take(q_pt, pt_idx, axis=0))
+            u = ee("eop,ep->eo", Jp, qe)  # Jp q
+            te = ee("eoc,eo->ec", Jc, cast(u))  # Jc^T (Jp q)
             return psum(jax.ops.segment_sum(te, cam_idx, num_segments=num_cameras))
 
     return hpl, hlp
@@ -139,6 +157,7 @@ def schur_pcg_solve(
     refuse_ratio: float = 1.0,
     compute_kind: ComputeKind = ComputeKind.IMPLICIT,
     axis_name: Optional[str] = None,
+    mixed_precision: bool = False,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt).
 
@@ -155,12 +174,40 @@ def schur_pcg_solve(
 
     Hpp_d = damp_blocks(system.Hpp, region)
     Hll_d = damp_blocks(system.Hll, region)
+    g_cam, g_pt = system.g_cam, system.g_pt
+    W = system.W
+
+    d_cam = d_pt = None
+    if mixed_precision:
+        # Jacobi (scale-then-cast) equilibration: BA Jacobian columns span
+        # ~6 orders of magnitude (rotation vs focal), far beyond bf16's
+        # dynamic range.  Solve the symmetrically scaled system
+        # (D S D) x~ = D v with D = diag(H)^-1/2 — unit-diagonal, so the
+        # bf16-cast coupling operands are well-ranged — and unscale the
+        # solution at the end.
+        d_cam = jax.lax.rsqrt(jnp.diagonal(Hpp_d, axis1=-2, axis2=-1))
+        d_pt = jax.lax.rsqrt(jnp.diagonal(Hll_d, axis1=-2, axis2=-1))
+        Hpp_d = Hpp_d * d_cam[:, :, None] * d_cam[:, None, :]
+        Hll_d = Hll_d * d_pt[:, :, None] * d_pt[:, None, :]
+        g_cam = g_cam * d_cam
+        g_pt = g_pt * d_pt
+        bf = jnp.bfloat16
+        if compute_kind == ComputeKind.EXPLICIT:
+            W = (
+                W
+                * jnp.take(d_cam, cam_idx, axis=0)[:, :, None]
+                * jnp.take(d_pt, pt_idx, axis=0)[:, None, :]
+            ).astype(bf)
+        else:
+            Jc = (Jc * jnp.take(d_cam, cam_idx, axis=0)[:, None, :]).astype(bf)
+            Jp = (Jp * jnp.take(d_pt, pt_idx, axis=0)[:, None, :]).astype(bf)
+
     Hll_inv = block_inv(Hll_d)
     Minv = block_inv(Hpp_d)  # block-Jacobi preconditioner
 
     hpl, hlp = make_coupling_matvecs(
-        system, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
-        compute_kind, axis_name,
+        W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
+        compute_kind, axis_name, mixed_precision=mixed_precision,
     )
 
     def s_matvec(p: jax.Array) -> jax.Array:
@@ -169,7 +216,7 @@ def schur_pcg_solve(
         return block_matvec(Hpp_d, p) - hpl(t)
 
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
-    v = system.g_cam - hpl(block_matvec(Hll_inv, system.g_pt))
+    v = g_cam - hpl(block_matvec(Hll_inv, g_pt))
 
     x0 = jnp.zeros_like(v)
     r0 = v  # x0 = 0 so r0 = v - S x0 = v
@@ -206,5 +253,8 @@ def schur_pcg_solve(
     x = jnp.where(refused, x_best, x)
 
     # Back-substitute the point update       [1 psum]
-    dx_pt = block_matvec(Hll_inv, system.g_pt - hlp(x))
+    dx_pt = block_matvec(Hll_inv, g_pt - hlp(x))
+    if mixed_precision:
+        x = x * d_cam  # unscale back to the original variables
+        dx_pt = dx_pt * d_pt
     return PCGResult(dx_cam=x, dx_pt=dx_pt, iterations=k, rho=rho)

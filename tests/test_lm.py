@@ -67,6 +67,29 @@ def test_lm_cost_monotone_nonincreasing():
     assert float(res.cost) < float(res.initial_cost) * 0.1
 
 
+def test_lm_mixed_precision_converges():
+    # Full LM with the bf16 (scale-then-cast) PCG must reach essentially
+    # the same final cost as full precision: the inexact steps are
+    # absorbed by the trust-region accept/reject.
+    s = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                           seed=0, param_noise=5e-2, pixel_noise=0.3)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    def solve(mixed):
+        option = ProblemOption(
+            mixed_precision_pcg=mixed,
+            algo_option=AlgoOption(max_iter=30, epsilon1=1e-9, epsilon2=1e-12),
+            solver_option=SolverOption(max_iter=100, tol=1e-14, refuse_ratio=1e30))
+        return lm_solve(f, jnp.asarray(s.cameras0), jnp.asarray(s.points0),
+                        jnp.asarray(s.obs), jnp.asarray(s.cam_idx),
+                        jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)), option)
+
+    full = solve(False)
+    mixed = solve(True)
+    assert float(mixed.cost) < float(mixed.initial_cost) * 1e-2
+    np.testing.assert_allclose(float(mixed.cost), float(full.cost), rtol=5e-2)
+
+
 def test_lm_respects_max_iter():
     _, res = run_lm(max_iter=3)
     assert int(res.iterations) <= 3

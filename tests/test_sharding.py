@@ -1,0 +1,76 @@
+"""Multi-device tests on the virtual CPU mesh (SURVEY.md §4e).
+
+The reference could never test its distribution without real GPUs; here
+world_size 1/2/8 runs on 8 virtual CPU devices and must agree with the
+single-device solve.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import cpu_devices
+
+from megba_tpu.algo import lm_solve
+from megba_tpu.common import AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.parallel import distributed_lm_solve, make_mesh, shard_edge_arrays
+
+
+def make_problem(seed=0):
+    return make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                              seed=seed, param_noise=4e-2, pixel_noise=0.3)
+
+
+def make_option(compute_kind=ComputeKind.IMPLICIT):
+    return ProblemOption(
+        compute_kind=compute_kind,
+        algo_option=AlgoOption(max_iter=12, epsilon1=1e-10, epsilon2=1e-12),
+        solver_option=SolverOption(max_iter=120, tol=1e-13, refuse_ratio=1e30),
+    )
+
+
+def solve_world(s, world_size, compute_kind=ComputeKind.IMPLICIT):
+    option = make_option(compute_kind)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    obs, cam_idx, pt_idx, mask = shard_edge_arrays(
+        s.obs, s.cam_idx, s.pt_idx, world_size)
+    mesh = make_mesh(world_size, cpu_devices(world_size))
+    return distributed_lm_solve(
+        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(obs),
+        jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
+        option, mesh)
+
+
+@pytest.mark.parametrize("world_size", [2, 8])
+@pytest.mark.parametrize("compute_kind", [ComputeKind.IMPLICIT, ComputeKind.EXPLICIT])
+def test_distributed_matches_single_device(world_size, compute_kind):
+    s = make_problem()
+    res1 = solve_world(s, 1, compute_kind)
+    resn = solve_world(s, world_size, compute_kind)
+    # Same algorithm, same partition semantics — only psum reduction order
+    # differs, so float64 costs agree tightly.
+    np.testing.assert_allclose(float(resn.cost), float(res1.cost), rtol=1e-9)
+    np.testing.assert_allclose(float(resn.initial_cost), float(res1.initial_cost), rtol=1e-12)
+    assert int(resn.iterations) == int(res1.iterations)
+    # Parameters drift slightly along the BA gauge directions from psum
+    # reduction-order differences; compare loosely.
+    np.testing.assert_allclose(np.asarray(resn.cameras), np.asarray(res1.cameras),
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_uneven_edges_padded():
+    s = make_synthetic_bal(num_cameras=6, num_points=41, obs_per_point=4,
+                           seed=3, param_noise=4e-2, pixel_noise=0.3)
+    # An odd observation count forces shard_edge_arrays to pad+mask.
+    assert len(s.obs) % 8 != 0
+    res = solve_world(s, 8)
+    assert np.isfinite(float(res.cost))
+    assert float(res.cost) < float(res.initial_cost)
+
+
+def test_world_size_exceeding_devices_raises():
+    with pytest.raises(ValueError):
+        make_mesh(1000, cpu_devices(8))
