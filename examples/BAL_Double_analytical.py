@@ -1,0 +1,15 @@
+"""Parity entry point for the reference's BAL_Double_analytical example
+(reference examples/BAL_Double_analytical.cpp): float64, analytical Jacobians, explicit Hessian."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from examples.common import run_example
+from megba_tpu.common import ComputeKind, JacobianMode
+
+if __name__ == "__main__":
+    run_example(np.float64, JacobianMode.ANALYTICAL, ComputeKind.EXPLICIT)

@@ -27,6 +27,14 @@ from megba_tpu.common import (
     SolverOption,
 )
 from megba_tpu.core.types import BALData, BAState
+from megba_tpu.problem import (
+    BaseEdge,
+    BaseProblem,
+    BaseVertex,
+    CameraVertex,
+    PointVertex,
+    VertexKind,
+)
 
 __version__ = "0.1.0"
 
@@ -35,11 +43,17 @@ __all__ = [
     "AlgoOption",
     "BALData",
     "BAState",
+    "BaseEdge",
+    "BaseProblem",
+    "BaseVertex",
+    "CameraVertex",
     "ComputeKind",
     "Device",
     "JacobianMode",
     "LinearSystemKind",
+    "PointVertex",
     "ProblemOption",
     "SolverKind",
     "SolverOption",
+    "VertexKind",
 ]

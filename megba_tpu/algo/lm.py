@@ -184,10 +184,20 @@ def lm_solve(
             stop=converged | (accept & stop_accept),
         )
         if verbose:
-            jax.debug.print(
-                "iter {k}: cost {c:.6e} log10 {l:.3f} accept {a} pcg_iters {p}",
-                k=s["k"], c=cost_new, l=jnp.log10(cost_new), a=accept,
-                p=pcg.iterations)
+            def _print(args):
+                k, c, a, p = args
+                jax.debug.print(
+                    "iter {k}: cost {c:.6e} log10 {l:.3f} accept {a} pcg_iters {p}",
+                    k=k, c=c, l=jnp.log10(c), a=a, p=p)
+
+            args = (s["k"], cost_new, accept, pcg.iterations)
+            if axis_name is None:
+                _print(args)
+            else:
+                # One line per iteration, not one per shard.
+                jax.lax.cond(
+                    jax.lax.axis_index(axis_name) == 0, _print,
+                    lambda _: None, args)
         return s_next
 
     out = jax.lax.while_loop(cond, body, state0)

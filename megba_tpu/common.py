@@ -71,7 +71,7 @@ class JacobianMode(enum.Enum):
     ANALYTICAL = 1
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class SolverOption:
     """Inner (PCG) solver options — reference common.h:27-33 defaults."""
 
@@ -81,7 +81,7 @@ class SolverOption:
     refuse_ratio: float = 1.0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class AlgoOption:
     """Outer (LM) loop options — reference common.h:35-42 defaults."""
 
@@ -92,9 +92,12 @@ class AlgoOption:
     epsilon2: float = 1e-10
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class ProblemOption:
     """Problem-level options — reference common.h:44-53.
+
+    Frozen (immutable + hashable): options are jit-trace statics and cache
+    keys; use dataclasses.replace to derive variants.
 
     `world_size` replaces the reference's `deviceUsed` GPU list: the number
     of mesh devices the edge axis is sharded over.  `dtype` replaces the

@@ -1,0 +1,137 @@
+// Fast BAL text parser — the native data-loader of the host runtime.
+//
+// Role equivalent of the reference's example-side line parser
+// (reference examples/BAL_Double.cpp:74-139, which fscanf's 4.5M
+// observation lines for Final-13682) and of its host-side problem
+// construction costs (SURVEY.md section 3.1 flags SoA appends as the
+// build bottleneck).  Design is new: mmap the whole file, scan the token
+// stream once with a branch-light float reader, write straight into
+// caller-provided (numpy) buffers.  C ABI for ctypes binding — no
+// pybind11 in this image.
+
+#include <cctype>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Cursor {
+  const char* p;
+  const char* end;
+};
+
+inline void skip_space(Cursor& c) {
+  while (c.p < c.end && std::isspace(static_cast<unsigned char>(*c.p))) ++c.p;
+}
+
+// strtod on a bounded buffer; BAL files are '\0'-free text so strtod's
+// scan terminates at whitespace well before `end`.
+inline bool next_double(Cursor& c, double* out) {
+  skip_space(c);
+  if (c.p >= c.end) return false;
+  char* after = nullptr;
+  *out = std::strtod(c.p, &after);
+  if (after == c.p) return false;
+  c.p = after;
+  return true;
+}
+
+inline bool next_long(Cursor& c, long* out) {
+  skip_space(c);
+  if (c.p >= c.end) return false;
+  char* after = nullptr;
+  *out = std::strtol(c.p, &after, 10);
+  if (after == c.p) return false;
+  c.p = after;
+  return true;
+}
+
+struct Mapped {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+
+  bool open_file(const char* path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size <= 0) return false;
+    size = static_cast<size_t>(st.st_size);
+    void* m = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (m == MAP_FAILED) return false;
+    data = static_cast<const char*>(m);
+    ::madvise(const_cast<char*>(data), size, MADV_SEQUENTIAL);
+    return true;
+  }
+
+  ~Mapped() {
+    if (data) munmap(const_cast<char*>(data), size);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Reads only the header. Returns 0 on success.
+int megba_bal_header(const char* path, int64_t* n_cam, int64_t* n_pt,
+                     int64_t* n_obs) {
+  Mapped m;
+  if (!m.open_file(path)) return -1;
+  Cursor c{m.data, m.data + m.size};
+  long a, b, d;
+  if (!next_long(c, &a) || !next_long(c, &b) || !next_long(c, &d)) return -2;
+  if (a < 0 || b < 0 || d < 0) return -3;
+  *n_cam = a;
+  *n_pt = b;
+  *n_obs = d;
+  return 0;
+}
+
+// Full parse into caller-allocated buffers:
+//   obs      [n_obs * 2] double
+//   cam_idx  [n_obs] int32
+//   pt_idx   [n_obs] int32
+//   cameras  [n_cam * 9] double
+//   points   [n_pt * 3] double
+// Returns 0 on success, negative error codes on malformed input.
+int megba_bal_parse(const char* path, int64_t n_cam, int64_t n_pt,
+                    int64_t n_obs, double* obs, int32_t* cam_idx,
+                    int32_t* pt_idx, double* cameras, double* points) {
+  Mapped m;
+  if (!m.open_file(path)) return -1;
+  Cursor c{m.data, m.data + m.size};
+  long a, b, d;
+  if (!next_long(c, &a) || !next_long(c, &b) || !next_long(c, &d)) return -2;
+  if (a != n_cam || b != n_pt || d != n_obs) return -3;
+
+  for (int64_t i = 0; i < n_obs; ++i) {
+    long ci, pi;
+    double u, v;
+    if (!next_long(c, &ci) || !next_long(c, &pi) || !next_double(c, &u) ||
+        !next_double(c, &v))
+      return -4;
+    if (ci < 0 || ci >= n_cam || pi < 0 || pi >= n_pt) return -5;
+    cam_idx[i] = static_cast<int32_t>(ci);
+    pt_idx[i] = static_cast<int32_t>(pi);
+    obs[2 * i] = u;
+    obs[2 * i + 1] = v;
+  }
+  for (int64_t i = 0; i < n_cam * 9; ++i)
+    if (!next_double(c, &cameras[i])) return -6;
+  for (int64_t i = 0; i < n_pt * 3; ++i)
+    if (!next_double(c, &points[i])) return -7;
+  skip_space(c);
+  if (c.p != c.end) return -8;  // trailing garbage
+  return 0;
+}
+
+}  // extern "C"

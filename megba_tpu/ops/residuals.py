@@ -16,6 +16,7 @@ single TPU program.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -94,6 +95,7 @@ def bal_residual_jacobian_analytical(
     return r, Jc, J_X
 
 
+@functools.lru_cache(maxsize=64)
 def make_residual_fn(
     residual_fn: ResidualFn = bal_residual,
 ) -> Callable[..., jnp.ndarray]:
@@ -106,12 +108,17 @@ def make_residual_fn(
     return jax.vmap(residual_fn, in_axes=(0, 0, 0))
 
 
+@functools.lru_cache(maxsize=64)
 def make_residual_jacobian_fn(
     residual_fn: ResidualFn = bal_residual,
     mode: JacobianMode = JacobianMode.AUTODIFF,
     analytical_fn: Optional[Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]] = None,
 ) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """Build the vectorised residual+Jacobian evaluator.
+
+    Memoised so repeated construction with the same engine config returns
+    the identical callable — keeping jax.jit / the distributed solve cache
+    hot across separate solves.
 
     Returns fn(cam_params[nE,cd], pt_params[nE,pd], obs[nE,od])
       -> (r[nE,od], Jc[nE,od,cd], Jp[nE,od,pd]).

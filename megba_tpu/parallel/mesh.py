@@ -20,6 +20,7 @@ changes.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -45,6 +46,23 @@ def make_mesh(
     """
     if devices is None:
         devices = jax.devices()
+        if len(devices) < world_size:
+            # Fall back to the CPU platform (e.g. virtual multi-device CPU
+            # testing while only one accelerator chip is attached) — loudly,
+            # so a production solve can't silently leave the accelerator.
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = []
+            if len(cpus) >= world_size:
+                warnings.warn(
+                    f"world_size {world_size} exceeds the {len(devices)} "
+                    f"{devices[0].platform} device(s); falling back to "
+                    f"{len(cpus)} CPU devices. Pass devices= explicitly to "
+                    "silence.",
+                    stacklevel=2,
+                )
+                devices = cpus
     if world_size > len(devices):
         raise ValueError(
             f"world_size {world_size} exceeds available devices {len(devices)}"
@@ -57,9 +75,15 @@ def shard_edge_arrays(
     cam_idx: np.ndarray,
     pt_idx: np.ndarray,
     world_size: int,
-    dtype=np.float64,
+    dtype=None,
 ):
-    """Pad the edge axis to a multiple of world_size; returns (+mask)."""
+    """Pad the edge axis to a multiple of world_size; returns (+mask).
+
+    The mask dtype follows `obs` unless overridden, so a float32 problem
+    is never silently upcast by a float64 mask.
+    """
+    if dtype is None:
+        dtype = obs.dtype
     return pad_edges(obs, cam_idx, pt_idx, world_size, dtype=dtype)
 
 
@@ -97,14 +121,6 @@ def distributed_lm_solve(
     edge = P(EDGE_AXIS)
     rep = P()
 
-    solve = functools.partial(
-        lm_solve,
-        residual_jac_fn,
-        option=option,
-        axis_name=EDGE_AXIS,
-        verbose=verbose,
-    )
-
     # Optional operands can't be None inside shard_map specs; pass the
     # present ones positionally with matching specs.
     args = [cameras, points, obs, cam_idx, pt_idx, mask]
@@ -114,15 +130,33 @@ def distributed_lm_solve(
         ("cam_fixed", cam_fixed, rep),
         ("pt_fixed", pt_fixed, rep),
     ]
-    keys = [k for k, v, _ in optional if v is not None]
+    keys = tuple(k for k, v, _ in optional if v is not None)
     args += [v for _, v, _ in optional if v is not None]
     in_specs += [spec for _, v, spec in optional if v is not None]
 
-    def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
-        return solve(cameras, points, obs, cam_idx, pt_idx, mask,
-                     **dict(zip(keys, extras)))
-
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=rep)
+    jitted = _cached_sharded_solve(
+        residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose)
 
     with jax.default_device(mesh.devices.flat[0]):
-        return jax.jit(sharded)(*args)
+        return jitted(*args)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose):
+    """Build-and-cache the jitted shard_map'ed solve.
+
+    jax.jit caches by callable identity, so rebuilding the closure every
+    call would recompile the full LM+PCG program per solve; caching on
+    (engine fn, mesh, option, operand layout) pays tracing + compilation
+    once per configuration.  ProblemOption is frozen/hashable for exactly
+    this purpose.
+    """
+
+    def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
+        return lm_solve(
+            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
+            option, axis_name=EDGE_AXIS, verbose=verbose,
+            **dict(zip(keys, extras)))
+
+    sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return jax.jit(sharded)

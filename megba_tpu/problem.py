@@ -1,0 +1,285 @@
+"""g2o-compatible Problem / Vertex / Edge user API.
+
+The object facade with the semantics of the reference's user layer
+(include/problem/base_problem.h:54-82, include/vertex/base_vertex.h:24-77,
+include/edge/base_edge.h:25-67): `append_vertex` / `append_edge` /
+`get_vertex` / `erase_vertex` / `solve`, camera/point vertex kinds, fixed
+vertices, per-edge measurements and information matrices, and
+user-defined `forward()` residuals.
+
+Unlike the reference — where the object graph IS the runtime data
+structure, flattened scalar-by-scalar into SoA JetVectors on every push
+(base_vertex.h:153-171, the host-side scalability bottleneck noted in
+SURVEY.md §3.1) — this facade is a thin builder: `solve()` lowers the
+graph once into flat index/parameter arrays and hands them to the jitted
+mesh-sharded LM solver.  A user `forward()` is traced ONCE under
+`jax.vmap` (plain jnp math on vertex estimations), replacing the entire
+JetVector/eigen_injector operator stack.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.common import JacobianMode, ProblemOption, validate_options
+from megba_tpu.ops.residuals import (
+    bal_residual,
+    bal_residual_jacobian_analytical,
+    make_residual_jacobian_fn,
+)
+from megba_tpu.parallel.mesh import distributed_lm_solve, make_mesh, shard_edge_arrays
+
+
+class VertexKind(enum.Enum):
+    """Reference BaseVertex kind() (base_vertex.h:52-56)."""
+
+    CAMERA = 0
+    POINT = 1
+    NONE = 2
+
+
+class BaseVertex:
+    """A parameter block (reference BaseVertex, base_vertex.h:24-63)."""
+
+    kind = VertexKind.NONE
+
+    def __init__(self, estimation: np.ndarray, fixed: bool = False):
+        self.estimation = np.atleast_1d(np.asarray(estimation, dtype=np.float64)).copy()
+        self.fixed = bool(fixed)
+
+    @property
+    def grad_shape(self) -> int:
+        """Differentiable width: 0 when fixed (base_vertex.h:48-50)."""
+        return 0 if self.fixed else int(self.estimation.size)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(dim={self.estimation.size}, fixed={self.fixed})"
+
+
+class CameraVertex(BaseVertex):
+    kind = VertexKind.CAMERA
+
+
+class PointVertex(BaseVertex):
+    kind = VertexKind.POINT
+
+
+class BaseEdge:
+    """A residual term over its vertices (reference BaseEdge,
+    base_edge.h:25-67).
+
+    Subclass and override `forward()` for custom residual models;
+    `forward` reads `self.vertex_estimation(i)` (a jnp array during
+    tracing) and `self.measurement`, and returns the residual as a jnp
+    array.  It is traced once under jax.vmap, so it must be pure jnp math
+    (the reference's equivalent constraint: JetVector-compatible Eigen
+    ops).  If `forward` is not overridden, the edge uses the built-in BAL
+    reprojection model (examples/BAL_Double.cpp:18-33).
+    """
+
+    def __init__(
+        self,
+        vertices: Optional[Sequence[BaseVertex]] = None,
+        measurement: Optional[np.ndarray] = None,
+        information: Optional[np.ndarray] = None,
+    ):
+        self.vertices: List[BaseVertex] = list(vertices) if vertices else []
+        self.measurement = (
+            None if measurement is None else np.atleast_1d(np.asarray(measurement, np.float64))
+        )
+        self.information = None if information is None else np.asarray(information, np.float64)
+        # Trace-time storage (set by the vectoriser while forward() runs).
+        self._traced_estimations: Optional[List[jnp.ndarray]] = None
+        self._traced_measurement: Optional[jnp.ndarray] = None
+
+    def append_vertex(self, v: BaseVertex) -> "BaseEdge":
+        self.vertices.append(v)
+        return self
+
+    def vertex_estimation(self, i: int) -> jnp.ndarray:
+        """The i-th vertex's parameters; traced value inside forward()."""
+        if self._traced_estimations is not None:
+            return self._traced_estimations[i]
+        return jnp.asarray(self.vertices[i].estimation)
+
+    def get_measurement(self) -> jnp.ndarray:
+        if self._traced_measurement is not None:
+            return self._traced_measurement
+        return jnp.asarray(self.measurement)
+
+    def forward(self) -> jnp.ndarray:
+        """Default: the BAL reprojection residual (camera, point)."""
+        camera = self.vertex_estimation(0)
+        point = self.vertex_estimation(1)
+        return bal_residual(camera, point, self.get_measurement())
+
+
+def _edge_residual_fn(proto: BaseEdge):
+    """Build (camera, point, obs) -> r from a prototype edge's forward()."""
+
+    def fn(camera, point, obs):
+        proto._traced_estimations = [camera, point]
+        proto._traced_measurement = obs
+        try:
+            return proto.forward()
+        finally:
+            proto._traced_estimations = None
+            proto._traced_measurement = None
+
+    return fn
+
+
+class BaseProblem:
+    """The user facade + orchestration (reference BaseProblem,
+    base_problem.h:54-82 / base_problem.cpp).
+
+    Usage mirrors the reference examples: append vertices by id, append
+    edges (each holding a camera vertex and a point vertex plus a 2-d
+    measurement), then `solve()`; solutions are written back into the
+    vertex `estimation` arrays (reference writeBack,
+    base_problem.cpp:249-272).
+    """
+
+    def __init__(self, option: Optional[ProblemOption] = None):
+        self.option = option or ProblemOption()
+        validate_options(self.option)
+        self._vertices: Dict[int, BaseVertex] = {}
+        self._edges: List[BaseEdge] = []
+        self._edge_type: Optional[type] = None
+        self.result: Optional[LMResult] = None
+
+    # -- graph construction ------------------------------------------------
+    def append_vertex(self, vertex_id: int, vertex: BaseVertex) -> None:
+        if vertex_id in self._vertices:
+            raise ValueError(f"duplicate vertex id {vertex_id}")
+        self._vertices[vertex_id] = vertex
+
+    def append_edge(self, edge: BaseEdge) -> None:
+        # Homogeneous edge types only, like the reference's typeid check
+        # (base_edge.cpp:49,84-86).
+        if self._edge_type is None:
+            self._edge_type = type(edge)
+        elif type(edge) is not self._edge_type:
+            raise TypeError(
+                f"heterogeneous edge types: {type(edge).__name__} vs "
+                f"{self._edge_type.__name__}"
+            )
+        kinds = [v.kind for v in edge.vertices]
+        if kinds != [VertexKind.CAMERA, VertexKind.POINT]:
+            # The reference classifies ONE/TWO_CAMERA/MULTI kinds
+            # (base_edge.cpp:27-36) but, like us, only implements the
+            # Schur pipeline for ONE_CAMERA_ONE_POINT.
+            raise NotImplementedError(
+                "only (CameraVertex, PointVertex) edges are supported"
+            )
+        for v in edge.vertices:
+            if not any(v is pv for pv in self._vertices.values()):
+                raise ValueError("edge references a vertex not in the problem")
+        if edge.measurement is None:
+            raise ValueError("edge has no measurement")
+        self._edges.append(edge)
+
+    def get_vertex(self, vertex_id: int) -> BaseVertex:
+        return self._vertices[vertex_id]
+
+    def erase_vertex(self, vertex_id: int) -> None:
+        """Remove a vertex and every edge touching it (reference
+        eraseVertex, base_problem.cpp:145-157)."""
+        v = self._vertices.pop(vertex_id)
+        self._edges = [e for e in self._edges if all(u is not v for u in e.vertices)]
+
+    # -- lowering + solve ----------------------------------------------------
+    def _lower(self):
+        cams = [(i, v) for i, v in self._vertices.items() if v.kind == VertexKind.CAMERA]
+        pts = [(i, v) for i, v in self._vertices.items() if v.kind == VertexKind.POINT]
+        if not cams or not pts or not self._edges:
+            raise ValueError("problem needs cameras, points, and edges")
+        cam_rank = {id(v): r for r, (_, v) in enumerate(cams)}
+        pt_rank = {id(v): r for r, (_, v) in enumerate(pts)}
+        cameras = np.stack([v.estimation for _, v in cams])
+        points = np.stack([v.estimation for _, v in pts])
+        cam_fixed = np.array([v.fixed for _, v in cams])
+        pt_fixed = np.array([v.fixed for _, v in pts])
+        cam_idx = np.array([cam_rank[id(e.vertices[0])] for e in self._edges], np.int32)
+        pt_idx = np.array([pt_rank[id(e.vertices[1])] for e in self._edges], np.int32)
+        obs = np.stack([e.measurement for e in self._edges])
+        sqrt_info = None
+        if any(e.information is not None for e in self._edges):
+            od = obs.shape[1]
+            infos = np.stack(
+                [e.information if e.information is not None else np.eye(od) for e in self._edges]
+            )
+            # Whitening factor: info = L L^T (Cholesky), use L^T so that
+            # r~^T r~ = r^T (L L^T) r = r^T info r (WLS semantics; the
+            # reference multiplies J by the information matrix,
+            # build_linear_system.cu:148-239).
+            sqrt_info = np.transpose(np.linalg.cholesky(infos), (0, 2, 1))
+        return cameras, points, obs, cam_idx, pt_idx, cam_fixed, pt_fixed, sqrt_info, cams, pts
+
+    def solve(self, verbose: bool = False) -> LMResult:
+        opt = self.option
+        (cameras, points, obs, cam_idx, pt_idx,
+         cam_fixed, pt_fixed, sqrt_info, cams, pts) = self._lower()
+
+        dtype = np.dtype(opt.dtype)
+        cameras = cameras.astype(dtype)
+        points = points.astype(dtype)
+        obs = obs.astype(dtype)
+
+        # Jacobian engine: the built-in analytical path only applies to the
+        # untouched BAL forward; custom forwards always go through autodiff.
+        custom_forward = (
+            self._edge_type is not None
+            and self._edge_type.forward is not BaseEdge.forward
+        )
+        if custom_forward:
+            proto = self._edges[0]
+            residual_jac_fn = make_residual_jacobian_fn(
+                residual_fn=_edge_residual_fn(proto), mode=JacobianMode.AUTODIFF
+            )
+        else:
+            residual_jac_fn = make_residual_jacobian_fn(mode=opt.jacobian_mode)
+
+        cam_fixed_j = jnp.asarray(cam_fixed) if cam_fixed.any() else None
+        pt_fixed_j = jnp.asarray(pt_fixed) if pt_fixed.any() else None
+        sqrt_info_j = None if sqrt_info is None else jnp.asarray(sqrt_info.astype(dtype))
+
+        if opt.world_size > 1:
+            obs_p, cam_idx_p, pt_idx_p, mask = shard_edge_arrays(
+                obs, cam_idx, pt_idx, opt.world_size, dtype=dtype)
+            if sqrt_info_j is not None and mask.shape[0] != obs.shape[0]:
+                pad = mask.shape[0] - obs.shape[0]
+                eye = np.broadcast_to(np.eye(obs.shape[1], dtype=dtype), (pad,) + sqrt_info.shape[1:])
+                sqrt_info_j = jnp.concatenate([sqrt_info_j, jnp.asarray(eye)])
+            mesh = make_mesh(opt.world_size)
+            result = distributed_lm_solve(
+                residual_jac_fn, jnp.asarray(cameras), jnp.asarray(points),
+                jnp.asarray(obs_p), jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p),
+                jnp.asarray(mask), opt, mesh,
+                sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
+                verbose=verbose)
+        else:
+            result = jax.jit(
+                lambda c, p, o, ci, pi, m: lm_solve(
+                    residual_jac_fn, c, p, o, ci, pi, m, opt,
+                    sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j,
+                    pt_fixed=pt_fixed_j, verbose=verbose)
+            )(jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
+              jnp.asarray(cam_idx), jnp.asarray(pt_idx),
+              jnp.ones(obs.shape[0], dtype=dtype))
+
+        # Write back (reference base_problem.cpp:249-272).
+        cams_out = np.asarray(result.cameras, dtype=np.float64)
+        pts_out = np.asarray(result.points, dtype=np.float64)
+        for r, (_, v) in enumerate(cams):
+            v.estimation = cams_out[r].copy()
+        for r, (_, v) in enumerate(pts):
+            v.estimation = pts_out[r].copy()
+        self.result = result
+        return result

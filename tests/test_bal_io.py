@@ -1,0 +1,53 @@
+"""BAL file format round-trip + validation tests."""
+
+import numpy as np
+import pytest
+
+from megba_tpu.io.bal import BALFile, load_bal, loads_bal, save_bal
+from megba_tpu.io.synthetic import make_synthetic_bal
+
+
+def synthetic_file():
+    s = make_synthetic_bal(num_cameras=3, num_points=10, obs_per_point=2, seed=5)
+    return BALFile(cameras=s.cameras0, points=s.points0, obs=s.obs,
+                   cam_idx=s.cam_idx, pt_idx=s.pt_idx)
+
+
+def test_roundtrip(tmp_path):
+    bal = synthetic_file()
+    p = tmp_path / "problem.txt"
+    save_bal(p, bal)
+    got = load_bal(p)
+    np.testing.assert_array_equal(got.cam_idx, bal.cam_idx)
+    np.testing.assert_array_equal(got.pt_idx, bal.pt_idx)
+    np.testing.assert_allclose(got.obs, bal.obs, rtol=0)
+    np.testing.assert_allclose(got.cameras, bal.cameras, rtol=0)
+    np.testing.assert_allclose(got.points, bal.points, rtol=0)
+
+
+def test_parse_reference_layout():
+    # Hand-built tiny file in the exact BAL layout.
+    text = """2 2 3
+0 0 1.5 -2.5
+0 1 0.25 0.75
+1 1 -1.0 3.0
+""" + "\n".join(str(float(i)) for i in range(18)) + "\n" + "\n".join(
+        str(float(i)) for i in range(6))
+    bal = loads_bal(text)
+    assert bal.num_cameras == 2 and bal.num_points == 2 and bal.num_observations == 3
+    np.testing.assert_array_equal(bal.cam_idx, [0, 0, 1])
+    np.testing.assert_array_equal(bal.pt_idx, [0, 1, 1])
+    np.testing.assert_allclose(bal.obs[0], [1.5, -2.5])
+    np.testing.assert_allclose(bal.cameras[1], np.arange(9.0) + 9)
+    np.testing.assert_allclose(bal.points[0], [0.0, 1.0, 2.0])
+
+
+def test_truncated_file_raises():
+    with pytest.raises(ValueError, match="token count"):
+        loads_bal("2 2 3\n0 0 1.0 2.0\n")
+
+
+def test_bad_indices_raise():
+    text = "1 1 1\n0 5 1.0 2.0\n" + "\n".join(["0.0"] * 12)
+    with pytest.raises(ValueError, match="out of range"):
+        loads_bal(text)

@@ -1,0 +1,87 @@
+"""Native C++ runtime tests: build, parse parity, index builders."""
+
+import numpy as np
+import pytest
+
+from megba_tpu.io.bal import BALFile, load_bal, save_bal
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.native import (
+    degree_stats,
+    get_lib,
+    parse_bal_native,
+    partition_bounds,
+    sort_edges_by_camera,
+)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("native library unavailable (g++ build failed)")
+    return lib
+
+
+def test_native_builds(lib):
+    assert lib is not None
+
+
+def test_native_parse_matches_python(lib, tmp_path):
+    s = make_synthetic_bal(num_cameras=4, num_points=30, obs_per_point=3, seed=9)
+    bal = BALFile(cameras=s.cameras0, points=s.points0, obs=s.obs,
+                  cam_idx=s.cam_idx, pt_idx=s.pt_idx)
+    p = str(tmp_path / "prob.txt")
+    save_bal(p, bal)
+    native = parse_bal_native(p)
+    # Python fallback assembles via np.fromfile; both must agree exactly.
+    with open(p, "rb") as f:
+        tokens = np.fromfile(f, sep=" ")
+    from megba_tpu.io.bal import _assemble
+    py = _assemble(tokens, np.float64)
+    np.testing.assert_array_equal(native.cam_idx, py.cam_idx)
+    np.testing.assert_array_equal(native.pt_idx, py.pt_idx)
+    np.testing.assert_array_equal(native.obs, py.obs)
+    np.testing.assert_array_equal(native.cameras, py.cameras)
+    np.testing.assert_array_equal(native.points, py.points)
+    # And load_bal prefers the native path transparently.
+    loaded = load_bal(p)
+    np.testing.assert_array_equal(loaded.cameras, py.cameras)
+
+
+def test_native_parse_rejects_truncated(lib, tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("2 2 3\n0 0 1.0 2.0\n")
+    with pytest.raises(ValueError, match="parse failed"):
+        parse_bal_native(str(p))
+
+
+def test_sort_edges(lib):
+    rng = np.random.default_rng(0)
+    cam_idx = rng.integers(0, 50, size=1000).astype(np.int32)
+    perm = sort_edges_by_camera(cam_idx, 50)
+    expect = np.argsort(cam_idx, kind="stable")
+    np.testing.assert_array_equal(perm, expect)
+
+
+def test_degree_stats(lib):
+    cam_idx = np.array([0, 0, 1, 2, 2, 2], np.int32)
+    pt_idx = np.array([0, 1, 1, 0, 0, 2], np.int32)  # (2,0) repeated
+    cam_counts, pt_counts, (max_c, max_p, nnz) = degree_stats(cam_idx, pt_idx, 3, 3)
+    np.testing.assert_array_equal(cam_counts, [2, 1, 3])
+    np.testing.assert_array_equal(pt_counts, [3, 2, 1])
+    assert max_c == 3 and max_p == 3
+    assert nnz == 5  # (0,0),(0,1),(1,1),(2,0),(2,2)
+
+
+def test_degree_stats_unsorted_flags():
+    cam_idx = np.array([1, 0], np.int32)
+    pt_idx = np.array([0, 0], np.int32)
+    _, _, (_, _, nnz) = degree_stats(cam_idx, pt_idx, 2, 1)
+    assert nnz == -1
+
+
+def test_partition_bounds(lib):
+    b = partition_bounds(10, 4)
+    np.testing.assert_array_equal(b, [0, 3, 6, 9, 12])
+    b = partition_bounds(8, 4)
+    np.testing.assert_array_equal(b, [0, 2, 4, 6, 8])
