@@ -1,4 +1,5 @@
 from megba_tpu.ops import geo
+from megba_tpu.ops.jet import Jet, seed_jets
 from megba_tpu.ops.residuals import (
     bal_residual,
     make_residual_jacobian_fn,
@@ -6,8 +7,10 @@ from megba_tpu.ops.residuals import (
 )
 
 __all__ = [
-    "geo",
+    "Jet",
     "bal_residual",
+    "geo",
     "make_residual_fn",
     "make_residual_jacobian_fn",
+    "seed_jets",
 ]

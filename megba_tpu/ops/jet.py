@@ -1,0 +1,130 @@
+"""Vectorised forward-mode dual numbers — the JetVector-equivalent API.
+
+Functional parity with the reference's operator layer
+(include/operator/jet_vector.h:22-171, jet_vector_op-inl.h:34-91 and the
+~40 CUDA kernels of src/operator/jet_vector_math_impl.cu): a `Jet` holds
+one scalar slot of ALL edges simultaneously — `value [nItem]` and
+`grad [N, nItem]` — and supports +, -, *, / (jet/jet and jet/scalar,
+both orders), unary minus, abs, sqrt, sin, cos.
+
+Three reference jet kinds map as:
+  * full jet      -> dense `grad`
+  * JPV one-hot   -> `seed_jets` builds the one-hot rows (the memory
+    optimisation is unnecessary here: XLA fuses the seeding into
+    consumers, nothing N x nItem is materialised unless used)
+  * scalar vector -> `Jet(value, zeros)` via `constant`
+
+The production solver does NOT route through this class — `jax.jacfwd`
+under vmap subsumes it (ops/residuals.py) — but it is the public
+building block for users who port JetVector-based code, and each op is
+verified against `jax.jvp` in tests/test_jet.py.  Being a pytree, `Jet`
+composes with jit/vmap/shard_map like any array.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Scalar = Union[float, int, jax.Array]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Jet:
+    """A batch of dual numbers: value [n], grad [N, n] (grad-major like
+    the reference's SoA layout, jet_vector.h:31-41)."""
+
+    value: jax.Array
+    grad: jax.Array
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def constant(value: jax.Array, n_grad: int) -> "Jet":
+        """A jet with zero derivative (reference scalar-vector kind)."""
+        value = jnp.asarray(value)
+        return Jet(value, jnp.zeros((n_grad,) + value.shape, value.dtype))
+
+    @staticmethod
+    def variable(value: jax.Array, n_grad: int, index: int) -> "Jet":
+        """A differentiation variable: one-hot grad at `index` (the
+        reference's JPV grad-position jet, jet_vector.h:38-39)."""
+        value = jnp.asarray(value)
+        grad = jnp.zeros((n_grad,) + value.shape, value.dtype)
+        return Jet(value, grad.at[index].set(1.0))
+
+    @property
+    def n_grad(self) -> int:
+        return self.grad.shape[0]
+
+    # -- helpers -----------------------------------------------------------
+    def _coerce(self, other) -> "Jet":
+        if isinstance(other, Jet):
+            return other
+        return Jet.constant(jnp.broadcast_to(jnp.asarray(other, self.value.dtype),
+                                             self.value.shape), self.n_grad)
+
+    # -- arithmetic (value/grad rules mirror jet_vector_math_impl.cu) -----
+    def __add__(self, other) -> "Jet":
+        o = self._coerce(other)
+        return Jet(self.value + o.value, self.grad + o.grad)
+
+    __radd__ = __add__
+
+    def __sub__(self, other) -> "Jet":
+        o = self._coerce(other)
+        return Jet(self.value - o.value, self.grad - o.grad)
+
+    def __rsub__(self, other) -> "Jet":
+        o = self._coerce(other)
+        return Jet(o.value - self.value, o.grad - self.grad)
+
+    def __mul__(self, other) -> "Jet":
+        o = self._coerce(other)
+        return Jet(self.value * o.value,
+                   self.grad * o.value + o.grad * self.value)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other) -> "Jet":
+        o = self._coerce(other)
+        inv = 1.0 / o.value
+        return Jet(self.value * inv,
+                   (self.grad - o.grad * (self.value * inv)) * inv)
+
+    def __rtruediv__(self, other) -> "Jet":
+        return self._coerce(other) / self
+
+    def __neg__(self) -> "Jet":
+        return Jet(-self.value, -self.grad)
+
+    # -- unary math (reference jet_vector_math_impl.cu:1193-1320) ---------
+    def abs(self) -> "Jet":
+        sign = jnp.sign(self.value)
+        return Jet(jnp.abs(self.value), self.grad * sign)
+
+    def sqrt(self) -> "Jet":
+        root = jnp.sqrt(self.value)
+        return Jet(root, self.grad * (0.5 / root))
+
+    def sin(self) -> "Jet":
+        return Jet(jnp.sin(self.value), self.grad * jnp.cos(self.value))
+
+    def cos(self) -> "Jet":
+        return Jet(jnp.cos(self.value), -self.grad * jnp.sin(self.value))
+
+
+def seed_jets(values: Sequence[jax.Array], dtype=None) -> list:
+    """Seed one `Jet` variable per scalar slot across a parameter list.
+
+    values: list of [n] arrays (one per scalar parameter, each holding
+    that parameter for all n edges).  Returns Jets whose grads form the
+    identity — the vectorised equivalent of the reference's
+    setGradShapeAndOffset one-hot assignment (base_vertex.h:142-151).
+    """
+    n_grad = len(values)
+    return [Jet.variable(jnp.asarray(v, dtype), n_grad, i)
+            for i, v in enumerate(values)]

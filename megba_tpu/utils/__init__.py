@@ -1,0 +1,13 @@
+from megba_tpu.utils.debug import assert_all_finite, describe_array, print_blocks
+from megba_tpu.utils.timing import PhaseTimer, trace_profile
+from megba_tpu.utils.checkpoint import load_state, save_state
+
+__all__ = [
+    "PhaseTimer",
+    "assert_all_finite",
+    "describe_array",
+    "load_state",
+    "print_blocks",
+    "save_state",
+    "trace_profile",
+]

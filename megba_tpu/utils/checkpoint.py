@@ -1,0 +1,60 @@
+"""Checkpoint / resume to disk.
+
+The reference has NO on-disk checkpointing (SURVEY.md §5.4): its only
+state persistence is the in-memory backup/rollback of the LM reject step,
+which this framework replaces with functional carries.  Disk
+checkpointing is therefore a capability this framework ADDS: a long
+Final-13682-scale solve can snapshot (cameras, points, trust-region
+state) each accepted iteration and resume after preemption — the
+TPU-pod operational norm.
+
+Plain .npz is used (self-contained, no orbax directory layout needed for
+a handful of dense arrays); atomic via write-to-temp + rename.
+
+To resume with full fidelity, thread the saved trust region back in:
+`AlgoOption(initial_region=float(state["region"]))` — otherwise the
+resumed solve restarts from the default region and re-adapts (costing a
+few extra LM iterations, not correctness).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def save_state(path: str, cameras, points, *, region: float = None,
+               cost: float = None, iteration: int = None,
+               extra: Optional[Dict[str, np.ndarray]] = None) -> None:
+    """Atomically snapshot solver state to `path` (.npz)."""
+    payload = {
+        "cameras": np.asarray(cameras),
+        "points": np.asarray(points),
+    }
+    if region is not None:
+        payload["region"] = np.asarray(region)
+    if cost is not None:
+        payload["cost"] = np.asarray(cost)
+    if iteration is not None:
+        payload["iteration"] = np.asarray(iteration)
+    for k, v in (extra or {}).items():
+        payload[f"extra_{k}"] = np.asarray(v)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_state(path: str) -> Dict[str, np.ndarray]:
+    """Load a snapshot; returns dict with cameras/points (+ any extras)."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

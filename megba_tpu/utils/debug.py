@@ -1,0 +1,73 @@
+"""Debug / inspection helpers.
+
+Functional parity with the reference's debug layer (include/macro.h):
+`PRINT_DMEMORY`/`PRINT_DCSR` device-memory dumps (macro.h:14-84) become
+`describe_array`/`print_blocks` (arrays are host-visible in JAX, so these
+are formatting helpers rather than device-copy machinery), and the
+`ASSERT_CUDA_NO_ERROR` / `ASSERT_HOST_NO_MEM_ERROR` macros (macro.h:49-95)
+map to `assert_all_finite`, the failure mode a functional pipeline can
+actually hit (NaN/Inf poisoning).  Like the reference's DEBUG-gating
+(macro.h:96-108), `assert_all_finite` is a no-op inside jit unless
+`debug=True` wires it through `jax.debug.callback`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def describe_array(name: str, x: Any, max_items: int = 8) -> str:
+    """One-line summary: shape, dtype, range, norm, first items."""
+    a = np.asarray(x)
+    if a.size == 0:
+        return f"{name}: shape={a.shape} (empty)"
+    flat = a.reshape(-1)
+    head = ", ".join(f"{v:.5g}" for v in flat[:max_items])
+    finite = np.isfinite(flat)
+    extra = "" if finite.all() else f" NONFINITE={int((~finite).sum())}"
+    return (
+        f"{name}: shape={a.shape} dtype={a.dtype} "
+        f"min={flat.min():.5g} max={flat.max():.5g} "
+        f"|x|={np.linalg.norm(flat):.5g}{extra} [{head}{', ...' if flat.size > max_items else ''}]"
+    )
+
+
+def print_blocks(name: str, blocks: Any, indices: Optional[range] = None) -> None:
+    """Pretty-print a few [N, d, d] Hessian blocks (PRINT_DCSR's role of
+    eyeballing assembled system content, macro.h:61-84)."""
+    b = np.asarray(blocks)
+    indices = indices if indices is not None else range(min(2, b.shape[0]))
+    print(f"{name}: {b.shape[0]} blocks of {b.shape[1]}x{b.shape[2]}")
+    for i in indices:
+        with np.printoptions(precision=4, suppress=True):
+            print(f"  block[{i}] =\n{np.asarray(b[i])}")
+
+
+def assert_all_finite(x: jax.Array, name: str = "array", debug: bool = False) -> jax.Array:
+    """Identity passthrough that raises if x contains non-finite values.
+
+    Outside jit: checks eagerly.  Inside jit: DEBUG-gated like the
+    reference's macros (macro.h:96-108) — a no-op unless `debug=True`,
+    in which case a host callback raises FloatingPointError at the
+    poisoning site (silent on clean values).
+    """
+    if isinstance(x, jax.core.Tracer):
+        if debug:
+            def _check(bad_count):
+                if int(bad_count) > 0:
+                    raise FloatingPointError(
+                        f"{name} contains {int(bad_count)} non-finite values"
+                    )
+
+            jax.debug.callback(_check, jnp.sum(~jnp.isfinite(x)))
+        return x
+    a = np.asarray(x)
+    if not np.isfinite(a).all():
+        raise FloatingPointError(
+            f"{name} contains {int((~np.isfinite(a)).sum())} non-finite values"
+        )
+    return x

@@ -1,0 +1,77 @@
+"""Timing / profiling hooks.
+
+The reference's only observability is wall-clock deltas printed per LM
+iteration (lm_algo.cu:141,157-161,215-219).  Here: `PhaseTimer` collects
+named phase timings (block_until_ready-accurate), and `trace_profile`
+wraps a block in a `jax.profiler` trace for TensorBoard/Perfetto — the
+TPU-native upgrade path (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+class _Phase:
+    """Handle yielded by PhaseTimer.phase; register outputs to sync on."""
+
+    def __init__(self):
+        self._targets = []
+
+    def sync(self, x):
+        """Mark `x` (array/pytree produced inside the block) to be
+        block_until_ready'd before the phase's clock stops; returns x."""
+        self._targets.append(x)
+        return x
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase; device-sync aware.
+
+    JAX dispatch is asynchronous, so an un-synced phase measures only
+    dispatch time.  Register the block's outputs on the yielded handle:
+
+        with timer.phase("pcg") as ph:
+            out = ph.sync(pcg_solve(...))
+    """
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        handle = _Phase()
+        t0 = time.perf_counter()
+        try:
+            yield handle
+        finally:
+            for t in handle._targets:
+                jax.block_until_ready(t)
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        lines = []
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            t, c = self.totals[name], self.counts[name]
+            lines.append(f"{name}: {t * 1e3:.1f} ms total / {c} calls = {t / c * 1e3:.2f} ms")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace_profile(logdir: Optional[str]):
+    """jax.profiler trace context; no-op when logdir is None."""
+    if logdir is None:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

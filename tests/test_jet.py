@@ -1,0 +1,95 @@
+"""Jet (dual-number) op tests vs jax.jvp (SURVEY.md §4a)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.ops.jet import Jet, seed_jets
+
+
+def jvp_grad(f, xs):
+    """Full Jacobian rows of elementwise f via jax.jvp, for comparison."""
+    n = len(xs)
+    outs = []
+    for i in range(n):
+        tangents = [jnp.ones_like(x) if j == i else jnp.zeros_like(x)
+                    for j, x in enumerate(xs)]
+        _, g = jax.jvp(f, (xs,), (tangents,))
+        outs.append(g)
+    return jnp.stack(outs)
+
+
+@pytest.mark.parametrize("op", ["add", "sub", "mul", "div"])
+def test_binary_ops_match_jvp(op):
+    r = np.random.default_rng(0)
+    a = jnp.asarray(r.normal(size=32) + 3.0)
+    b = jnp.asarray(r.normal(size=32) + 3.0)
+    ja, jb = seed_jets([a, b])
+
+    def f(xs):
+        x, y = xs
+        return {"add": x + y, "sub": x - y, "mul": x * y, "div": x / y}[op]
+
+    got = {"add": ja + jb, "sub": ja - jb, "mul": ja * jb, "div": ja / jb}[op]
+    np.testing.assert_allclose(got.value, f([a, b]), rtol=1e-12)
+    np.testing.assert_allclose(got.grad, jvp_grad(f, [a, b]), rtol=1e-12)
+
+
+def test_scalar_both_orders():
+    a = jnp.asarray([1.0, 2.0, 4.0])
+    (j,) = seed_jets([a])
+    np.testing.assert_allclose((2.0 - j).value, 2.0 - a)
+    np.testing.assert_allclose((2.0 - j).grad[0], -np.ones(3))
+    np.testing.assert_allclose((3.0 / j).value, 3.0 / a)
+    np.testing.assert_allclose((3.0 / j).grad[0], -3.0 / a**2)
+    np.testing.assert_allclose((j * 5.0).grad[0], 5.0 * np.ones(3))
+    np.testing.assert_allclose((-j).grad[0], -np.ones(3))
+
+
+@pytest.mark.parametrize("name", ["abs", "sqrt", "sin", "cos"])
+def test_unary_ops_match_jvp(name):
+    r = np.random.default_rng(1)
+    a = jnp.asarray(np.abs(r.normal(size=16)) + 0.5)
+    if name == "abs":
+        a = a * jnp.asarray(r.choice([-1.0, 1.0], size=16))
+    (j,) = seed_jets([a])
+    got = getattr(j, name)()
+    f = {"abs": jnp.abs, "sqrt": jnp.sqrt, "sin": jnp.sin, "cos": jnp.cos}[name]
+    np.testing.assert_allclose(got.value, f(a), rtol=1e-12)
+    np.testing.assert_allclose(got.grad, jvp_grad(lambda xs: f(xs[0]), [a]),
+                               rtol=1e-12)
+
+
+def test_composite_expression_matches_jacfwd():
+    # A BAL-flavoured composite: f*(1 + k*n)*x / z built from Jet ops must
+    # reproduce jacfwd column-for-column.
+    r = np.random.default_rng(2)
+    x, z, f, k = (jnp.asarray(r.normal(size=8) + 2.0) for _ in range(4))
+    jx, jz, jf, jk = seed_jets([x, z, f, k])
+    n = jx * jx
+    expr = jf * (1.0 + jk * n) * jx / jz
+
+    def ref(args):
+        x, z, f, k = args
+        return f * (1.0 + k * x * x) * x / z
+
+    np.testing.assert_allclose(expr.value, ref([x, z, f, k]), rtol=1e-12)
+    np.testing.assert_allclose(expr.grad, jvp_grad(ref, [x, z, f, k]), rtol=1e-12)
+
+
+def test_jet_is_jit_and_vmap_compatible():
+    a = jnp.arange(1.0, 9.0)
+
+    @jax.jit
+    def run(a):
+        (j,) = seed_jets([a])
+        return (j * j + 3.0).sqrt().value
+
+    np.testing.assert_allclose(run(a), np.sqrt(a**2 + 3.0), rtol=1e-12)
+
+
+def test_constant_has_zero_grad():
+    c = Jet.constant(jnp.ones(4), n_grad=3)
+    assert c.grad.shape == (3, 4)
+    np.testing.assert_array_equal(c.grad, 0.0)

@@ -1,0 +1,89 @@
+"""Utils tests: debug helpers, timers, checkpoint round-trip."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.utils import (
+    PhaseTimer,
+    assert_all_finite,
+    describe_array,
+    load_state,
+    print_blocks,
+    save_state,
+    trace_profile,
+)
+
+
+def test_describe_array():
+    s = describe_array("x", np.array([1.0, 2.0, np.inf]))
+    assert "NONFINITE=1" in s and "shape=(3,)" in s
+    assert "empty" in describe_array("e", np.zeros((0, 3)))
+
+
+def test_assert_all_finite():
+    assert_all_finite(jnp.ones(3), "ok")
+    with pytest.raises(FloatingPointError, match="bad"):
+        assert_all_finite(jnp.asarray([1.0, np.nan]), "bad")
+
+
+def test_assert_all_finite_under_jit():
+    import jax
+
+    @jax.jit
+    def f(x):
+        return assert_all_finite(x * 2, "traced")
+
+    np.testing.assert_allclose(f(jnp.ones(3)), 2.0)
+
+
+def test_assert_all_finite_debug_raises_in_jit():
+    import jax
+
+    @jax.jit
+    def f(x):
+        return assert_all_finite(x / x, "traced-debug", debug=True)
+
+    np.testing.assert_allclose(f(jnp.ones(3)), 1.0)  # clean: silent
+    with pytest.raises((FloatingPointError, Exception)):
+        jax.block_until_ready(f(jnp.zeros(3)))  # 0/0 -> NaN -> raise
+
+
+def test_print_blocks(capsys):
+    print_blocks("Hpp", np.eye(3)[None].repeat(4, 0))
+    out = capsys.readouterr().out
+    assert "4 blocks of 3x3" in out
+
+
+def test_phase_timer():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b") as ph:
+        out = ph.sync(jnp.ones(2) * 2)  # produced INSIDE the block
+    np.testing.assert_allclose(out, 2.0)
+    assert t.counts["a"] == 2 and t.counts["b"] == 1
+    assert "a:" in t.report()
+
+
+def test_trace_profile_noop():
+    with trace_profile(None):
+        pass
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    p = str(tmp_path / "ck.npz")
+    save_state(p, np.ones((2, 9)), np.zeros((3, 3)), region=10.0, cost=5.5,
+               iteration=7, extra={"v": np.arange(3)})
+    got = load_state(p)
+    np.testing.assert_array_equal(got["cameras"], np.ones((2, 9)))
+    np.testing.assert_array_equal(got["points"], np.zeros((3, 3)))
+    assert float(got["region"]) == 10.0 and int(got["iteration"]) == 7
+    np.testing.assert_array_equal(got["extra_v"], np.arange(3))
+    # Overwrite is atomic (no stray tmp files).
+    save_state(p, np.zeros((2, 9)), np.ones((3, 3)))
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
