@@ -77,8 +77,12 @@ def main() -> None:
         jnp.ones(n_edge, dtype=dtype),
     )
 
+    from megba_tpu.core.types import is_cam_sorted
+
+    cam_sorted = is_cam_sorted(s.cam_idx)
     solve = jax.jit(
-        lambda cams, pts, obs, ci, pi, m: lm_solve(f, cams, pts, obs, ci, pi, m, option)
+        lambda cams, pts, obs, ci, pi, m: lm_solve(
+            f, cams, pts, obs, ci, pi, m, option, cam_sorted=cam_sorted)
     )
 
     # Warmup (compile) — not timed.

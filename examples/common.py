@@ -84,6 +84,8 @@ def run_example(dtype, jacobian_mode, compute_kind, argv=None) -> float:
         f"jacobian={jacobian_mode.name} compute={compute_kind.name} "
         f"world_size={args.world_size}")
 
+    from megba_tpu.core.types import is_cam_sorted
+    cam_sorted = is_cam_sorted(cam_idx)
     t0 = time.perf_counter()
     if args.world_size > 1:
         obs_p, cam_idx_p, pt_idx_p, mask = shard_edge_arrays(
@@ -92,12 +94,13 @@ def run_example(dtype, jacobian_mode, compute_kind, argv=None) -> float:
         result = distributed_lm_solve(
             f, jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs_p),
             jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p), jnp.asarray(mask),
-            option, mesh, verbose=True)
+            option, mesh, verbose=True, cam_sorted=cam_sorted)
     else:
         result = lm_solve(
             f, jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
             jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-            jnp.ones(obs.shape[0], dtype=dtype), option, verbose=True)
+            jnp.ones(obs.shape[0], dtype=dtype), option, verbose=True,
+            cam_sorted=cam_sorted)
     cost = float(result.cost)
     elapsed = time.perf_counter() - t0
     print(

@@ -64,6 +64,7 @@ def lm_solve(
     pt_fixed: Optional[jax.Array] = None,
     axis_name: Optional[str] = None,
     verbose: bool = False,
+    cam_sorted: bool = False,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -89,7 +90,7 @@ def lm_solve(
         system = build_schur_system(
             r, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
             compute_kind=compute_kind, axis_name=axis_name,
-            cam_fixed=cam_fixed, pt_fixed=pt_fixed)
+            cam_fixed=cam_fixed, pt_fixed=pt_fixed, cam_sorted=cam_sorted)
         return r, Jc, Jp, system
 
     r0, Jc0, Jp0, system0 = linearize(cameras, points)
@@ -120,7 +121,7 @@ def lm_solve(
             max_iter=solver_opt.max_iter, tol=solver_opt.tol,
             refuse_ratio=solver_opt.refuse_ratio,
             compute_kind=compute_kind, axis_name=axis_name,
-            mixed_precision=option.mixed_precision_pcg)
+            mixed_precision=option.mixed_precision_pcg, cam_sorted=cam_sorted)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply

@@ -88,6 +88,12 @@ class BAState:
     points: jax.Array
 
 
+def is_cam_sorted(cam_idx: np.ndarray) -> bool:
+    """True when edges are ordered by nondecreasing camera index — the
+    promise behind `indices_are_sorted` in the Hessian scatter-reduces."""
+    return bool(np.all(np.diff(cam_idx) >= 0))
+
+
 def pad_edges(
     obs: np.ndarray,
     cam_idx: np.ndarray,
@@ -97,10 +103,13 @@ def pad_edges(
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pad the edge axis to a multiple of `multiple` with masked-out edges.
 
-    Padding edges point at index 0 with weight 0 so gathers stay in bounds
-    and segment_sums contribute nothing.  This replaces the reference's
-    uneven remainder shard (MemoryPool::getItemNum, memory_pool.h:48-63)
-    with the static equal shapes XLA sharding requires.
+    Padding edges repeat the LAST edge's vertex indices with weight 0 so
+    gathers stay in bounds, segment_sums contribute nothing, and a
+    camera-sorted edge order STAYS sorted (which lets the Hessian
+    scatter-reduces use `indices_are_sorted`).  This replaces the
+    reference's uneven remainder shard (MemoryPool::getItemNum,
+    memory_pool.h:48-63) with the static equal shapes XLA sharding
+    requires.
     """
     n = obs.shape[0]
     n_pad = (-n) % multiple
@@ -108,6 +117,6 @@ def pad_edges(
     if n_pad:
         mask[n:] = 0.0
         obs = np.concatenate([obs, np.zeros((n_pad,) + obs.shape[1:], obs.dtype)])
-        cam_idx = np.concatenate([cam_idx, np.zeros(n_pad, cam_idx.dtype)])
-        pt_idx = np.concatenate([pt_idx, np.zeros(n_pad, pt_idx.dtype)])
+        cam_idx = np.concatenate([cam_idx, np.full(n_pad, cam_idx[-1] if n else 0, cam_idx.dtype)])
+        pt_idx = np.concatenate([pt_idx, np.full(n_pad, pt_idx[-1] if n else 0, pt_idx.dtype)])
     return obs, cam_idx, pt_idx, mask

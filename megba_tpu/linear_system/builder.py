@@ -106,8 +106,13 @@ def build_schur_system(
     axis_name: Optional[str] = None,
     cam_fixed: Optional[jax.Array] = None,
     pt_fixed: Optional[jax.Array] = None,
+    cam_sorted: bool = False,
 ) -> SchurSystem:
     """Assemble the Schur-form normal equations from per-edge Jacobians.
+
+    `cam_sorted=True` asserts edges are ordered by cam_idx (BAL files are;
+    BaseProblem sorts at lowering) — the camera-side scatter-reduces then
+    run as sorted segment reductions, the cheap path on TPU.
 
     Args:
       r: [nE, od] residuals, Jc: [nE, od, cd], Jp: [nE, od, pd] — all
@@ -128,9 +133,11 @@ def build_schur_system(
     g_cam_e = -jnp.einsum("eoi,eo->ei", Jc, r, precision=HI)
     g_pt_e = -jnp.einsum("eoi,eo->ei", Jp, r, precision=HI)
 
-    Hpp = jax.ops.segment_sum(hpp_e, cam_idx, num_segments=num_cameras)
+    Hpp = jax.ops.segment_sum(hpp_e, cam_idx, num_segments=num_cameras,
+                              indices_are_sorted=cam_sorted)
     Hll = jax.ops.segment_sum(hll_e, pt_idx, num_segments=num_points)
-    g_cam = jax.ops.segment_sum(g_cam_e, cam_idx, num_segments=num_cameras)
+    g_cam = jax.ops.segment_sum(g_cam_e, cam_idx, num_segments=num_cameras,
+                                indices_are_sorted=cam_sorted)
     g_pt = jax.ops.segment_sum(g_pt_e, pt_idx, num_segments=num_points)
 
     if axis_name is not None:

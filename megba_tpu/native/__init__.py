@@ -148,7 +148,9 @@ def degree_stats(cam_idx: np.ndarray, pt_idx: np.ndarray, num_cameras: int,
     if lib is None:
         cam_counts = np.bincount(cam_idx, minlength=num_cameras).astype(np.int64)
         pt_counts = np.bincount(pt_idx, minlength=num_points).astype(np.int64)
-        sorted_ = bool(np.all(np.diff(cam_idx) >= 0))
+        from megba_tpu.core.types import is_cam_sorted
+
+        sorted_ = is_cam_sorted(cam_idx)
         nnz = int(len(set(zip(cam_idx.tolist(), pt_idx.tolist())))) if sorted_ else -1
         return cam_counts, pt_counts, (int(cam_counts.max(initial=0)),
                                        int(pt_counts.max(initial=0)), nnz)

@@ -101,6 +101,7 @@ def distributed_lm_solve(
     cam_fixed: Optional[jax.Array] = None,
     pt_fixed: Optional[jax.Array] = None,
     verbose: bool = False,
+    cam_sorted: bool = False,
 ) -> LMResult:
     """Run the full LM solve SPMD over the mesh's edge axis.
 
@@ -135,14 +136,14 @@ def distributed_lm_solve(
     in_specs += [spec for _, v, spec in optional if v is not None]
 
     jitted = _cached_sharded_solve(
-        residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose)
+        residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose, cam_sorted)
 
     with jax.default_device(mesh.devices.flat[0]):
         return jitted(*args)
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose):
+def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose, cam_sorted=False):
     """Build-and-cache the jitted shard_map'ed solve.
 
     jax.jit caches by callable identity, so rebuilding the closure every
@@ -155,7 +156,7 @@ def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
-            option, axis_name=EDGE_AXIS, verbose=verbose,
+            option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
             **dict(zip(keys, extras)))
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())

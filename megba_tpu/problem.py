@@ -232,6 +232,21 @@ class BaseProblem:
         points = points.astype(dtype)
         obs = obs.astype(dtype)
 
+        # Order edges by camera (native counting sort): the camera-side
+        # Hessian scatter-reduces then run as sorted segment sums, and
+        # shard slices keep spatial locality.  Edge order is otherwise
+        # irrelevant to the math.
+        from megba_tpu.native import sort_edges_by_camera
+
+        from megba_tpu.core.types import is_cam_sorted
+
+        if not is_cam_sorted(cam_idx):
+            perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
+            cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+            if sqrt_info is not None:
+                sqrt_info = sqrt_info[perm]
+        cam_sorted = True
+
         # Jacobian engine: the built-in analytical path only applies to the
         # untouched BAL forward; custom forwards always go through autodiff.
         custom_forward = (
@@ -263,13 +278,14 @@ class BaseProblem:
                 jnp.asarray(obs_p), jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p),
                 jnp.asarray(mask), opt, mesh,
                 sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
-                verbose=verbose)
+                verbose=verbose, cam_sorted=cam_sorted)
         else:
             result = jax.jit(
                 lambda c, p, o, ci, pi, m: lm_solve(
                     residual_jac_fn, c, p, o, ci, pi, m, opt,
                     sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j,
-                    pt_fixed=pt_fixed_j, verbose=verbose)
+                    pt_fixed=pt_fixed_j, verbose=verbose,
+                    cam_sorted=cam_sorted)
             )(jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
               jnp.asarray(cam_idx), jnp.asarray(pt_idx),
               jnp.ones(obs.shape[0], dtype=dtype))

@@ -31,6 +31,16 @@ def build_test_system(seed=0, num_cameras=3, num_points=12, compute_kind=Compute
     return system, r, Jc, Jp, cam_idx, pt_idx
 
 
+@pytest.mark.parametrize("d", [1, 2, 3, 9])
+def test_block_inv_matches_numpy(d):
+    from megba_tpu.solver import block_inv
+    r = np.random.default_rng(0)
+    A = r.normal(size=(7, d, d))
+    spd = A @ A.transpose(0, 2, 1) + 3.0 * np.eye(d)  # damped-SPD-like
+    got = block_inv(jnp.asarray(spd))
+    np.testing.assert_allclose(got, np.linalg.inv(spd), rtol=1e-9, atol=1e-11)
+
+
 def test_hessian_blocks_match_dense_assembly():
     system, r, Jc, Jp, cam_idx, pt_idx = build_test_system()
     # Assemble J^T J brute-force per camera from the edge list.
