@@ -32,6 +32,51 @@ LM_ITERS = 8
 PCG_ITERS = 30
 
 
+def _probe_pallas(cam_idx):
+    """Decide whether to route the Hessian build through the Pallas kernel.
+
+    MEGBA_BENCH_PALLAS=0 disables, =1 forces; default 'auto' enables only
+    if the plan is feasible AND the kernel actually compiles+matches on a
+    small input on this backend (so an unexpected Mosaic lowering failure
+    degrades to the XLA path instead of killing the benchmark).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from megba_tpu.ops.pallas_kernels import camera_hessian_gradient, camera_window_plan
+
+    mode = os.environ.get("MEGBA_BENCH_PALLAS", "auto")
+    if mode == "0":
+        return None
+    ok, window = camera_window_plan(cam_idx)
+    if not ok:
+        return None
+    plan = (512, window)
+    if mode == "1":
+        return plan
+    if jax.default_backend() != "tpu":
+        # Off-TPU the kernel runs in interpret mode — correct but slow;
+        # only the real TPU lowering is a performance win.
+        return None
+    try:
+        n, cd, od = 1024, 9, 2
+        jc = jnp.ones((n, od, cd), jnp.float32)
+        r = jnp.ones((n, od), jnp.float32)
+        ci = jnp.asarray(np.repeat(np.arange(8), n // 8), jnp.int32)
+        hpp, g = camera_hessian_gradient(
+            jc, r, ci, num_cameras=8, tile=512, window=window,
+            interpret=False)  # probe only runs on the TPU backend
+        expect = float(n // 8 * od)
+        assert abs(float(hpp[0, 0, 0]) - expect) < 1e-2
+        return plan
+    except Exception as e:  # pragma: no cover - backend specific
+        import sys
+
+        print(f"pallas probe failed ({type(e).__name__}); using XLA path",
+              file=sys.stderr, flush=True)
+        return None
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -80,9 +125,11 @@ def main() -> None:
     from megba_tpu.core.types import is_cam_sorted
 
     cam_sorted = is_cam_sorted(s.cam_idx)
+    pallas_plan = _probe_pallas(s.cam_idx) if cam_sorted else None
     solve = jax.jit(
         lambda cams, pts, obs, ci, pi, m: lm_solve(
-            f, cams, pts, obs, ci, pi, m, option, cam_sorted=cam_sorted)
+            f, cams, pts, obs, ci, pi, m, option, cam_sorted=cam_sorted,
+            pallas_plan=pallas_plan)
     )
 
     # Warmup (compile) — not timed.

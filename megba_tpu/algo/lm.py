@@ -65,6 +65,7 @@ def lm_solve(
     axis_name: Optional[str] = None,
     verbose: bool = False,
     cam_sorted: bool = False,
+    pallas_plan=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -90,7 +91,8 @@ def lm_solve(
         system = build_schur_system(
             r, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
             compute_kind=compute_kind, axis_name=axis_name,
-            cam_fixed=cam_fixed, pt_fixed=pt_fixed, cam_sorted=cam_sorted)
+            cam_fixed=cam_fixed, pt_fixed=pt_fixed, cam_sorted=cam_sorted,
+            pallas_plan=pallas_plan)
         return r, Jc, Jp, system
 
     r0, Jc0, Jp0, system0 = linearize(cameras, points)

@@ -107,12 +107,18 @@ def build_schur_system(
     cam_fixed: Optional[jax.Array] = None,
     pt_fixed: Optional[jax.Array] = None,
     cam_sorted: bool = False,
+    pallas_plan: Optional[Tuple[int, int]] = None,
 ) -> SchurSystem:
     """Assemble the Schur-form normal equations from per-edge Jacobians.
 
     `cam_sorted=True` asserts edges are ordered by cam_idx (BAL files are;
     BaseProblem sorts at lowering) — the camera-side scatter-reduces then
     run as sorted segment reductions, the cheap path on TPU.
+
+    `pallas_plan=(tile, window)` (requires cam_sorted) routes the
+    camera-side build through the fused Pallas kernel
+    (ops/pallas_kernels.py) instead of materialising per-edge outer
+    products; obtain the plan from `camera_window_plan` host-side.
 
     Args:
       r: [nE, od] residuals, Jc: [nE, od, cd], Jp: [nE, od, pd] — all
@@ -128,16 +134,31 @@ def build_schur_system(
     # Per-edge outer products, then scatter-reduce by vertex — the
     # race-free functional form of the reference's atomicAdd makeHpp /
     # makeHll (build_linear_system.cu:116-134).
-    hpp_e = jnp.einsum("eoi,eoj->eij", Jc, Jc, precision=HI)
-    hll_e = jnp.einsum("eoi,eoj->eij", Jp, Jp, precision=HI)
-    g_cam_e = -jnp.einsum("eoi,eo->ei", Jc, r, precision=HI)
-    g_pt_e = -jnp.einsum("eoi,eo->ei", Jp, r, precision=HI)
+    if pallas_plan is not None:
+        from megba_tpu.ops.pallas_kernels import camera_hessian_gradient
 
-    Hpp = jax.ops.segment_sum(hpp_e, cam_idx, num_segments=num_cameras,
-                              indices_are_sorted=cam_sorted)
+        if r.dtype != jnp.float32:
+            # The kernel accumulates in float32; silently downgrading a
+            # float64 build would corrupt the double-precision pipeline.
+            raise ValueError(
+                f"pallas_plan requires float32 inputs, got {r.dtype}; "
+                "use the XLA path (pallas_plan=None) for other dtypes"
+            )
+        tile, window = pallas_plan
+        Hpp, g_cam = camera_hessian_gradient(
+            Jc, r, cam_idx, num_cameras=num_cameras, tile=tile,
+            window=window, interpret=jax.default_backend() != "tpu")
+    else:
+        hpp_e = jnp.einsum("eoi,eoj->eij", Jc, Jc, precision=HI)
+        g_cam_e = -jnp.einsum("eoi,eo->ei", Jc, r, precision=HI)
+        Hpp = jax.ops.segment_sum(hpp_e, cam_idx, num_segments=num_cameras,
+                                  indices_are_sorted=cam_sorted)
+        g_cam = jax.ops.segment_sum(g_cam_e, cam_idx, num_segments=num_cameras,
+                                    indices_are_sorted=cam_sorted)
+
+    hll_e = jnp.einsum("eoi,eoj->eij", Jp, Jp, precision=HI)
+    g_pt_e = -jnp.einsum("eoi,eo->ei", Jp, r, precision=HI)
     Hll = jax.ops.segment_sum(hll_e, pt_idx, num_segments=num_points)
-    g_cam = jax.ops.segment_sum(g_cam_e, cam_idx, num_segments=num_cameras,
-                                indices_are_sorted=cam_sorted)
     g_pt = jax.ops.segment_sum(g_pt_e, pt_idx, num_segments=num_points)
 
     if axis_name is not None:

@@ -1,0 +1,160 @@
+"""Pallas TPU kernels for the Hessian-assembly hot path.
+
+The fusion the reference gets from its hand-written `makeHSchur` CUDA
+kernel (src/edge/build_linear_system.cu:88-146 — one pass over the
+Jacobians, accumulating Hpp and g in shared memory/atomics), rebuilt for
+the TPU memory hierarchy: the XLA path materialises the per-edge outer
+products `hpp_e [nE,9,9]` in HBM (~728 B/edge of traffic for Hpp at
+float32: write + re-read + the Jacobian read); this kernel computes them
+in VMEM and reduces tile-locally, so HBM sees only the Jacobian/residual
+read (~80 B/edge) plus a tiny per-tile partial buffer.
+
+Layout exploited: edges are camera-sorted (BaseProblem lowering
+guarantees it), so each tile of `tile` edges touches a narrow window of
+consecutive cameras.  Each grid step emits its window's partial sums
+`[window, cd*cd + cd]`; a cheap XLA scatter-add combines the
+`[n_tiles, window, ...]` partials (a few MB) into the final blocks.
+
+The camera window start per tile is just `cam_idx[i*tile]` — data-
+dependent, delivered via `PrefetchScalarGridSpec` scalar prefetch.
+Feasibility (every tile spans < window cameras) is a static property of
+the problem topology; `camera_window_plan` checks it host-side at
+lowering.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_TILE = 512
+DEFAULT_WINDOW = 16
+
+
+def camera_window_plan(
+    cam_idx: np.ndarray, tile: int = DEFAULT_TILE, max_window: int = 64
+) -> Tuple[bool, int]:
+    """Host-side static check: (feasible, window) for this topology.
+
+    A tile of `tile` consecutive camera-sorted edges spans
+    `cam_idx[end] - cam_idx[start] + 1` cameras; the kernel needs that
+    bounded by a compile-time window.  The check slides over EVERY
+    possible tile offset (not just multiples of `tile`), so the plan
+    stays valid for any shard boundary when the edge axis is split by
+    shard_map.  Returns the smallest power-of-two window covering the
+    worst tile (min DEFAULT_WINDOW), or (False, 0) when it would exceed
+    `max_window` — the kernel statically unrolls the window loop, so
+    large windows mean huge programs; fall back to the XLA path instead.
+    """
+    n = len(cam_idx)
+    if n == 0:
+        return False, 0
+    cam_idx = np.asarray(cam_idx)
+    if n <= tile:
+        span = int(cam_idx[-1] - cam_idx[0] + 1)
+    else:
+        span = int(np.max(cam_idx[tile - 1 :] - cam_idx[: n - tile + 1]) + 1)
+    window = DEFAULT_WINDOW
+    while window < span:
+        window *= 2
+    return (window <= max_window), window
+
+
+def _hessian_cam_kernel(starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, window, tile, cd, od):
+    """One tile: partial (Hpp, g) sums for `window` consecutive cameras.
+
+    out_ref block: [1, window, cd*cd + cd] — H flattened then g.
+    """
+    i = pl.program_id(0)
+    base = starts_ref[i]
+    local = cam_idx_ref[:, 0] - base  # [tile] ints in [0, window) by plan
+
+    for w in range(window):  # static unroll: window small (16-64)
+        oh = (local == w).astype(jc_ref.dtype)[:, None]  # [tile, 1]
+        acc_h = jnp.zeros((cd, cd), dtype=jnp.float32)
+        acc_g = jnp.zeros((cd,), dtype=jnp.float32)
+        for o in range(od):  # residual components (BAL: 2)
+            jo = jc_ref[:, o * cd : (o + 1) * cd]  # [tile, cd]
+            jom = jo * oh
+            acc_h = acc_h + jax.lax.dot_general(
+                jom, jo, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ro = r_ref[:, o : o + 1]  # [tile, 1]
+            acc_g = acc_g - jax.lax.dot_general(
+                jom, ro, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)[:, 0]
+        out_ref[0, w, 0 : cd * cd] = acc_h.reshape(cd * cd).astype(out_ref.dtype)
+        out_ref[0, w, cd * cd : cd * cd + cd] = acc_g.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_cameras", "tile", "window", "interpret"),
+)
+def camera_hessian_gradient(
+    Jc: jax.Array,
+    r: jax.Array,
+    cam_idx: jax.Array,
+    num_cameras: int,
+    tile: int = DEFAULT_TILE,
+    window: int = DEFAULT_WINDOW,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused camera-side Hessian diagonal + gradient.
+
+    Jc: [nE, od, cd] weighted camera Jacobians (camera-sorted edges),
+    r: [nE, od] weighted residuals, cam_idx: [nE] int32 nondecreasing.
+    Returns (Hpp [num_cameras, cd, cd], g_cam [num_cameras, cd]) equal to
+    the segment_sum path up to float addition order.
+    """
+    nE, od, cd = Jc.shape
+    dtype = Jc.dtype
+
+    # Pad edge axis to a tile multiple with inert rows (zero J/r; camera
+    # index repeats the last edge so tiles stay sorted).
+    n_pad = (-nE) % tile
+    if n_pad:
+        Jc = jnp.concatenate([Jc, jnp.zeros((n_pad, od, cd), dtype)])
+        r = jnp.concatenate([r, jnp.zeros((n_pad, od), dtype)])
+        cam_idx = jnp.concatenate([cam_idx, jnp.broadcast_to(cam_idx[-1], (n_pad,))])
+    n_tiles = Jc.shape[0] // tile
+
+    jc_flat = Jc.reshape(Jc.shape[0], od * cd)
+    starts = cam_idx[:: tile].astype(jnp.int32)  # [n_tiles]
+    feat = cd * cd + cd
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, 1), lambda i, s: (i, 0)),
+            pl.BlockSpec((tile, od * cd), lambda i, s: (i, 0)),
+            pl.BlockSpec((tile, od), lambda i, s: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, window, feat), lambda i, s: (i, 0, 0)),
+    )
+
+    partials = pl.pallas_call(
+        functools.partial(
+            _hessian_cam_kernel, window=window, tile=tile, cd=cd, od=od),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles, window, feat), dtype),
+        interpret=interpret,
+    )(starts, cam_idx[:, None].astype(jnp.int32), jc_flat, r)
+
+    # Combine: scatter-add each tile's window into the (padded) camera
+    # axis.  [n_tiles, window, feat] is tiny next to the per-edge outer
+    # products the XLA path would materialise.
+    cam_targets = starts[:, None] + jnp.arange(window)[None, :]  # [n_tiles, window]
+    out = jnp.zeros((num_cameras + window, feat), dtype)
+    out = out.at[cam_targets.reshape(-1)].add(partials.reshape(-1, feat))
+    out = out[:num_cameras]
+    Hpp = out[:, : cd * cd].reshape(num_cameras, cd, cd)
+    g = out[:, cd * cd :]
+    return Hpp, g

@@ -102,6 +102,7 @@ def distributed_lm_solve(
     pt_fixed: Optional[jax.Array] = None,
     verbose: bool = False,
     cam_sorted: bool = False,
+    pallas_plan=None,
 ) -> LMResult:
     """Run the full LM solve SPMD over the mesh's edge axis.
 
@@ -136,14 +137,16 @@ def distributed_lm_solve(
     in_specs += [spec for _, v, spec in optional if v is not None]
 
     jitted = _cached_sharded_solve(
-        residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose, cam_sorted)
+        residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
+        cam_sorted, pallas_plan)
 
     with jax.default_device(mesh.devices.flat[0]):
         return jitted(*args)
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose, cam_sorted=False):
+def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
+                          cam_sorted=False, pallas_plan=None):
     """Build-and-cache the jitted shard_map'ed solve.
 
     jax.jit caches by callable identity, so rebuilding the closure every
@@ -157,7 +160,7 @@ def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
-            **dict(zip(keys, extras)))
+            pallas_plan=pallas_plan, **dict(zip(keys, extras)))
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
     return jax.jit(sharded)
