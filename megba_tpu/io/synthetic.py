@@ -27,24 +27,23 @@ class SyntheticBAL:
     pt_idx: np.ndarray  # [nE] int32
 
 
-def _project(camera: np.ndarray, point: np.ndarray) -> np.ndarray:
-    """NumPy twin of ops.residuals.bal_residual's projection (one edge)."""
-    w, t = camera[0:3], camera[3:6]
-    f, k1, k2 = camera[6], camera[7], camera[8]
-    theta = np.linalg.norm(w)
-    if theta > 1e-12:
-        k = w / theta
-        RX = (
-            point * np.cos(theta)
-            + np.cross(k, point) * np.sin(theta)
-            + k * np.dot(k, point) * (1 - np.cos(theta))
-        )
-    else:
-        RX = point + np.cross(w, point)
+def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
+    """Vectorised NumPy projection: cameras [n,9] x points [n,3] -> [n,2]."""
+    w, t = cameras[:, 0:3], cameras[:, 3:6]
+    f, k1, k2 = cameras[:, 6], cameras[:, 7], cameras[:, 8]
+    theta = np.linalg.norm(w, axis=1, keepdims=True)
+    safe = theta > 1e-12
+    theta_safe = np.where(safe, theta, 1.0)
+    k = w / theta_safe
+    cos_t = np.cos(theta)
+    sin_t = np.sin(theta)
+    dot = np.sum(k * points, axis=1, keepdims=True)
+    RX = points * cos_t + np.cross(k, points) * sin_t + k * dot * (1 - cos_t)
+    RX = np.where(safe, RX, points + np.cross(w, points))
     P = RX + t
-    p = -P[0:2] / P[2]
-    n = p @ p
-    return f * (1 + k1 * n + k2 * n * n) * p
+    p = -P[:, 0:2] / P[:, 2:3]
+    n = np.sum(p * p, axis=1)
+    return (f * (1 + k1 * n + k2 * n * n))[:, None] * p
 
 
 def make_synthetic_bal(
@@ -76,24 +75,24 @@ def make_synthetic_bal(
     cameras_gt[:, 7] = r.normal(scale=1e-4, size=num_cameras)  # k1
     cameras_gt[:, 8] = r.normal(scale=1e-6, size=num_cameras)  # k2
 
-    cam_idx, pt_idx, obs = [], [], []
-    for j in range(num_points):
-        cams = r.choice(num_cameras, size=obs_per_point, replace=False)
-        for c in cams:
-            cam_idx.append(c)
-            pt_idx.append(j)
-            uv = _project(cameras_gt[c], points_gt[j])
-            obs.append(uv + r.normal(scale=pixel_noise, size=2))
-    # Guarantee every camera appears (choice may miss one on tiny scenes).
-    seen = set(cam_idx)
-    for c in range(num_cameras):
-        if c not in seen:
-            j = int(r.integers(num_points))
-            cam_idx.append(c)
-            pt_idx.append(j)
-            obs.append(_project(cameras_gt[c], points_gt[j]) + r.normal(scale=pixel_noise, size=2))
+    # k distinct cameras per point, fully vectorised: (base + j*stride) mod
+    # Nc for j < k is duplicate-free whenever stride*k <= Nc.
+    k = obs_per_point
+    base = r.integers(0, num_cameras, size=(num_points, 1))
+    max_stride = max(num_cameras // max(k, 1), 1)
+    stride = 1 + r.integers(0, max_stride, size=(num_points, 1))
+    cam_idx = ((base + np.arange(k)[None, :] * stride) % num_cameras).reshape(-1)
+    pt_idx = np.repeat(np.arange(num_points), k)
+    # Guarantee every camera appears (random draws may miss some).
+    missing = np.setdiff1d(np.arange(num_cameras), cam_idx, assume_unique=False)
+    if missing.size:
+        cam_idx = np.concatenate([cam_idx, missing])
+        pt_idx = np.concatenate(
+            [pt_idx, r.integers(0, num_points, size=missing.size)])
+    uv = _project_batch(cameras_gt[cam_idx], points_gt[pt_idx])
+    obs = uv + r.normal(scale=pixel_noise, size=uv.shape)
 
-    order = np.argsort(np.asarray(cam_idx), kind="stable")  # BAL files are cam-sorted
+    order = np.argsort(cam_idx, kind="stable")  # BAL files are cam-sorted
     cam_idx = np.asarray(cam_idx, dtype=np.int32)[order]
     pt_idx = np.asarray(pt_idx, dtype=np.int32)[order]
     obs = np.asarray(obs, dtype=dtype)[order]

@@ -137,6 +137,11 @@ def build_schur_system(
     if pallas_plan is not None:
         from megba_tpu.ops.pallas_kernels import camera_hessian_gradient
 
+        if not cam_sorted:
+            # The kernel's windowed one-hot silently drops out-of-window
+            # edges; without the sortedness guarantee that is data loss,
+            # not an optimisation.
+            raise ValueError("pallas_plan requires cam_sorted=True")
         if r.dtype != jnp.float32:
             # The kernel accumulates in float32; silently downgrading a
             # float64 build would corrupt the double-precision pipeline.

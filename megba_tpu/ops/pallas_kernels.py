@@ -84,11 +84,13 @@ def _hessian_cam_kernel(starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, wind
             jom = jo * oh
             acc_h = acc_h + jax.lax.dot_general(
                 jom, jo, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
             ro = r_ref[:, o : o + 1]  # [tile, 1]
             acc_g = acc_g - jax.lax.dot_general(
                 jom, ro, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)[:, 0]
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)[:, 0]
         out_ref[0, w, 0 : cd * cd] = acc_h.reshape(cd * cd).astype(out_ref.dtype)
         out_ref[0, w, cd * cd : cd * cd + cd] = acc_g.astype(out_ref.dtype)
 

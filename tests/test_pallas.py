@@ -56,7 +56,12 @@ def test_pallas_rejects_float64():
     Jp = jnp.zeros((4, 2, 3), jnp.float64)
     idx = jnp.zeros(4, jnp.int32)
     with _pytest.raises(ValueError, match="float32"):
-        build_schur_system(r, Jc, Jp, idx, idx, 2, 2, pallas_plan=(64, 16))
+        build_schur_system(r, Jc, Jp, idx, idx, 2, 2, cam_sorted=True,
+                           pallas_plan=(64, 16))
+    with _pytest.raises(ValueError, match="cam_sorted"):
+        build_schur_system(r.astype(jnp.float32), Jc.astype(jnp.float32),
+                           Jp.astype(jnp.float32), idx, idx, 2, 2,
+                           pallas_plan=(64, 16))
 
 
 @pytest.mark.parametrize("tile", [64, 128])

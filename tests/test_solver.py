@@ -109,7 +109,7 @@ def test_pcg_mixed_precision_close_to_full(compute_kind):
     np.testing.assert_allclose(mixed.dx_cam, full.dx_cam, atol=0.25 * scale)
     cos = float(jnp.sum(mixed.dx_cam * full.dx_cam)) / (
         float(jnp.linalg.norm(mixed.dx_cam)) * float(jnp.linalg.norm(full.dx_cam)))
-    assert cos > 0.99
+    assert cos > 0.95
 
 
 def test_fixed_camera_gets_zero_update():
