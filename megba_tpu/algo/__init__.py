@@ -1,3 +1,4 @@
+from megba_tpu.algo.checkpointed import solve_checkpointed
 from megba_tpu.algo.lm import LMResult, lm_solve
 
-__all__ = ["LMResult", "lm_solve"]
+__all__ = ["LMResult", "lm_solve", "solve_checkpointed"]

@@ -48,6 +48,7 @@ class LMResult:
     iterations: jax.Array  # LM iterations executed
     accepted: jax.Array  # number of accepted steps
     region: jax.Array  # final trust region
+    v: jax.Array  # final reject back-off factor (resume state)
 
 
 def lm_solve(
@@ -66,6 +67,8 @@ def lm_solve(
     verbose: bool = False,
     cam_sorted: bool = False,
     pallas_plan=None,
+    initial_region=None,
+    initial_v=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -73,6 +76,9 @@ def lm_solve(
     vectorised engine from ops.residuals.  Edge-axis arrays (obs, cam_idx,
     pt_idx, mask, sqrt_info) may be shard-local when `axis_name` names a
     mesh axis; cameras/points are replicated.
+
+    `initial_region`/`initial_v` override the trust-region start state —
+    the resume hook used by utils.checkpoint / solve_checkpointed.
     """
     num_cameras = cameras.shape[0]
     num_points = points.shape[0]
@@ -109,8 +115,10 @@ def lm_solve(
         Jp=Jp0,
         system=system0,
         cost=cost0,
-        region=jnp.asarray(algo_opt.initial_region, dtype),
-        v=jnp.asarray(2.0, dtype),
+        region=jnp.asarray(
+            algo_opt.initial_region if initial_region is None else initial_region,
+            dtype),
+        v=jnp.asarray(2.0 if initial_v is None else initial_v, dtype),
         stop=jnp.bool_(False),
     )
 
@@ -212,6 +220,7 @@ def lm_solve(
         iterations=out["k"],
         accepted=out["accepted"],
         region=out["region"],
+        v=out["v"],
     )
 
 

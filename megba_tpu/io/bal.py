@@ -49,9 +49,25 @@ class BALFile:
 
 
 def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
-    """Parse a BAL text file (plain or .txt; pre-decompressed)."""
+    """Parse a BAL text file (.txt or the .bz2 the BAL site distributes)."""
     if not os.path.exists(path):
         raise FileNotFoundError(f"BAL file not found: {path}")
+
+    if str(path).endswith(".bz2"):
+        # Decompress to a temp file once so the mmap-based native parser
+        # still applies; BAL .bz2 expand ~4x (Final-13682 ~350MB text).
+        import bz2
+        import shutil
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=".txt")
+        try:
+            with bz2.open(path, "rb") as src, os.fdopen(fd, "wb") as dst:
+                shutil.copyfileobj(src, dst, length=1 << 24)
+            return load_bal(tmp, dtype)
+        finally:
+            os.unlink(tmp)
+
     try:
         from megba_tpu.native import parse_bal_native
 

@@ -4,5 +4,12 @@ from megba_tpu.parallel.mesh import (
     make_mesh,
     shard_edge_arrays,
 )
+from megba_tpu.parallel.multihost import initialize_multihost
 
-__all__ = ["EDGE_AXIS", "distributed_lm_solve", "make_mesh", "shard_edge_arrays"]
+__all__ = [
+    "EDGE_AXIS",
+    "distributed_lm_solve",
+    "initialize_multihost",
+    "make_mesh",
+    "shard_edge_arrays",
+]

@@ -1,0 +1,55 @@
+"""Multi-host (multi-process) initialisation.
+
+The reference is hard-capped at one process on one node — its NCCL comm
+is created with the single-process `ncclCommInitAll`
+(src/resource/handle_manager.cpp:17-22) and SURVEY.md §1 records "no
+multi-process / multi-node support".  Here multi-host costs one call:
+`initialize_multihost()` wires `jax.distributed`, after which
+`jax.devices()` spans every host's chips, `make_mesh(total_chips)`
+builds a global edge mesh, and the psums inside the solve ride ICI
+within a slice and DCN across slices with zero further code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Initialise JAX's distributed runtime (idempotent).
+
+    With no arguments, relies on the cluster environment (TPU pod
+    metadata / SLURM / GKE) exactly as `jax.distributed.initialize`
+    does.  Returns a summary dict {process_index, process_count,
+    local_devices, global_devices}.
+    """
+    already = getattr(jax.distributed, "is_initialized", None)
+    if not (callable(already) and already()):
+        explicit = any(
+            a is not None for a in (coordinator_address, num_processes, process_id)
+        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except (RuntimeError, ValueError):
+            # Auto-detection outside a cluster env: degrade to local
+            # single-process.  But if the caller named ANY cluster
+            # parameter they meant to join a pod — failing silently would
+            # leave each host solo-solving, so re-raise.
+            if explicit:
+                raise
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
