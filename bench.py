@@ -78,6 +78,15 @@ def _probe_pallas(cam_idx):
 
 
 def main() -> None:
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from megba_tpu.utils.backend import ensure_usable_backend
+
+    backend_note = ""
+    if ensure_usable_backend():
+        backend_note = " [accelerator init hung; CPU fallback]"
+
     import jax
     import jax.numpy as jnp
 
@@ -146,7 +155,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"LM iters/sec, synthetic Venice-1778 scale ({n_edge} edges), f32 analytical implicit, 1 chip",
+                "metric": f"LM iters/sec, synthetic Venice-1778 scale ({n_edge} edges), f32 analytical implicit, 1 chip{backend_note}",
                 "value": round(lm_iters_per_sec, 3),
                 "unit": "LM iters/s",
                 "vs_baseline": round(lm_iters_per_sec / ASSUMED_BASELINE_LM_ITERS_PER_SEC, 3),

@@ -57,6 +57,7 @@ def solve_checkpointed(
         region = float(st["region"])
         v = float(st["extra_v"])
         done = int(st["iteration"])
+        accepted_total = int(st.get("extra_accepted", 0))
 
     result = None
     while done < total:
@@ -80,20 +81,21 @@ def solve_checkpointed(
         save_state(
             checkpoint_path, np.asarray(cameras), np.asarray(points),
             region=float(region), cost=float(result.cost), iteration=done,
-            extra={"v": np.asarray(float(v))})
-        if ran < chunk:
-            break  # converged inside the chunk
+            extra={"v": np.asarray(float(v)),
+                   "accepted": np.asarray(accepted_total)})
+        if bool(result.stopped) or ran < chunk:
+            break  # converged (possibly exactly on the chunk boundary)
 
-    if result is None:  # resumed at/past total: report current state
+    if result is None:  # resumed at/past total: evaluate current state
         result = lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             dataclasses.replace(
                 option,
                 algo_option=dataclasses.replace(option.algo_option, max_iter=0)),
-            initial_region=region, initial_v=v, **lm_kwargs)
-        return result
+            initial_region=region, initial_v=v, verbose=verbose, **lm_kwargs)
+        first_cost = result.initial_cost
 
-    # Report whole-solve (this process) aggregates, not last-chunk ones.
+    # Report whole-solve aggregates, not last-chunk ones.
     return dataclasses.replace(
         result,
         initial_cost=first_cost,

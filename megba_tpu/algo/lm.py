@@ -49,6 +49,7 @@ class LMResult:
     accepted: jax.Array  # number of accepted steps
     region: jax.Array  # final trust region
     v: jax.Array  # final reject back-off factor (resume state)
+    stopped: jax.Array  # True when a convergence criterion fired
 
 
 def lm_solve(
@@ -221,6 +222,7 @@ def lm_solve(
         accepted=out["accepted"],
         region=out["region"],
         v=out["v"],
+        stopped=out["stop"],
     )
 
 

@@ -53,14 +53,17 @@ def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
     if not os.path.exists(path):
         raise FileNotFoundError(f"BAL file not found: {path}")
 
-    if str(path).endswith(".bz2"):
+    if str(path).lower().endswith(".bz2"):
         # Decompress to a temp file once so the mmap-based native parser
         # still applies; BAL .bz2 expand ~4x (Final-13682 ~350MB text).
         import bz2
         import shutil
         import tempfile
 
-        fd, tmp = tempfile.mkstemp(suffix=".txt")
+        # Expand next to the archive (default temp dirs are often small
+        # tmpfs mounts; Final-13682 expands to ~350MB).
+        fd, tmp = tempfile.mkstemp(
+            suffix=".txt", dir=os.path.dirname(os.path.abspath(path)))
         try:
             with bz2.open(path, "rb") as src, os.fdopen(fd, "wb") as dst:
                 shutil.copyfileobj(src, dst, length=1 << 24)

@@ -30,10 +30,16 @@ def initialize_multihost(
     local_devices, global_devices}.
     """
     already = getattr(jax.distributed, "is_initialized", None)
-    if not (callable(already) and already()):
-        explicit = any(
-            a is not None for a in (coordinator_address, num_processes, process_id)
-        )
+    initialized = callable(already) and already()
+    explicit = any(
+        a is not None for a in (coordinator_address, num_processes, process_id)
+    )
+    if initialized and explicit:
+        raise RuntimeError(
+            "jax.distributed is already initialized; explicit cluster "
+            "parameters cannot be applied — call initialize_multihost "
+            "before any other jax.distributed use")
+    if not initialized:
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

@@ -1,0 +1,51 @@
+"""Backend health probing (shared by bench.py and __graft_entry__.py).
+
+The axon TPU tunnel is single-client; a client that died mid-claim can
+wedge it so that JAX backend initialisation hangs forever.  Probing in a
+child process with a timeout lets driver-facing scripts fall back to CPU
+and keep reporting instead of hanging.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_probe_result: Optional[bool] = None
+
+
+def accelerator_usable(timeout_s: float = 120.0) -> bool:
+    """True when `import jax; jax.devices()` completes in a subprocess.
+
+    Cached per process (one probe covers every entry point).
+    """
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        _probe_result = proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        _probe_result = False
+    return _probe_result
+
+
+def ensure_usable_backend(timeout_s: float = 120.0) -> bool:
+    """Pin jax to CPU when accelerator init would hang.
+
+    Returns True when the fallback was applied.  Honours
+    MEGBA_BENCH_SKIP_PROBE=1 (no probe, trust the environment).  Must be
+    called before the first jax device query of the process.
+    """
+    if os.environ.get("MEGBA_BENCH_SKIP_PROBE") == "1":
+        return False
+    if accelerator_usable(timeout_s):
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True
