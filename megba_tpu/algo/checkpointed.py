@@ -58,6 +58,10 @@ def solve_checkpointed(
         v = float(st["extra_v"])
         done = int(st["iteration"])
         accepted_total = int(st.get("extra_accepted", 0))
+        if "extra_first_cost" in st:
+            first_cost = jnp.asarray(st["extra_first_cost"])
+        if bool(st.get("extra_stopped", False)):
+            done = total  # converged earlier; skip straight to reporting
 
     result = None
     while done < total:
@@ -78,12 +82,15 @@ def solve_checkpointed(
         accepted_total += int(result.accepted)
         ran = int(result.iterations)
         done += ran
+        stopped = bool(result.stopped) or ran < chunk
         save_state(
             checkpoint_path, np.asarray(cameras), np.asarray(points),
             region=float(region), cost=float(result.cost), iteration=done,
             extra={"v": np.asarray(float(v)),
-                   "accepted": np.asarray(accepted_total)})
-        if bool(result.stopped) or ran < chunk:
+                   "accepted": np.asarray(accepted_total),
+                   "first_cost": np.asarray(float(first_cost)),
+                   "stopped": np.asarray(stopped)})
+        if stopped:
             break  # converged (possibly exactly on the chunk boundary)
 
     if result is None:  # resumed at/past total: evaluate current state
@@ -93,7 +100,8 @@ def solve_checkpointed(
                 option,
                 algo_option=dataclasses.replace(option.algo_option, max_iter=0)),
             initial_region=region, initial_v=v, verbose=verbose, **lm_kwargs)
-        first_cost = result.initial_cost
+        if first_cost is None:
+            first_cost = result.initial_cost
 
     # Report whole-solve aggregates, not last-chunk ones.
     return dataclasses.replace(

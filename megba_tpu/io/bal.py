@@ -60,10 +60,14 @@ def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
         import shutil
         import tempfile
 
-        # Expand next to the archive (default temp dirs are often small
-        # tmpfs mounts; Final-13682 expands to ~350MB).
-        fd, tmp = tempfile.mkstemp(
-            suffix=".txt", dir=os.path.dirname(os.path.abspath(path)))
+        # Prefer expanding next to the archive (default temp dirs are
+        # often small tmpfs mounts; Final-13682 expands to ~350MB), but
+        # fall back to the system temp dir for read-only dataset mounts.
+        try:
+            fd, tmp = tempfile.mkstemp(
+                suffix=".txt", dir=os.path.dirname(os.path.abspath(path)))
+        except OSError:
+            fd, tmp = tempfile.mkstemp(suffix=".txt")
         try:
             with bz2.open(path, "rb") as src, os.fdopen(fd, "wb") as dst:
                 shutil.copyfileobj(src, dst, length=1 << 24)

@@ -1,0 +1,19 @@
+"""Model families.
+
+`bal` — the flagship 3D Bundle-Adjustment-in-the-Large model (9-dof
+cameras, 3D points, 2D reprojections): the problem family all six
+reference examples solve.
+
+`planar` — 2D bundle adjustment (3-dof SE(2) pose + focal, 2D points, 1D
+image line): exercises the generic engine with different block sizes and
+the rotation2D geometry op (reference src/geo/rotation2D.cu; its SE2
+vertex, include/vertex/SE2_vertex.h, is dead code — this family is the
+live equivalent).
+
+Every model is just a residual function (+ optional closed-form
+Jacobian); the whole solver stack is dimension-generic.
+"""
+
+from megba_tpu.models import bal, planar
+
+__all__ = ["bal", "planar"]

@@ -1,0 +1,23 @@
+"""The BAL 3D bundle adjustment model family (flagship).
+
+Camera block (9): angle-axis rotation (3), translation (3), focal, k1,
+k2.  Point block (3).  Observation (2).  Mirrors the model solved by all
+six reference examples (examples/BAL_Double.cpp:18-33 etc.).
+"""
+
+from megba_tpu.ops.residuals import (
+    bal_residual as residual,
+    bal_residual_jacobian_analytical as residual_jacobian_analytical,
+)
+
+CAMERA_DIM = 9
+POINT_DIM = 3
+OBS_DIM = 2
+
+__all__ = [
+    "CAMERA_DIM",
+    "OBS_DIM",
+    "POINT_DIM",
+    "residual",
+    "residual_jacobian_analytical",
+]

@@ -12,9 +12,13 @@ within a slice and DCN across slices with zero further code changes.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
+
+# Parameters this module successfully initialized jax.distributed with
+# (None until we did); used to keep repeat calls idempotent.
+_initialized_with: Optional[Tuple] = None
 
 
 def initialize_multihost(
@@ -29,23 +33,30 @@ def initialize_multihost(
     does.  Returns a summary dict {process_index, process_count,
     local_devices, global_devices}.
     """
+    global _initialized_with
     already = getattr(jax.distributed, "is_initialized", None)
     initialized = callable(already) and already()
     explicit = any(
         a is not None for a in (coordinator_address, num_processes, process_id)
     )
-    if initialized and explicit:
-        raise RuntimeError(
-            "jax.distributed is already initialized; explicit cluster "
-            "parameters cannot be applied — call initialize_multihost "
-            "before any other jax.distributed use")
-    if not initialized:
+    params = (coordinator_address, num_processes, process_id)
+    if initialized:
+        # Idempotent on an exact repeat of OUR parameters; anything else
+        # (different params, or an init we didn't perform) cannot be
+        # applied and failing silently would leave hosts solo-solving.
+        if explicit and params != _initialized_with:
+            raise RuntimeError(
+                "jax.distributed is already initialized with different "
+                "parameters; call initialize_multihost before any other "
+                "jax.distributed use")
+    else:
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
             )
+            _initialized_with = params
         except (RuntimeError, ValueError):
             # Auto-detection outside a cluster env: degrade to local
             # single-process.  But if the caller named ANY cluster

@@ -66,6 +66,27 @@ def test_checkpointed_aggregates_whole_run(tmp_path):
                                float(straight.initial_cost), rtol=1e-10)
 
 
+def test_resume_preserves_initial_cost_and_converged_state(tmp_path):
+    import dataclasses
+    f, args, option = setup(seed=3)
+    ck = str(tmp_path / "r.npz")
+    short = dataclasses.replace(
+        option, algo_option=dataclasses.replace(option.algo_option, max_iter=4))
+    first = solve_checkpointed(f, *args, short, checkpoint_path=ck,
+                               checkpoint_every=4)
+    resumed = solve_checkpointed(f, *args, option, checkpoint_path=ck,
+                                 checkpoint_every=4)
+    # initial_cost must be the TRUE first cost, not the resume point's.
+    np.testing.assert_allclose(float(resumed.initial_cost),
+                               float(first.initial_cost), rtol=1e-10)
+    # A converged checkpoint resumes without redoing LM iterations.
+    done_before = int(load_state(ck)["iteration"])
+    again = solve_checkpointed(f, *args, option, checkpoint_path=ck,
+                               checkpoint_every=4)
+    assert int(load_state(ck)["iteration"]) == done_before
+    np.testing.assert_allclose(float(again.cost), float(resumed.cost), rtol=1e-10)
+
+
 def test_checkpoint_every_validated(tmp_path):
     import pytest
     f, args, option = setup()
