@@ -49,6 +49,7 @@ def solve_checkpointed(
     v = None
     accepted_total = 0
     first_cost = None
+    already_stopped = False
 
     if os.path.exists(checkpoint_path):
         st = load_state(checkpoint_path)
@@ -60,11 +61,10 @@ def solve_checkpointed(
         accepted_total = int(st.get("extra_accepted", 0))
         if "extra_first_cost" in st:
             first_cost = jnp.asarray(st["extra_first_cost"])
-        if bool(st.get("extra_stopped", False)):
-            done = total  # converged earlier; skip straight to reporting
+        already_stopped = bool(st.get("extra_stopped", False))
 
     result = None
-    while done < total:
+    while not already_stopped and done < total:
         chunk = min(checkpoint_every, total - done)
         chunk_option = dataclasses.replace(
             option,
@@ -93,7 +93,7 @@ def solve_checkpointed(
         if stopped:
             break  # converged (possibly exactly on the chunk boundary)
 
-    if result is None:  # resumed at/past total: evaluate current state
+    if result is None:  # resumed at/past total (or converged): evaluate state
         result = lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             dataclasses.replace(
@@ -102,6 +102,8 @@ def solve_checkpointed(
             initial_region=region, initial_v=v, verbose=verbose, **lm_kwargs)
         if first_cost is None:
             first_cost = result.initial_cost
+        if already_stopped:
+            result = dataclasses.replace(result, stopped=jnp.bool_(True))
 
     # Report whole-solve aggregates, not last-chunk ones.
     return dataclasses.replace(

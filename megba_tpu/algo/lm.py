@@ -31,6 +31,7 @@ from megba_tpu.linear_system.builder import (
     build_schur_system,
     weight_system_inputs,
 )
+from megba_tpu.ops.robust import RobustKind, robustify
 from megba_tpu.solver.pcg import HI, schur_pcg_solve
 
 _TINY = 1e-30
@@ -90,20 +91,32 @@ def lm_solve(
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
+    robust = option.robust_kind
+    robust_delta = option.robust_delta
+
     def linearize(cams, pts):
         r, Jc, Jp = residual_jac_fn(jnp.take(cams, cam_idx, axis=0),
                                     jnp.take(pts, pt_idx, axis=0), obs)
         r, Jc, Jp = weight_system_inputs(
             r, Jc, Jp, cam_idx, pt_idx, mask, sqrt_info, cam_fixed, pt_fixed)
+        if robust == RobustKind.NONE:
+            wcost = psum(jnp.sum(r * r))
+            cost = wcost
+        else:
+            # IRLS reweighting (ops/robust.py); the system is built from
+            # the weighted quantities, the accept test uses Sum rho, the
+            # quadratic model is measured from the weighted norm.
+            r, Jc, Jp, rho_e = robustify(r, Jc, Jp, robust, robust_delta)
+            cost = psum(jnp.sum(rho_e))
+            wcost = psum(jnp.sum(r * r))
         system = build_schur_system(
             r, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
             compute_kind=compute_kind, axis_name=axis_name,
             cam_fixed=cam_fixed, pt_fixed=pt_fixed, cam_sorted=cam_sorted,
             pallas_plan=pallas_plan)
-        return r, Jc, Jp, system
+        return r, Jc, Jp, system, cost, wcost
 
-    r0, Jc0, Jp0, system0 = linearize(cameras, points)
-    cost0 = psum(jnp.sum(r0 * r0))
+    r0, Jc0, Jp0, system0, cost0, wcost0 = linearize(cameras, points)
 
     dtype = cameras.dtype
     state0 = dict(
@@ -116,6 +129,7 @@ def lm_solve(
         Jp=Jp0,
         system=system0,
         cost=cost0,
+        wcost=wcost0,
         region=jnp.asarray(
             algo_opt.initial_region if initial_region is None else initial_region,
             dtype),
@@ -152,17 +166,20 @@ def lm_solve(
             + s["r"]
         )
         predicted = psum(jnp.sum(jdx * jdx))
+        # The quadratic model is in the (robust-)weighted residuals; its
+        # decrease is measured from the carried weighted norm, while
+        # accept uses the true (robustified) cost.  For RobustKind.NONE
+        # both equal Sum r^2 and this reduces to the reference formula.
         # The linearised decrease is <= 0 for any useful step; clamp
         # sign-preservingly so an underflowing denominator can't flip
         # rho's sign and collapse the trust region on an accepted step.
-        denominator = jnp.minimum(predicted - s["cost"], -_TINY)
+        denominator = jnp.minimum(predicted - s["wcost"], -_TINY)
 
         # ONE linearisation at the trial point serves both the cost test
         # and the accept branch — the reference's second forward() per
         # iteration whose jets feed buildLinearSystem on accept
         # (lm_algo.cu:183-189).
-        r_n, Jc_n, Jp_n, system_n = linearize(cams_new, pts_new)
-        cost_new = psum(jnp.sum(r_n * r_n))
+        r_n, Jc_n, Jp_n, system_n, cost_new, wcost_new = linearize(cams_new, pts_new)
         rho = (cost_new - s["cost"]) / denominator
 
         accept = cost_new < s["cost"]
@@ -191,6 +208,7 @@ def lm_solve(
             Jp=pick(Jp_n, s["Jp"]),
             system=pick(system_n, s["system"]),
             cost=jnp.where(accept, cost_new, s["cost"]),
+            wcost=jnp.where(accept, wcost_new, s["wcost"]),
             region=jnp.where(accept, region_accept, region_reject),
             v=jnp.where(accept, jnp.asarray(2.0, dtype), v_reject),
             stop=converged | (accept & stop_accept),

@@ -120,10 +120,22 @@ class ProblemOption:
     algo_option: AlgoOption = dataclasses.field(default_factory=AlgoOption)
     # bf16 inner PCG vectors with fp32 reductions (BASELINE.md config 5).
     mixed_precision_pcg: bool = False
+    # Robust loss (capability beyond the reference; Ceres-style kernels).
+    robust_kind: "RobustKind" = None  # resolved to RobustKind.NONE below
+    robust_delta: float = 1.0
 
     def __post_init__(self) -> None:
+        if self.robust_kind is None:
+            from megba_tpu.ops.robust import RobustKind
+
+            object.__setattr__(self, "robust_kind", RobustKind.NONE)
         if self.world_size < 1:
             raise ValueError(f"world_size must be >= 1, got {self.world_size}")
+        from megba_tpu.ops.robust import RobustKind as _RK
+
+        if self.robust_kind != _RK.NONE and not self.robust_delta > 0:
+            raise ValueError(
+                f"robust_delta must be > 0, got {self.robust_delta}")
         if not self.use_schur:
             # Parity note: the reference also only implements the Schur path
             # (every useSchur=false branch is a TODO, base_problem.cpp:112-123).

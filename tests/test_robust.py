@@ -1,0 +1,107 @@
+"""Robust loss kernels: math checks + outlier-rejection end-to-end."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.algo import lm_solve
+from megba_tpu.common import AlgoOption, JacobianMode, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.ops.robust import RobustKind, rho_and_weight, robustify
+
+
+def test_rho_properties():
+    s = jnp.asarray([0.0, 0.5, 1.0, 4.0, 100.0])
+    for kind in (RobustKind.HUBER, RobustKind.CAUCHY):
+        rho, w = rho_and_weight(s, kind, delta=1.0)
+        # rho(s) ~ s near zero, concave growth, weights in (0, 1].
+        np.testing.assert_allclose(rho[0], 0.0, atol=1e-12)
+        np.testing.assert_allclose(rho[1], s[1], rtol=0.4)
+        assert np.all(np.diff(np.asarray(rho)) > 0)  # increasing
+        assert np.all(np.asarray(rho) <= np.asarray(s) + 1e-12)  # below L2
+        assert np.all((np.asarray(w) > 0) & (np.asarray(w) <= 1.0 + 1e-12))
+
+
+def test_huber_matches_piecewise():
+    delta = 2.0
+    s = jnp.asarray([1.0, 4.0, 16.0])
+    rho, w = rho_and_weight(s, RobustKind.HUBER, delta)
+    np.testing.assert_allclose(rho[0], 1.0)  # inside: identity
+    np.testing.assert_allclose(rho[2], 2 * delta * 4.0 - delta * delta)  # outside
+    np.testing.assert_allclose(w[0], 1.0)
+    np.testing.assert_allclose(w[2], np.sqrt(delta / 4.0))
+
+
+def test_weight_derivative_consistency():
+    # w^2 must equal d rho / d s (finite difference).
+    for kind in (RobustKind.HUBER, RobustKind.CAUCHY):
+        s = jnp.asarray([0.3, 2.0, 9.0, 50.0])
+        eps = 1e-6
+        rho_p, _ = rho_and_weight(s + eps, kind, 1.5)
+        rho_m, _ = rho_and_weight(s - eps, kind, 1.5)
+        _, w = rho_and_weight(s, kind, 1.5)
+        np.testing.assert_allclose(w * w, (rho_p - rho_m) / (2 * eps),
+                                   rtol=1e-4, atol=1e-7)
+
+
+def test_none_kind_is_identity():
+    r = jnp.asarray(np.random.default_rng(0).normal(size=(8, 2)))
+    Jc = jnp.asarray(np.random.default_rng(1).normal(size=(8, 2, 9)))
+    Jp = jnp.asarray(np.random.default_rng(2).normal(size=(8, 2, 3)))
+    r2, Jc2, Jp2, rho = robustify(r, Jc, Jp, RobustKind.NONE, 1.0)
+    np.testing.assert_allclose(r2, r)
+    np.testing.assert_allclose(rho, jnp.sum(r * r, axis=1))
+
+
+def solve(s, robust_kind, delta=3.0, anchor_gauge=False):
+    option = ProblemOption(
+        robust_kind=robust_kind, robust_delta=delta,
+        algo_option=AlgoOption(max_iter=30, epsilon1=1e-10, epsilon2=1e-13),
+        solver_option=SolverOption(max_iter=120, tol=1e-13, refuse_ratio=1e30))
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    cam_fixed = None
+    cameras0 = np.array(s.cameras0)
+    if anchor_gauge:
+        # Fix two ground-truth cameras so parameter errors measure
+        # estimation quality, not gauge drift.
+        cameras0[:2] = s.cameras_gt[:2]
+        cam_fixed = jnp.zeros(len(cameras0), bool).at[:2].set(True)
+    return lm_solve(
+        f, jnp.asarray(cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+        jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)),
+        option, cam_fixed=cam_fixed)
+
+
+@pytest.mark.parametrize("kind", [RobustKind.HUBER, RobustKind.CAUCHY])
+def test_outlier_rejection(kind):
+    # Corrupt 5% of observations with gross outliers: the robust solve
+    # must recover points far closer to ground truth than plain L2.
+    s = make_synthetic_bal(num_cameras=8, num_points=80, obs_per_point=5,
+                           seed=7, param_noise=1e-2, pixel_noise=0.2)
+    rng = np.random.default_rng(0)
+    n_out = max(4, len(s.obs) // 20)
+    bad = rng.choice(len(s.obs), size=n_out, replace=False)
+    s.obs[bad] += rng.normal(scale=300.0, size=(n_out, 2))  # gross outliers
+
+    res_l2 = solve(s, RobustKind.NONE, anchor_gauge=True)
+    res_rb = solve(s, kind, anchor_gauge=True)
+
+    def pt_err(res):
+        return float(jnp.median(jnp.linalg.norm(
+            res.points - jnp.asarray(s.points_gt), axis=1)))
+
+    e_l2, e_rb = pt_err(res_l2), pt_err(res_rb)
+    assert e_rb < e_l2 * 0.5, (e_l2, e_rb)
+
+
+def test_robust_matches_l2_on_clean_data():
+    # With no outliers and a large delta the robust solve equals L2.
+    s = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                           seed=1, param_noise=2e-2, pixel_noise=0.1)
+    res_l2 = solve(s, RobustKind.NONE)
+    res_h = solve(s, RobustKind.HUBER, delta=1e6)
+    np.testing.assert_allclose(float(res_h.cost), float(res_l2.cost), rtol=1e-8)
