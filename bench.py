@@ -1,9 +1,10 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Measures LM iterations/second on a synthetic Venice-1778-scale problem
-(1778 cameras, ~1M observations — the BASELINE.md config 3 shape) with
-the analytical Jacobian and the implicit (matrix-free) Schur PCG, float32,
-on whatever accelerator JAX provides (the real TPU chip under the driver).
+Measures LM iterations/second on a synthetic problem shaped like one of
+the five BASELINE.md configurations (MEGBA_BENCH_CONFIG = ladybug /
+trafalgar / venice / final / final_mixed; default venice — 1778 cameras,
+~1M observations, analytical Jacobian, implicit Schur PCG, float32) on
+whatever accelerator JAX provides (the real TPU chip under the driver).
 
 The reference repo publishes no absolute numbers (BASELINE.md); the
 `vs_baseline` field is computed against ASSUMED_BASELINE_LM_ITERS_PER_SEC,
@@ -23,11 +24,48 @@ import os
 
 ASSUMED_BASELINE_LM_ITERS_PER_SEC = 10.0
 
+# The five BASELINE.md configs, selectable via MEGBA_BENCH_CONFIG
+# (default: venice — the headline metric).  Shapes approximate the BAL
+# dataset of the same name (cameras and observation count match; the
+# synthetic point count is scaled so obs_per_point stays ~10).
+from typing import NamedTuple
+
+
+class BenchConfig(NamedTuple):
+    cameras: int
+    points: int
+    obs_per_point: int
+    dtype: str
+    jacobian: str
+    compute: str
+    mixed: bool = False
+    force_cpu: bool = False
+
+
+CONFIGS = {
+    # BAL Ladybug problem-49-7776: BAL_Double semantics, CPU, world 1.
+    "ladybug": BenchConfig(49, 7776, 4, "float64", "AUTODIFF", "EXPLICIT", force_cpu=True),
+    # BAL Trafalgar problem-257-65132: BAL_Float autodiff, single chip.
+    "trafalgar": BenchConfig(257, 22_544, 10, "float32", "AUTODIFF", "EXPLICIT"),
+    # BAL Venice problem-1778-993923: analytical, distributed PCG shape.
+    "venice": BenchConfig(1778, 99_392, 10, "float32", "ANALYTICAL", "IMPLICIT"),
+    # BAL Final problem-13682-4456117: analytical implicit.
+    "final": BenchConfig(13_682, 445_612, 10, "float32", "ANALYTICAL", "IMPLICIT"),
+    # Final, mixed precision: fp32 residuals + bf16 PCG.
+    "final_mixed": BenchConfig(13_682, 445_612, 10, "float32", "ANALYTICAL", "IMPLICIT", mixed=True),
+}
+
+CONFIG = os.environ.get("MEGBA_BENCH_CONFIG", "venice")
+if CONFIG not in CONFIGS:
+    raise SystemExit(
+        f"unknown MEGBA_BENCH_CONFIG {CONFIG!r}; choose from {sorted(CONFIGS)}")
+
 # MEGBA_BENCH_SCALE in (0, 1] shrinks the problem for smoke tests.
 _SCALE = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
-NUM_CAMERAS = max(8, int(1778 * _SCALE))
-NUM_POINTS = max(64, int(99_392 * _SCALE))  # ~Venice/10 point count; obs count matches
-OBS_PER_POINT = 10  # ~994k observations at full scale — Venice-1778's edge count
+_C = CONFIGS[CONFIG]
+NUM_CAMERAS = max(8, int(_C.cameras * _SCALE))
+NUM_POINTS = max(64, int(_C.points * _SCALE))
+OBS_PER_POINT = _C.obs_per_point
 LM_ITERS = 8
 PCG_ITERS = 30
 
@@ -84,7 +122,12 @@ def main() -> None:
     from megba_tpu.utils.backend import ensure_usable_backend
 
     backend_note = ""
-    if ensure_usable_backend():
+    if _C.force_cpu:
+        # This config is CPU by design; no accelerator probe needed.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif ensure_usable_backend():
         backend_note = " [accelerator init hung; CPU fallback]"
 
     import jax
@@ -101,7 +144,14 @@ def main() -> None:
     from megba_tpu.io.synthetic import make_synthetic_bal
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
 
-    dtype = np.float32
+    dtype_name, jac_name, ck_name = _C.dtype, _C.jacobian, _C.compute
+    mixed = _C.mixed
+    if dtype_name == "float64":
+        jax.config.update("jax_enable_x64", True)
+    dtype = np.dtype(dtype_name)
+    jac_mode = JacobianMode[jac_name]
+    compute_kind = ComputeKind[ck_name]
+
     s = make_synthetic_bal(
         num_cameras=NUM_CAMERAS,
         num_points=NUM_POINTS,
@@ -115,12 +165,13 @@ def main() -> None:
 
     option = ProblemOption(
         dtype=dtype,
-        compute_kind=ComputeKind.IMPLICIT,
-        jacobian_mode=JacobianMode.ANALYTICAL,
+        compute_kind=compute_kind,
+        jacobian_mode=jac_mode,
+        mixed_precision_pcg=mixed,
         algo_option=AlgoOption(max_iter=LM_ITERS, epsilon1=1e-12, epsilon2=1e-15),
         solver_option=SolverOption(max_iter=PCG_ITERS, tol=1e-10, refuse_ratio=1e30),
     )
-    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    f = make_residual_jacobian_fn(mode=jac_mode)
 
     args = (
         jnp.asarray(s.cameras0),
@@ -134,7 +185,10 @@ def main() -> None:
     from megba_tpu.core.types import is_cam_sorted
 
     cam_sorted = is_cam_sorted(s.cam_idx)
-    pallas_plan = _probe_pallas(s.cam_idx) if cam_sorted else None
+    pallas_plan = (
+        _probe_pallas(s.cam_idx)
+        if cam_sorted and dtype == np.float32 else None
+    )
     solve = jax.jit(
         lambda cams, pts, obs, ci, pi, m: lm_solve(
             f, cams, pts, obs, ci, pi, m, option, cam_sorted=cam_sorted,
@@ -155,7 +209,11 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"LM iters/sec, synthetic Venice-1778 scale ({n_edge} edges), f32 analytical implicit, 1 chip{backend_note}",
+                "metric": (
+                    f"LM iters/sec, synthetic {CONFIG} scale ({n_edge} edges), "
+                    f"{dtype_name} {jac_name.lower()} {ck_name.lower()}"
+                    f"{' bf16-mixed' if mixed else ''}, 1 chip{backend_note}"
+                ),
                 "value": round(lm_iters_per_sec, 3),
                 "unit": "LM iters/s",
                 "vs_baseline": round(lm_iters_per_sec / ASSUMED_BASELINE_LM_ITERS_PER_SEC, 3),

@@ -125,15 +125,13 @@ class ProblemOption:
     robust_delta: float = 1.0
 
     def __post_init__(self) -> None:
-        if self.robust_kind is None:
-            from megba_tpu.ops.robust import RobustKind
+        from megba_tpu.ops.robust import RobustKind
 
+        if self.robust_kind is None:
             object.__setattr__(self, "robust_kind", RobustKind.NONE)
         if self.world_size < 1:
             raise ValueError(f"world_size must be >= 1, got {self.world_size}")
-        from megba_tpu.ops.robust import RobustKind as _RK
-
-        if self.robust_kind != _RK.NONE and not self.robust_delta > 0:
+        if self.robust_kind != RobustKind.NONE and not self.robust_delta > 0:
             raise ValueError(
                 f"robust_delta must be > 0, got {self.robust_delta}")
         if not self.use_schur:
