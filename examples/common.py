@@ -40,14 +40,13 @@ def run_example(dtype, jacobian_mode, compute_kind, argv=None) -> float:
     if np.dtype(dtype) == np.float64:
         jax.config.update("jax_enable_x64", True)
 
-    import jax.numpy as jnp
 
-    from megba_tpu.algo import lm_solve
+
     from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
     from megba_tpu.io.bal import load_bal
     from megba_tpu.io.synthetic import make_synthetic_bal
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
-    from megba_tpu.parallel import distributed_lm_solve, make_mesh, shard_edge_arrays
+    from megba_tpu.solve import flat_solve
 
     args = build_arg_parser().parse_args(argv)
 
@@ -84,23 +83,9 @@ def run_example(dtype, jacobian_mode, compute_kind, argv=None) -> float:
         f"jacobian={jacobian_mode.name} compute={compute_kind.name} "
         f"world_size={args.world_size}")
 
-    from megba_tpu.core.types import is_cam_sorted
-    cam_sorted = is_cam_sorted(cam_idx)
     t0 = time.perf_counter()
-    if args.world_size > 1:
-        obs_p, cam_idx_p, pt_idx_p, mask = shard_edge_arrays(
-            obs, cam_idx, pt_idx, args.world_size, dtype=dtype)
-        mesh = make_mesh(args.world_size)
-        result = distributed_lm_solve(
-            f, jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs_p),
-            jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p), jnp.asarray(mask),
-            option, mesh, verbose=True, cam_sorted=cam_sorted)
-    else:
-        result = lm_solve(
-            f, jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
-            jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-            jnp.ones(obs.shape[0], dtype=dtype), option, verbose=True,
-            cam_sorted=cam_sorted)
+    result = flat_solve(f, cameras, points, obs, cam_idx, pt_idx, option,
+                        verbose=True)
     cost = float(result.cost)
     elapsed = time.perf_counter() - t0
     print(

@@ -35,6 +35,7 @@ from megba_tpu.problem import (
     PointVertex,
     VertexKind,
 )
+from megba_tpu.solve import solve_bal
 
 __version__ = "0.1.0"
 
@@ -56,4 +57,5 @@ __all__ = [
     "SolverKind",
     "SolverOption",
     "VertexKind",
+    "solve_bal",
 ]

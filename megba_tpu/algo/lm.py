@@ -145,6 +145,7 @@ def lm_solve(
             s["system"], s["Jc"], s["Jp"], cam_idx, pt_idx, s["region"],
             max_iter=solver_opt.max_iter, tol=solver_opt.tol,
             refuse_ratio=solver_opt.refuse_ratio,
+            tol_relative=solver_opt.tol_relative,
             compute_kind=compute_kind, axis_name=axis_name,
             mixed_precision=option.mixed_precision_pcg, cam_sorted=cam_sorted)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt

@@ -73,12 +73,20 @@ class JacobianMode(enum.Enum):
 
 @dataclasses.dataclass(frozen=True)
 class SolverOption:
-    """Inner (PCG) solver options — reference common.h:27-33 defaults."""
+    """Inner (PCG) solver options — reference common.h:27-33 defaults.
+
+    `tol` follows the reference's semantics: an ABSOLUTE threshold on the
+    preconditioned residual energy rho = <r, M^-1 r> (fine when costs are
+    large, awkward otherwise).  `tol_relative=True` reinterprets it as a
+    fraction of the initial rho — the conventional, scale-free PCG
+    stopping rule (capability beyond the reference).
+    """
 
     solver_kind: SolverKind = SolverKind.PCG
     max_iter: int = 100
     tol: float = 1e-1
     refuse_ratio: float = 1.0
+    tol_relative: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,14 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.algo.lm import LMResult
 from megba_tpu.common import JacobianMode, ProblemOption, validate_options
 from megba_tpu.ops.residuals import (
     bal_residual,
     bal_residual_jacobian_analytical,
     make_residual_jacobian_fn,
 )
-from megba_tpu.parallel.mesh import distributed_lm_solve, make_mesh, shard_edge_arrays
 
 
 class VertexKind(enum.Enum):
@@ -227,26 +226,6 @@ class BaseProblem:
         (cameras, points, obs, cam_idx, pt_idx,
          cam_fixed, pt_fixed, sqrt_info, cams, pts) = self._lower()
 
-        dtype = np.dtype(opt.dtype)
-        cameras = cameras.astype(dtype)
-        points = points.astype(dtype)
-        obs = obs.astype(dtype)
-
-        # Order edges by camera (native counting sort): the camera-side
-        # Hessian scatter-reduces then run as sorted segment sums, and
-        # shard slices keep spatial locality.  Edge order is otherwise
-        # irrelevant to the math.
-        from megba_tpu.native import sort_edges_by_camera
-
-        from megba_tpu.core.types import is_cam_sorted
-
-        if not is_cam_sorted(cam_idx):
-            perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
-            cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
-            if sqrt_info is not None:
-                sqrt_info = sqrt_info[perm]
-        cam_sorted = True
-
         # Jacobian engine: the built-in analytical path only applies to the
         # untouched BAL forward; custom forwards always go through autodiff.
         custom_forward = (
@@ -261,34 +240,16 @@ class BaseProblem:
         else:
             residual_jac_fn = make_residual_jacobian_fn(mode=opt.jacobian_mode)
 
-        cam_fixed_j = jnp.asarray(cam_fixed) if cam_fixed.any() else None
-        pt_fixed_j = jnp.asarray(pt_fixed) if pt_fixed.any() else None
-        sqrt_info_j = None if sqrt_info is None else jnp.asarray(sqrt_info.astype(dtype))
+        # All lowering (dtype cast, camera sort, pad/shard, jit caching)
+        # lives in the shared pipeline.
+        from megba_tpu.solve import flat_solve
 
-        if opt.world_size > 1:
-            obs_p, cam_idx_p, pt_idx_p, mask = shard_edge_arrays(
-                obs, cam_idx, pt_idx, opt.world_size, dtype=dtype)
-            if sqrt_info_j is not None and mask.shape[0] != obs.shape[0]:
-                pad = mask.shape[0] - obs.shape[0]
-                eye = np.broadcast_to(np.eye(obs.shape[1], dtype=dtype), (pad,) + sqrt_info.shape[1:])
-                sqrt_info_j = jnp.concatenate([sqrt_info_j, jnp.asarray(eye)])
-            mesh = make_mesh(opt.world_size)
-            result = distributed_lm_solve(
-                residual_jac_fn, jnp.asarray(cameras), jnp.asarray(points),
-                jnp.asarray(obs_p), jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p),
-                jnp.asarray(mask), opt, mesh,
-                sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
-                verbose=verbose, cam_sorted=cam_sorted)
-        else:
-            result = jax.jit(
-                lambda c, p, o, ci, pi, m: lm_solve(
-                    residual_jac_fn, c, p, o, ci, pi, m, opt,
-                    sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j,
-                    pt_fixed=pt_fixed_j, verbose=verbose,
-                    cam_sorted=cam_sorted)
-            )(jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
-              jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-              jnp.ones(obs.shape[0], dtype=dtype))
+        result = flat_solve(
+            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, opt,
+            sqrt_info=sqrt_info,
+            cam_fixed=cam_fixed if cam_fixed.any() else None,
+            pt_fixed=pt_fixed if pt_fixed.any() else None,
+            verbose=verbose)
 
         # Write back (reference base_problem.cpp:249-272).
         cams_out = np.asarray(result.cameras, dtype=np.float64)

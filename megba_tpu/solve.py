@@ -1,0 +1,143 @@
+"""Flat solve pipeline + one-call BAL convenience.
+
+`flat_solve` is THE lowering pipeline from flat arrays to the jitted
+solver — dtype cast, native camera sort, pad/shard, single- or
+multi-device dispatch, and jit caching — shared by `BaseProblem.solve`,
+`solve_bal`, and the example CLIs so the semantics live in exactly one
+place.  The object facade (problem.py) mirrors the reference's g2o-style
+API on top; `solve_bal` goes straight from a parsed `BALFile` (or path)
+to the solver without building per-edge Python objects.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.common import ProblemOption, validate_options
+from megba_tpu.core.types import is_cam_sorted
+from megba_tpu.io.bal import BALFile, load_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.parallel.mesh import distributed_lm_solve, make_mesh, shard_edge_arrays
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted,
+                         pallas_plan):
+    """Jitted single-device solve, cached per configuration (same pitfall
+    and remedy as parallel.mesh._cached_sharded_solve)."""
+
+    def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
+        return lm_solve(
+            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
+            option, verbose=verbose, cam_sorted=cam_sorted,
+            pallas_plan=pallas_plan, **dict(zip(keys, extras)))
+
+    return jax.jit(fn)
+
+
+def flat_solve(
+    residual_jac_fn,
+    cameras: np.ndarray,
+    points: np.ndarray,
+    obs: np.ndarray,
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    option: ProblemOption,
+    sqrt_info: Optional[np.ndarray] = None,
+    cam_fixed: Optional[np.ndarray] = None,
+    pt_fixed: Optional[np.ndarray] = None,
+    verbose: bool = False,
+    pallas_plan: Optional[Tuple[int, int]] = None,
+) -> LMResult:
+    """Lower flat arrays and run the solve (single- or multi-device).
+
+    Edges are camera-sorted here (native counting sort) if they are not
+    already; `sqrt_info` rides the same permutation.  `option.world_size`
+    selects the mesh; jitted programs are cached per configuration.
+    """
+    dtype = np.dtype(option.dtype)
+    cameras = np.asarray(cameras).astype(dtype)
+    points = np.asarray(points).astype(dtype)
+    obs = np.asarray(obs).astype(dtype)
+    cam_idx = np.asarray(cam_idx)
+    pt_idx = np.asarray(pt_idx)
+
+    if not is_cam_sorted(cam_idx):
+        from megba_tpu.native import sort_edges_by_camera
+
+        perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
+        cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+        if sqrt_info is not None:
+            sqrt_info = np.asarray(sqrt_info)[perm]
+
+    sqrt_info_j = None if sqrt_info is None else jnp.asarray(
+        np.asarray(sqrt_info).astype(dtype))
+    cam_fixed_j = None if cam_fixed is None else jnp.asarray(cam_fixed)
+    pt_fixed_j = None if pt_fixed is None else jnp.asarray(pt_fixed)
+
+    if option.world_size > 1:
+        obs_p, cam_idx_p, pt_idx_p, mask = shard_edge_arrays(
+            obs, cam_idx, pt_idx, option.world_size, dtype=dtype)
+        if sqrt_info_j is not None and mask.shape[0] != obs.shape[0]:
+            pad = mask.shape[0] - obs.shape[0]
+            eye = np.broadcast_to(
+                np.eye(obs.shape[1], dtype=dtype),
+                (pad,) + sqrt_info_j.shape[1:])
+            sqrt_info_j = jnp.concatenate([sqrt_info_j, jnp.asarray(eye)])
+        mesh = make_mesh(option.world_size)
+        return distributed_lm_solve(
+            residual_jac_fn, jnp.asarray(cameras), jnp.asarray(points),
+            jnp.asarray(obs_p), jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p),
+            jnp.asarray(mask), option, mesh,
+            sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
+            verbose=verbose, cam_sorted=True, pallas_plan=pallas_plan)
+
+    optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
+                ("pt_fixed", pt_fixed_j)]
+    keys = tuple(k for k, v in optional if v is not None)
+    extras = [v for _, v in optional if v is not None]
+    jitted = _cached_single_solve(
+        residual_jac_fn, option, keys, verbose, True, pallas_plan)
+    return jitted(
+        jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
+        jnp.asarray(cam_idx), jnp.asarray(pt_idx),
+        jnp.ones(obs.shape[0], dtype=dtype), *extras)
+
+
+def solve_bal(
+    bal: Union[BALFile, str, os.PathLike],
+    option: Optional[ProblemOption] = None,
+    verbose: bool = False,
+) -> Tuple[BALFile, LMResult]:
+    """Solve a BAL problem end to end.
+
+    Accepts a parsed `BALFile` or a path (.txt/.bz2).  Uses
+    `option.jacobian_mode`, `option.compute_kind`, `option.world_size`,
+    dtype, robust/mixed-precision settings.  Returns (solved BALFile with
+    updated cameras/points and the ORIGINAL edge order, LMResult).
+    """
+    option = option or ProblemOption()
+    validate_options(option)
+    if not isinstance(bal, BALFile):
+        bal = load_bal(bal, dtype=option.dtype)
+
+    f = make_residual_jacobian_fn(mode=option.jacobian_mode)
+    result = flat_solve(
+        f, bal.cameras, bal.points, bal.obs, bal.cam_idx, bal.pt_idx,
+        option, verbose=verbose)
+
+    solved = BALFile(
+        cameras=np.asarray(result.cameras, dtype=np.float64),
+        points=np.asarray(result.points, dtype=np.float64),
+        obs=bal.obs,  # original order/values
+        cam_idx=bal.cam_idx,
+        pt_idx=bal.pt_idx,
+    )
+    return solved, result

@@ -39,6 +39,9 @@ from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 
 HI = jax.lax.Precision.HIGHEST
 
+# Absolute floor for the relative PCG threshold (guards rho0 == 0).
+_TINY_RHO = 1e-30
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -187,6 +190,7 @@ def schur_pcg_solve(
     max_iter: int = 100,
     tol: float = 1e-1,
     refuse_ratio: float = 1.0,
+    tol_relative: bool = False,
     compute_kind: ComputeKind = ComputeKind.IMPLICIT,
     axis_name: Optional[str] = None,
     mixed_precision: bool = False,
@@ -256,6 +260,14 @@ def schur_pcg_solve(
     r0 = v  # x0 = 0 so r0 = v - S x0 = v
     z0 = block_matvec(Minv, r0)
     rho0 = _dot(r0, z0)
+    # Reference semantics: absolute threshold on rho
+    # (schur_pcg_solver.cu:406-407).  tol_relative scales it by rho0 —
+    # floored so a zero gradient (rho0 == 0) exits immediately instead of
+    # iterating into 0/0 NaNs.
+    threshold = (
+        jnp.maximum(tol * jnp.abs(rho0), jnp.asarray(_TINY_RHO, rho0.dtype))
+        if tol_relative else tol
+    )
 
     # Carry: (k, x, r, p, rho, rho_min, x_best, refused)
     state0 = (
@@ -265,7 +277,7 @@ def schur_pcg_solve(
 
     def cond(state):
         k, _, _, _, rho, _, _, refused = state
-        return (k < max_iter) & (jnp.abs(rho) >= tol) & (~refused)
+        return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
 
     def body(state):
         k, x, r, p, rho, rho_min, x_best, _ = state

@@ -42,6 +42,34 @@ def test_parse_reference_layout():
     np.testing.assert_allclose(bal.points[0], [0.0, 1.0, 2.0])
 
 
+def test_solve_bal_one_call(tmp_path):
+    from megba_tpu import ProblemOption, solve_bal
+    from megba_tpu.common import AlgoOption, JacobianMode, SolverOption
+
+    s = make_synthetic_bal(num_cameras=5, num_points=30, obs_per_point=3,
+                           seed=12, param_noise=3e-2, pixel_noise=0.2)
+    # Scramble the edge order to exercise the native sort path.
+    perm = np.random.default_rng(0).permutation(len(s.obs))
+    bal = BALFile(cameras=s.cameras0, points=s.points0, obs=s.obs[perm],
+                  cam_idx=s.cam_idx[perm], pt_idx=s.pt_idx[perm])
+    p = tmp_path / "p.txt"
+    save_bal(p, bal)
+    option = ProblemOption(
+        jacobian_mode=JacobianMode.ANALYTICAL,
+        algo_option=AlgoOption(max_iter=15, epsilon1=1e-9, epsilon2=1e-12),
+        solver_option=SolverOption(max_iter=100, tol=1e-10, tol_relative=True,
+                                   refuse_ratio=1e30))
+    # Independently held copies: solve_bal must return the ORIGINAL
+    # (scrambled) order, not its internal camera-sorted permutation.
+    cam_idx_before = bal.cam_idx.copy()
+    obs_before = bal.obs.copy()
+    solved, result = solve_bal(str(p), option)
+    assert float(result.cost) < float(result.initial_cost) * 1e-2
+    np.testing.assert_array_equal(solved.cam_idx, cam_idx_before)
+    np.testing.assert_array_equal(solved.obs, obs_before)
+    assert not np.allclose(solved.cameras, s.cameras0)
+
+
 def test_truncated_file_raises():
     with pytest.raises(ValueError, match="token count"):
         loads_bal("2 2 3\n0 0 1.0 2.0\n")

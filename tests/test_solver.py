@@ -112,6 +112,24 @@ def test_pcg_mixed_precision_close_to_full(compute_kind):
     assert cos > 0.95
 
 
+def test_relative_tolerance_mode():
+    # tol_relative reinterprets tol as a fraction of rho0: a modest 1e-8
+    # relative tolerance must reach (near) the dense answer regardless of
+    # the problem's cost scale, where the same 1e-8 ABSOLUTE tol would
+    # run to max_iter on a large-scale problem or quit instantly on a
+    # tiny one.
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system(seed=5)
+    region = jnp.asarray(100.0)
+    dx_cam_d, dx_pt_d = dense_reference_solve(system, Jc, Jp, cam_idx, pt_idx, region)
+    out = schur_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, region,
+                          max_iter=500, tol=1e-12, tol_relative=True,
+                          refuse_ratio=1e30)
+    np.testing.assert_allclose(out.dx_cam, dx_cam_d, rtol=1e-4, atol=1e-7)
+    # With an absurd absolute tol the loop would exit immediately; the
+    # relative mode must actually iterate.
+    assert int(out.iterations) > 0
+
+
 def test_refuse_ratio_guard():
     # With the reference's default refuse_ratio=1.0, the solver must stop
     # as soon as rho is non-decreasing and restore the best iterate
