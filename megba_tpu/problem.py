@@ -22,7 +22,6 @@ from __future__ import annotations
 import enum
 from typing import Dict, List, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -119,18 +118,35 @@ class BaseEdge:
         return bal_residual(camera, point, self.get_measurement())
 
 
-def _edge_residual_fn(proto: BaseEdge):
-    """Build (camera, point, obs) -> r from a prototype edge's forward()."""
+_EDGE_ENGINE_CACHE: Dict[type, object] = {}
 
-    def fn(camera, point, obs):
-        proto._traced_estimations = [camera, point]
-        proto._traced_measurement = obs
-        try:
-            return proto.forward()
-        finally:
-            proto._traced_estimations = None
-            proto._traced_measurement = None
 
+def _edge_residual_jac_fn(proto: BaseEdge):
+    """Vectorised autodiff engine for a custom edge class's forward().
+
+    Cached per edge CLASS: forward() must be pure jnp math over the
+    traced vertex estimations/measurement (one prototype stands in for
+    every edge — per-instance attributes beyond vertices/measurement are
+    not vectorised), so the class fully determines the engine, and
+    caching keeps jit compilations warm across solves instead of leaking
+    one executable per prototype closure.
+    """
+    cls = type(proto)
+    fn = _EDGE_ENGINE_CACHE.get(cls)
+    if fn is None:
+
+        def residual(camera, point, obs, proto=proto):
+            proto._traced_estimations = [camera, point]
+            proto._traced_measurement = obs
+            try:
+                return proto.forward()
+            finally:
+                proto._traced_estimations = None
+                proto._traced_measurement = None
+
+        fn = make_residual_jacobian_fn(
+            residual_fn=residual, mode=JacobianMode.AUTODIFF)
+        _EDGE_ENGINE_CACHE[cls] = fn
     return fn
 
 
@@ -233,10 +249,7 @@ class BaseProblem:
             and self._edge_type.forward is not BaseEdge.forward
         )
         if custom_forward:
-            proto = self._edges[0]
-            residual_jac_fn = make_residual_jacobian_fn(
-                residual_fn=_edge_residual_fn(proto), mode=JacobianMode.AUTODIFF
-            )
+            residual_jac_fn = _edge_residual_jac_fn(self._edges[0])
         else:
             residual_jac_fn = make_residual_jacobian_fn(mode=opt.jacobian_mode)
 

@@ -1,0 +1,110 @@
+"""Edge cases the reference's design makes impossible or implicit.
+
+The reference's CSR build requires ONE edge per (camera, point) pair
+(race-freedom of makeHpl relies on it, build_linear_system.cu:55-76);
+segment_sum has no such constraint — duplicate pairs must simply
+accumulate.  Facade misuse must fail loudly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu import (
+    BaseEdge,
+    BaseProblem,
+    CameraVertex,
+    PointVertex,
+    ProblemOption,
+)
+from megba_tpu.common import AlgoOption, JacobianMode, SolverOption
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.linear_system import build_schur_system, weight_system_inputs
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+
+def test_duplicate_camera_point_pairs_accumulate():
+    # Two identical edges must contribute exactly twice one edge's blocks.
+    s = make_synthetic_bal(num_cameras=3, num_points=10, obs_per_point=2, seed=0)
+    cams, pts = jnp.asarray(s.cameras0), jnp.asarray(s.points0)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    def build(cam_idx, pt_idx, obs):
+        cam_idx, pt_idx, obs = (jnp.asarray(cam_idx), jnp.asarray(pt_idx),
+                                jnp.asarray(obs))
+        r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+        r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx,
+                                         jnp.ones(len(obs)))
+        return build_schur_system(r, Jc, Jp, cam_idx, pt_idx, 3, 10)
+
+    one = build(s.cam_idx[:1], s.pt_idx[:1], s.obs[:1])
+    two = build(np.repeat(s.cam_idx[:1], 2), np.repeat(s.pt_idx[:1], 2),
+                np.repeat(s.obs[:1], 2, axis=0))
+    c = int(s.cam_idx[0])
+    np.testing.assert_allclose(np.asarray(two.Hpp[c]),
+                               2 * np.asarray(one.Hpp[c]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(two.g_cam[c]),
+                               2 * np.asarray(one.g_cam[c]), rtol=1e-12)
+
+
+def test_facade_rejects_unknown_vertex_edge():
+    pb = BaseProblem()
+    c = CameraVertex(np.zeros(9))
+    p = PointVertex(np.zeros(3))
+    pb.append_vertex(0, c)  # p NOT appended
+    with pytest.raises(ValueError, match="not in the problem"):
+        pb.append_edge(BaseEdge([c, p], measurement=np.zeros(2)))
+
+
+def test_facade_rejects_missing_measurement():
+    pb = BaseProblem()
+    c, p = CameraVertex(np.zeros(9)), PointVertex(np.zeros(3))
+    pb.append_vertex(0, c)
+    pb.append_vertex(1, p)
+    with pytest.raises(ValueError, match="measurement"):
+        pb.append_edge(BaseEdge([c, p]))
+
+
+def test_facade_rejects_duplicate_vertex_id():
+    pb = BaseProblem()
+    pb.append_vertex(7, CameraVertex(np.zeros(9)))
+    with pytest.raises(ValueError, match="duplicate"):
+        pb.append_vertex(7, PointVertex(np.zeros(3)))
+
+
+def test_erase_camera_vertex():
+    s = make_synthetic_bal(num_cameras=4, num_points=20, obs_per_point=2, seed=3)
+    pb = BaseProblem(ProblemOption(
+        algo_option=AlgoOption(max_iter=8, epsilon1=1e-9, epsilon2=1e-12),
+        solver_option=SolverOption(max_iter=60, tol=1e-8, tol_relative=True,
+                                   refuse_ratio=1e30)))
+    cams = [CameraVertex(c) for c in s.cameras0]
+    pts = [PointVertex(p) for p in s.points0]
+    for i, v in enumerate(cams):
+        pb.append_vertex(i, v)
+    for j, v in enumerate(pts):
+        pb.append_vertex(100 + j, v)
+    for c, p, uv in zip(s.cam_idx, s.pt_idx, s.obs):
+        pb.append_edge(BaseEdge([cams[c], pts[p]], measurement=uv))
+    pb.erase_vertex(2)  # a camera this time
+    assert all(e.vertices[0] is not cams[2] for e in pb._edges)
+    res = pb.solve()
+    assert np.isfinite(float(res.cost))
+
+
+def test_all_vertices_fixed_is_a_noop_solve():
+    s = make_synthetic_bal(num_cameras=3, num_points=12, obs_per_point=2, seed=4)
+    pb = BaseProblem(ProblemOption(
+        algo_option=AlgoOption(max_iter=5),
+        solver_option=SolverOption(max_iter=30)))
+    cams = [CameraVertex(c, fixed=True) for c in s.cameras0]
+    pts = [PointVertex(p, fixed=True) for p in s.points0]
+    for i, v in enumerate(cams):
+        pb.append_vertex(i, v)
+    for j, v in enumerate(pts):
+        pb.append_vertex(100 + j, v)
+    for c, p, uv in zip(s.cam_idx, s.pt_idx, s.obs):
+        pb.append_edge(BaseEdge([cams[c], pts[p]], measurement=uv))
+    res = pb.solve()
+    np.testing.assert_allclose(float(res.cost), float(res.initial_cost), rtol=1e-12)
+    np.testing.assert_array_equal(cams[0].estimation, s.cameras0[0])
