@@ -40,8 +40,6 @@ def run_example(dtype, jacobian_mode, compute_kind, argv=None) -> float:
     if np.dtype(dtype) == np.float64:
         jax.config.update("jax_enable_x64", True)
 
-
-
     from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
     from megba_tpu.io.bal import load_bal
     from megba_tpu.io.synthetic import make_synthetic_bal

@@ -20,7 +20,7 @@ JetVector/eigen_injector operator stack.
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -118,7 +118,7 @@ class BaseEdge:
         return bal_residual(camera, point, self.get_measurement())
 
 
-_EDGE_ENGINE_CACHE: Dict[type, object] = {}
+_EDGE_ENGINE_CACHE: Dict[type, Callable] = {}
 
 
 def _edge_residual_jac_fn(proto: BaseEdge):

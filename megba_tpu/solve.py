@@ -63,9 +63,11 @@ def flat_solve(
     selects the mesh; jitted programs are cached per configuration.
     """
     dtype = np.dtype(option.dtype)
-    cameras = np.asarray(cameras).astype(dtype)
-    points = np.asarray(points).astype(dtype)
-    obs = np.asarray(obs).astype(dtype)
+    # copy=False: at Final-13682 scale obs alone is ~70MB; don't duplicate
+    # arrays that are already the right dtype.
+    cameras = np.asarray(cameras).astype(dtype, copy=False)
+    points = np.asarray(points).astype(dtype, copy=False)
+    obs = np.asarray(obs).astype(dtype, copy=False)
     cam_idx = np.asarray(cam_idx)
     pt_idx = np.asarray(pt_idx)
 
@@ -78,7 +80,7 @@ def flat_solve(
             sqrt_info = np.asarray(sqrt_info)[perm]
 
     sqrt_info_j = None if sqrt_info is None else jnp.asarray(
-        np.asarray(sqrt_info).astype(dtype))
+        np.asarray(sqrt_info).astype(dtype, copy=False))
     cam_fixed_j = None if cam_fixed is None else jnp.asarray(cam_fixed)
     pt_fixed_j = None if pt_fixed is None else jnp.asarray(pt_fixed)
 
