@@ -20,10 +20,12 @@ on-device: no host-blocking residual-norm or dot reductions
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from megba_tpu.common import ComputeKind, ProblemOption
 from megba_tpu.linear_system.builder import (
@@ -35,6 +37,23 @@ from megba_tpu.ops.robust import RobustKind, robustify
 from megba_tpu.solver.pcg import HI, schur_pcg_solve
 
 _TINY = 1e-30
+
+# Host-side clock for verbose per-iteration lines; reset by iteration 0's
+# callback so elapsed-ms is per-solve even though jitted programs (and
+# this closure) are cached across solves.
+_VERBOSE_CLOCK = {"t0": 0.0}
+
+
+def _emit_verbose_line(k, c, a, p):
+    now = time.perf_counter()
+    if int(k) == 0:
+        _VERBOSE_CLOCK["t0"] = now
+    dt = (now - _VERBOSE_CLOCK["t0"]) * 1e3
+    print(
+        f"iter {int(k)}: cost {float(c):.6e} "
+        f"log10 {np.log10(max(float(c), 1e-300)):.3f} "
+        f"accept {bool(a)} pcg_iters {int(p)} "
+        f"elapsed {dt:.1f} ms", flush=True)
 
 
 @jax.tree_util.register_dataclass
@@ -216,10 +235,13 @@ def lm_solve(
         )
         if verbose:
             def _print(args):
-                k, c, a, p = args
-                jax.debug.print(
-                    "iter {k}: cost {c:.6e} log10 {l:.3f} accept {a} pcg_iters {p}",
-                    k=k, c=c, l=jnp.log10(c), a=a, p=p)
+                # Host callback: prints the reference's per-iteration line
+                # (cost, log10 cost, elapsed ms — lm_algo.cu:149-162).
+                # Elapsed is measured host-side from this solve's first
+                # iteration callback (iteration 0 resets the clock — the
+                # jitted program is cached across solves, so a trace-time
+                # baseline would be frozen at the FIRST solve's start).
+                jax.debug.callback(_emit_verbose_line, *args)
 
             args = (s["k"], cost_new, accept, pcg.iterations)
             if axis_name is None:

@@ -63,6 +63,16 @@ def flat_solve(
     selects the mesh; jitted programs are cached per configuration.
     """
     dtype = np.dtype(option.dtype)
+    if dtype == np.float64 and not jax.config.jax_enable_x64:
+        import warnings
+
+        warnings.warn(
+            "ProblemOption(dtype=float64) but jax x64 is disabled — JAX "
+            "will silently compute in float32. Call "
+            'jax.config.update("jax_enable_x64", True) first (CPU '
+            "recommended; TPU float64 is emulated) or set dtype=float32.",
+            stacklevel=2,
+        )
     # copy=False: at Final-13682 scale obs alone is ~70MB; don't duplicate
     # arrays that are already the right dtype.
     cameras = np.asarray(cameras).astype(dtype, copy=False)
