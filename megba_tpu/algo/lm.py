@@ -34,7 +34,7 @@ from megba_tpu.linear_system.builder import (
     weight_system_inputs,
 )
 from megba_tpu.ops.robust import RobustKind, robustify
-from megba_tpu.solver.pcg import HI, schur_pcg_solve
+from megba_tpu.solver.pcg import HI, plain_pcg_solve, schur_pcg_solve
 
 _TINY = 1e-30
 
@@ -159,8 +159,10 @@ def lm_solve(
     def cond(s):
         return (s["k"] < algo_opt.max_iter) & (~s["stop"])
 
+    pcg_solve = schur_pcg_solve if option.use_schur else plain_pcg_solve
+
     def body(s):
-        pcg = schur_pcg_solve(
+        pcg = pcg_solve(
             s["system"], s["Jc"], s["Jp"], cam_idx, pt_idx, s["region"],
             max_iter=solver_opt.max_iter, tol=solver_opt.tol,
             refuse_ratio=solver_opt.refuse_ratio,

@@ -142,10 +142,9 @@ class ProblemOption:
         if self.robust_kind != RobustKind.NONE and not self.robust_delta > 0:
             raise ValueError(
                 f"robust_delta must be > 0, got {self.robust_delta}")
-        if not self.use_schur:
-            # Parity note: the reference also only implements the Schur path
-            # (every useSchur=false branch is a TODO, base_problem.cpp:112-123).
-            raise NotImplementedError("only the Schur path is implemented")
+        # use_schur=False selects the plain full-system PCG
+        # (solver.pcg.plain_pcg_solve) — the path the reference left as a
+        # TODO (base_problem.cpp:112-123) but this framework implements.
 
 
 @dataclasses.dataclass
@@ -170,9 +169,13 @@ def validate_options(option: ProblemOption) -> None:
     """
     if option.algo_kind != AlgoKind.LM:
         raise ValueError("only AlgoKind.LM is supported")
-    if option.linear_system_kind != LinearSystemKind.SCHUR:
-        raise ValueError("only LinearSystemKind.SCHUR is supported")
+    if option.use_schur and option.linear_system_kind != LinearSystemKind.SCHUR:
+        raise ValueError("use_schur=True requires LinearSystemKind.SCHUR")
     if option.solver_option.solver_kind != SolverKind.PCG:
         raise ValueError("only SolverKind.PCG is supported")
+    if not option.use_schur and option.mixed_precision_pcg:
+        raise ValueError(
+            "mixed_precision_pcg is only implemented for the Schur solver "
+            "(use_schur=True)")
     if np.dtype(option.dtype) not in DTYPE_TO_JAX:
         raise ValueError(f"unsupported dtype {option.dtype}")

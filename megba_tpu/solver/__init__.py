@@ -1,4 +1,4 @@
-from megba_tpu.solver.pcg import PCGResult, block_inv, block_matvec, schur_pcg_solve
+from megba_tpu.solver.pcg import PCGResult, block_inv, block_matvec, plain_pcg_solve, schur_pcg_solve
 from megba_tpu.solver.dense import dense_reference_solve
 
 __all__ = [
@@ -6,5 +6,6 @@ __all__ = [
     "block_inv",
     "block_matvec",
     "dense_reference_solve",
+    "plain_pcg_solve",
     "schur_pcg_solve",
 ]

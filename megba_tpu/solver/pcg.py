@@ -180,6 +180,126 @@ def make_coupling_matvecs(
     return hpl, hlp
 
 
+def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative):
+    """Preconditioned CG over an arbitrary pytree "vector".
+
+    One implementation of the reference's stopping + refuse semantics
+    (|rho| < tol exit, schur_pcg_solver.cu:406-407; rho > refuse_ratio *
+    min(rho) -> restore best iterate, :288-296) shared by the Schur
+    solver (vector = one array) and the plain full-system solver
+    (vector = a (camera, point) pair).  Returns (x, iterations, rho).
+    """
+    tm = jax.tree_util.tree_map
+
+    def tdot(a, c):
+        return jax.tree_util.tree_reduce(
+            lambda acc, v: acc + v, tm(_dot, a, c))
+
+    def axpy(a, x, y):  # y + a * x, leafwise
+        return tm(lambda xi, yi: yi + a * xi, x, y)
+
+    def select(pred, a, c):
+        return tm(lambda ai, ci: jnp.where(pred, ai, ci), a, c)
+
+    x0 = tm(jnp.zeros_like, b)
+    r0 = b  # x0 = 0 so r0 = b - A x0 = b
+    z0 = precond(r0)
+    rho0 = tdot(r0, z0)
+    # Reference semantics: absolute threshold on rho; tol_relative scales
+    # it by rho0, floored so a zero RHS exits immediately instead of
+    # iterating into 0/0 NaNs.
+    threshold = (
+        jnp.maximum(tol * jnp.abs(rho0), jnp.asarray(_TINY_RHO, rho0.dtype))
+        if tol_relative else tol
+    )
+
+    state0 = (jnp.int32(0), x0, r0, z0, rho0, jnp.abs(rho0), x0,
+              jnp.bool_(False))
+
+    def cond(state):
+        k, _, _, _, rho, _, _, refused = state
+        return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
+
+    def body(state):
+        k, x, r, p, rho, rho_min, x_best, _ = state
+        q = matvec(p)
+        alpha = rho / tdot(p, q)
+        x = axpy(alpha, p, x)
+        r = axpy(-alpha, q, r)
+        z = precond(r)
+        rho_new = tdot(r, z)
+        refused = jnp.abs(rho_new) > refuse_ratio * rho_min
+        improved = jnp.abs(rho_new) < rho_min
+        rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
+        x_best = select(improved, x, x_best)
+        beta = rho_new / rho
+        p = axpy(beta, p, z)
+        return (k + 1, x, r, p, rho_new, rho_min, x_best, refused)
+
+    k, x, _, _, rho, _, x_best, refused = jax.lax.while_loop(cond, body, state0)
+    return select(~refused, x, x_best), k, rho
+
+
+def plain_pcg_solve(
+    system: SchurSystem,
+    Jc: jax.Array,
+    Jp: jax.Array,
+    cam_idx: jax.Array,
+    pt_idx: jax.Array,
+    region: jax.Array,
+    max_iter: int = 100,
+    tol: float = 1e-1,
+    refuse_ratio: float = 1.0,
+    tol_relative: bool = False,
+    compute_kind: ComputeKind = ComputeKind.IMPLICIT,
+    axis_name: Optional[str] = None,
+    mixed_precision: bool = False,
+    cam_sorted: bool = False,
+) -> PCGResult:
+    """Solve the damped FULL system H dx = g without Schur reduction.
+
+    The path the reference left as `// TODO(Jie Ren)` behind
+    `useSchur=false` (base_problem.cpp:112-123) — implemented here: PCG
+    over the concatenated (camera, point) unknowns with the block-diagonal
+    H as preconditioner, coupling applied by the same matrix-free /
+    per-edge-block matvecs as the Schur solver.  Useful when the point
+    blocks are ill-conditioned enough that the Schur complement's
+    Hll^-1 amplifies error, and as an independent cross-check of the
+    Schur pipeline (both solve the same damped normal equations).
+    """
+    num_cameras = system.Hpp.shape[0]
+    num_points = system.Hll.shape[0]
+
+    if mixed_precision:
+        raise NotImplementedError(
+            "mixed_precision is only implemented for the Schur solver")
+
+    Hpp_d = damp_blocks(system.Hpp, region)
+    Hll_d = damp_blocks(system.Hll, region)
+    Minv_c = block_inv(Hpp_d)
+    Minv_p = block_inv(Hll_d)
+
+    hpl, hlp = make_coupling_matvecs(
+        system.W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
+        compute_kind, axis_name, cam_sorted=cam_sorted,
+    )
+
+    def h_matvec(x):
+        # [Hpp Hpl; Hlp Hll] applied blockwise      [2 psums]
+        xc, xp = x
+        return (block_matvec(Hpp_d, xc) + hpl(xp),
+                hlp(xc) + block_matvec(Hll_d, xp))
+
+    def precond(r):
+        rc, rp = r
+        return block_matvec(Minv_c, rc), block_matvec(Minv_p, rp)
+
+    (xc, xp), k, rho = _pcg_core(
+        h_matvec, precond, (system.g_cam, system.g_pt),
+        max_iter, tol, refuse_ratio, tol_relative)
+    return PCGResult(dx_cam=xc, dx_pt=xp, iterations=k, rho=rho)
+
+
 def schur_pcg_solve(
     system: SchurSystem,
     Jc: jax.Array,
@@ -256,47 +376,9 @@ def schur_pcg_solve(
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
     v = g_cam - hpl(block_matvec(Hll_inv, g_pt))
 
-    x0 = jnp.zeros_like(v)
-    r0 = v  # x0 = 0 so r0 = v - S x0 = v
-    z0 = block_matvec(Minv, r0)
-    rho0 = _dot(r0, z0)
-    # Reference semantics: absolute threshold on rho
-    # (schur_pcg_solver.cu:406-407).  tol_relative scales it by rho0 —
-    # floored so a zero gradient (rho0 == 0) exits immediately instead of
-    # iterating into 0/0 NaNs.
-    threshold = (
-        jnp.maximum(tol * jnp.abs(rho0), jnp.asarray(_TINY_RHO, rho0.dtype))
-        if tol_relative else tol
-    )
-
-    # Carry: (k, x, r, p, rho, rho_min, x_best, refused)
-    state0 = (
-        jnp.int32(0), x0, r0, z0, rho0, jnp.abs(rho0), x0,
-        jnp.bool_(False),
-    )
-
-    def cond(state):
-        k, _, _, _, rho, _, _, refused = state
-        return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
-
-    def body(state):
-        k, x, r, p, rho, rho_min, x_best, _ = state
-        q = s_matvec(p)
-        alpha = rho / _dot(p, q)
-        x = x + alpha * p
-        r = r - alpha * q
-        z = block_matvec(Minv, r)
-        rho_new = _dot(r, z)
-        refused = jnp.abs(rho_new) > refuse_ratio * rho_min
-        improved = jnp.abs(rho_new) < rho_min
-        rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
-        x_best = jnp.where(improved, x, x_best)
-        beta = rho_new / rho
-        p = z + beta * p
-        return (k + 1, x, r, p, rho_new, rho_min, x_best, refused)
-
-    k, x, _, _, rho, _, x_best, refused = jax.lax.while_loop(cond, body, state0)
-    x = jnp.where(refused, x_best, x)
+    x, k, rho = _pcg_core(
+        s_matvec, lambda r: block_matvec(Minv, r), v,
+        max_iter, tol, refuse_ratio, tol_relative)
 
     # Back-substitute the point update       [1 psum]
     dx_pt = block_matvec(Hll_inv, g_pt - hlp(x))

@@ -1,0 +1,77 @@
+"""Plain (non-Schur) full-system PCG — the path the reference left TODO."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.algo import lm_solve
+from megba_tpu.common import (
+    AlgoOption,
+    ComputeKind,
+    JacobianMode,
+    LinearSystemKind,
+    ProblemOption,
+    SolverOption,
+    validate_options,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.solver import dense_reference_solve, plain_pcg_solve
+from tests.test_solver import build_test_system
+
+
+@pytest.mark.parametrize("compute_kind", [ComputeKind.IMPLICIT, ComputeKind.EXPLICIT])
+def test_plain_pcg_matches_dense(compute_kind):
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system(compute_kind=compute_kind)
+    region = jnp.asarray(100.0)
+    dx_cam_d, dx_pt_d = dense_reference_solve(system, Jc, Jp, cam_idx, pt_idx, region)
+    out = plain_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, region,
+                          max_iter=2000, tol=1e-14, tol_relative=True,
+                          refuse_ratio=1e30, compute_kind=compute_kind)
+    np.testing.assert_allclose(out.dx_cam, dx_cam_d, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(out.dx_pt, dx_pt_d, rtol=1e-5, atol=1e-7)
+
+
+def test_plain_lm_converges_and_matches_schur():
+    s = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                           seed=0, param_noise=4e-2, pixel_noise=0.3)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    args = (jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+            jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)))
+
+    def opt(use_schur):
+        return ProblemOption(
+            use_schur=use_schur,
+            algo_option=AlgoOption(max_iter=25, epsilon1=1e-9, epsilon2=1e-12),
+            solver_option=SolverOption(max_iter=800, tol=1e-12,
+                                       tol_relative=True, refuse_ratio=1e30))
+
+    schur = lm_solve(f, *args, opt(True))
+    plain = lm_solve(f, *args, opt(False))
+    # Both solve the same damped normal equations; final costs agree.
+    np.testing.assert_allclose(float(plain.cost), float(schur.cost), rtol=1e-6)
+    assert int(plain.accepted) > 0
+
+
+def test_plain_mode_option_validation():
+    # use_schur=False no longer raises, and tolerates BASE linear system.
+    o = ProblemOption(use_schur=False,
+                      linear_system_kind=LinearSystemKind.BASE_LINEAR_SYSTEM)
+    validate_options(o)
+    with pytest.raises(ValueError, match="use_schur=True requires"):
+        validate_options(ProblemOption(
+            use_schur=True,
+            linear_system_kind=LinearSystemKind.BASE_LINEAR_SYSTEM))
+
+
+def test_plain_rejects_mixed_precision():
+    # Upfront at option validation...
+    with pytest.raises(ValueError, match="mixed_precision_pcg"):
+        validate_options(ProblemOption(use_schur=False, mixed_precision_pcg=True))
+    # ...and at the solver for direct callers.
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system()
+    with pytest.raises(NotImplementedError, match="mixed_precision"):
+        plain_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, jnp.asarray(10.0),
+                        mixed_precision=True)
