@@ -61,14 +61,20 @@ class SolverKind(enum.Enum):
 class JacobianMode(enum.Enum):
     """How per-edge Jacobians are produced.
 
-    AUTODIFF = forward-mode `jax.jacfwd` under `jax.vmap` (the TPU-native
-    equivalent of the reference's JetVector operator layer).
+    AUTODIFF = reverse-mode `jax.vjp` under `jax.vmap`: one pullback per
+    residual component (od=2 passes for BAL) instead of one JVP per
+    parameter (12) — ~3x faster than forward mode for od << cd+pd.  The
+    reference's JetVector layer is forward-mode by construction; picking
+    the cheaper direction is a deliberate departure (same Jacobian).
+    AUTODIFF_FORWARD = `jax.jacfwd` under vmap (the reference-faithful
+    direction; useful for residuals with od >= param count).
     ANALYTICAL = hand-derived closed-form Jacobian (the equivalent of
     reference src/geo/analytical_derivatives.cu).
     """
 
     AUTODIFF = 0
     ANALYTICAL = 1
+    AUTODIFF_FORWARD = 2
 
 
 @dataclasses.dataclass(frozen=True)

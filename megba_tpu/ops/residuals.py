@@ -5,9 +5,11 @@ JetVector forward-mode dual numbers (reference include/operator/jet_vector.h,
 src/operator/jet_vector_math_impl.cu — ~40 CUDA kernels), the Eigen
 injector (include/operator/eigen_injector.h) and the hand-fused geo kernels
 all collapse into ONE jitted function: a per-edge residual written in plain
-JAX numpy, vmapped over the edge axis, with Jacobians from `jax.jacfwd`
-(AUTODIFF mode) or a hand-derived closed form (ANALYTICAL mode, the
-equivalent of reference src/geo/analytical_derivatives.cu:162-322).
+JAX numpy, vmapped over the edge axis, with Jacobians from reverse-mode
+`jax.vjp` (AUTODIFF — od pullbacks, the cheap direction for short
+residuals), forward-mode `jax.jacfwd` (AUTODIFF_FORWARD — the
+reference-faithful direction), or a hand-derived closed form (ANALYTICAL,
+the equivalent of reference src/geo/analytical_derivatives.cu:162-322).
 
 In the reference every JetVector op is its own kernel launch
 (jet_vector.cpp:207-224); here XLA fuses the whole forward pass into a
@@ -123,9 +125,11 @@ def make_residual_jacobian_fn(
     Returns fn(cam_params[nE,cd], pt_params[nE,pd], obs[nE,od])
       -> (r[nE,od], Jc[nE,od,cd], Jp[nE,od,pd]).
 
-    AUTODIFF mode is the TPU equivalent of the reference's JetVector
-    forward pass (§3.4 of SURVEY.md); ANALYTICAL uses a closed-form
-    Jacobian function (default: the BAL one above).
+    AUTODIFF (reverse-mode vjp) and AUTODIFF_FORWARD (jacfwd — the
+    direction the reference's JetVector pass uses, SURVEY.md §3.4)
+    compute the same Jacobian; ANALYTICAL uses a closed-form function
+    (default: the BAL one above).  See common.JacobianMode for when each
+    direction wins.
     """
     if mode == JacobianMode.ANALYTICAL:
         fn = analytical_fn
@@ -137,9 +141,26 @@ def make_residual_jacobian_fn(
             fn = bal_residual_jacobian_analytical
         return jax.vmap(fn, in_axes=(0, 0, 0))
 
+    if mode == JacobianMode.AUTODIFF_FORWARD:
+
+        def value_and_jac_fwd(camera, point, obs):
+            r = residual_fn(camera, point, obs)
+            Jc, Jp = jax.jacfwd(residual_fn, argnums=(0, 1))(camera, point, obs)
+            return r, Jc, Jp
+
+        return jax.vmap(value_and_jac_fwd, in_axes=(0, 0, 0))
+
     def value_and_jac(camera, point, obs):
-        r = residual_fn(camera, point, obs)
-        Jc, Jp = jax.jacfwd(residual_fn, argnums=(0, 1))(camera, point, obs)
+        # Reverse mode: od pullbacks instead of (cd+pd) pushforwards —
+        # the cheap direction for short residuals (see JacobianMode).
+        r, pull = jax.vjp(lambda c, p: residual_fn(c, p, obs), camera, point)
+        # Stamp the primal's varying-axes type onto the cotangent basis so
+        # the pullback is well-typed inside shard_map.  Routing through
+        # isfinite keeps the stamp exactly zero even when a residual
+        # component is inf/NaN (0*inf would poison the whole basis).
+        stamp = (jnp.isfinite(r).astype(r.dtype) * 0.0)[None, :]
+        eye = jnp.eye(r.shape[0], dtype=r.dtype) + stamp
+        Jc, Jp = jax.vmap(pull)(eye)
         return r, Jc, Jp
 
     return jax.vmap(value_and_jac, in_axes=(0, 0, 0))

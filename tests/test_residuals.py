@@ -58,6 +58,21 @@ def test_jacobian_finite_difference():
         np.testing.assert_allclose(Jp[:, i], fd, rtol=1e-4, atol=1e-4)
 
 
+def test_forward_and_reverse_autodiff_agree():
+    r = np.random.default_rng(5)
+    edges = [random_edge(r) for _ in range(8)]
+    cams = jnp.stack([e[0] for e in edges])
+    pts = jnp.stack([e[1] for e in edges])
+    obs = jnp.stack([e[2] for e in edges])
+    fa = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    fb = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF_FORWARD)
+    ra, Jca, Jpa = fa(cams, pts, obs)
+    rb, Jcb, Jpb = fb(cams, pts, obs)
+    np.testing.assert_allclose(ra, rb, rtol=1e-12)
+    np.testing.assert_allclose(Jca, Jcb, rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(Jpa, Jpb, rtol=1e-10, atol=1e-12)
+
+
 def test_vectorised_modes_agree():
     r = np.random.default_rng(2)
     edges = [random_edge(r) for _ in range(16)]
