@@ -17,7 +17,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.algo.lm import LMResult
 from megba_tpu.common import ProblemOption
 from megba_tpu.utils.checkpoint import load_state, save_state
 
@@ -29,18 +29,21 @@ def solve_checkpointed(
     obs,
     cam_idx,
     pt_idx,
-    mask,
     option: ProblemOption,
     checkpoint_path: str,
     checkpoint_every: int = 5,
     verbose: bool = False,
-    **lm_kwargs,
+    **solve_kwargs,
 ) -> LMResult:
     """Run the LM solve, snapshotting every `checkpoint_every` iterations.
 
     If `checkpoint_path` exists, resumes from it (same problem assumed).
-    Extra kwargs flow to `lm_solve` (sqrt_info, cam_fixed, cam_sorted...).
+    Runs through the shared flat_solve pipeline, so all chunks of the
+    same configuration reuse ONE compiled program (the resume state rides
+    as dynamic operands).  Extra kwargs flow to `solve.flat_solve`
+    (sqrt_info, cam_fixed, pt_fixed, pallas_plan...).
     """
+    from megba_tpu.solve import flat_solve
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     total = option.algo_option.max_iter
@@ -70,10 +73,10 @@ def solve_checkpointed(
             option,
             algo_option=dataclasses.replace(option.algo_option, max_iter=chunk),
         )
-        result = lm_solve(
-            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
+        result = flat_solve(
+            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx,
             chunk_option, verbose=verbose,
-            initial_region=region, initial_v=v, **lm_kwargs)
+            initial_region=region, initial_v=v, **solve_kwargs)
         cameras, points = result.cameras, result.points
         region = result.region
         v = result.v
@@ -94,12 +97,12 @@ def solve_checkpointed(
             break  # converged (possibly exactly on the chunk boundary)
 
     if result is None:  # resumed at/past total (or converged): evaluate state
-        result = lm_solve(
-            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
+        result = flat_solve(
+            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx,
             dataclasses.replace(
                 option,
                 algo_option=dataclasses.replace(option.algo_option, max_iter=0)),
-            initial_region=region, initial_v=v, verbose=verbose, **lm_kwargs)
+            initial_region=region, initial_v=v, verbose=verbose, **solve_kwargs)
         if first_cost is None:
             first_cost = result.initial_cost
         if already_stopped:

@@ -40,7 +40,9 @@ _TINY = 1e-30
 
 # Host-side clock for verbose per-iteration lines; reset by iteration 0's
 # callback so elapsed-ms is per-solve even though jitted programs (and
-# this closure) are cached across solves.
+# this closure) are cached across solves.  Known limits: concurrent
+# verbose solves share this clock (their lines interleave anyway), and a
+# chunked solve restarts it per chunk — elapsed is per-chunk there.
 _VERBOSE_CLOCK = {"t0": 0.0}
 
 
@@ -204,7 +206,9 @@ def lm_solve(
         r_n, Jc_n, Jp_n, system_n, cost_new, wcost_new = linearize(cams_new, pts_new)
         rho = (cost_new - s["cost"]) / denominator
 
-        accept = cost_new < s["cost"]
+        # Reference lm_algo.cu breaks BEFORE edges.update() when the
+        # step-size test fires — a converged step is never applied.
+        accept = (cost_new < s["cost"]) & (~converged)
 
         g_inf = jnp.maximum(jnp.max(jnp.abs(system_n.g_cam)),
                             jnp.max(jnp.abs(system_n.g_pt)))

@@ -103,6 +103,8 @@ def distributed_lm_solve(
     verbose: bool = False,
     cam_sorted: bool = False,
     pallas_plan=None,
+    initial_region=None,
+    initial_v=None,
 ) -> LMResult:
     """Run the full LM solve SPMD over the mesh's edge axis.
 
@@ -125,8 +127,12 @@ def distributed_lm_solve(
 
     # Optional operands can't be None inside shard_map specs; pass the
     # present ones positionally with matching specs.
-    args = [cameras, points, obs, cam_idx, pt_idx, mask]
-    in_specs = [rep, rep, edge, edge, edge, edge]
+    dtype = cameras.dtype
+    ir = option.algo_option.initial_region if initial_region is None else initial_region
+    iv = 2.0 if initial_v is None else initial_v
+    args = [cameras, points, obs, cam_idx, pt_idx, mask,
+            jnp.asarray(ir, dtype), jnp.asarray(iv, dtype)]
+    in_specs = [rep, rep, edge, edge, edge, edge, rep, rep]
     optional = [
         ("sqrt_info", sqrt_info, edge),
         ("cam_fixed", cam_fixed, rep),
@@ -156,11 +162,13 @@ def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose
     this purpose.
     """
 
-    def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
+    def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
+           *extras):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
-            pallas_plan=pallas_plan, **dict(zip(keys, extras)))
+            pallas_plan=pallas_plan, initial_region=init_region,
+            initial_v=init_v, **dict(zip(keys, extras)))
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
     return jax.jit(sharded)

@@ -118,36 +118,29 @@ class BaseEdge:
         return bal_residual(camera, point, self.get_measurement())
 
 
-_EDGE_ENGINE_CACHE: Dict[type, Callable] = {}
-
-
 def _edge_residual_jac_fn(proto: BaseEdge):
-    """Vectorised autodiff engine for a custom edge class's forward().
+    """Vectorised autodiff engine for a custom edge's forward().
 
-    Cached per edge CLASS: forward() must be pure jnp math over the
-    traced vertex estimations/measurement (one prototype stands in for
-    every edge — per-instance attributes beyond vertices/measurement are
-    not vectorised), so the class fully determines the engine, and
-    caching keeps jit compilations warm across solves instead of leaking
-    one executable per prototype closure.
+    One prototype edge stands in for every edge during tracing, so
+    anything forward() reads beyond the traced vertex estimations and
+    measurement (e.g. a per-instance constant) is baked in from THIS
+    prototype.  The engine is therefore cached per problem (see
+    BaseProblem._engine), never shared across problems whose prototypes
+    might differ — a class-level cache was reproduced serving one
+    problem's constants to another.
     """
-    cls = type(proto)
-    fn = _EDGE_ENGINE_CACHE.get(cls)
-    if fn is None:
 
-        def residual(camera, point, obs, proto=proto):
-            proto._traced_estimations = [camera, point]
-            proto._traced_measurement = obs
-            try:
-                return proto.forward()
-            finally:
-                proto._traced_estimations = None
-                proto._traced_measurement = None
+    def residual(camera, point, obs, proto=proto):
+        proto._traced_estimations = [camera, point]
+        proto._traced_measurement = obs
+        try:
+            return proto.forward()
+        finally:
+            proto._traced_estimations = None
+            proto._traced_measurement = None
 
-        fn = make_residual_jacobian_fn(
-            residual_fn=residual, mode=JacobianMode.AUTODIFF)
-        _EDGE_ENGINE_CACHE[cls] = fn
-    return fn
+    return make_residual_jacobian_fn(
+        residual_fn=residual, mode=JacobianMode.AUTODIFF)
 
 
 class BaseProblem:
@@ -165,8 +158,10 @@ class BaseProblem:
         self.option = option or ProblemOption()
         validate_options(self.option)
         self._vertices: Dict[int, BaseVertex] = {}
+        self._vertex_ids: set = set()  # id(vertex) for O(1) membership
         self._edges: List[BaseEdge] = []
         self._edge_type: Optional[type] = None
+        self._engine: Optional[Callable] = None  # cached custom-edge engine
         self.result: Optional[LMResult] = None
 
     # -- graph construction ------------------------------------------------
@@ -174,6 +169,7 @@ class BaseProblem:
         if vertex_id in self._vertices:
             raise ValueError(f"duplicate vertex id {vertex_id}")
         self._vertices[vertex_id] = vertex
+        self._vertex_ids.add(id(vertex))
 
     def append_edge(self, edge: BaseEdge) -> None:
         # Homogeneous edge types only, like the reference's typeid check
@@ -194,7 +190,7 @@ class BaseProblem:
                 "only (CameraVertex, PointVertex) edges are supported"
             )
         for v in edge.vertices:
-            if not any(v is pv for pv in self._vertices.values()):
+            if id(v) not in self._vertex_ids:
                 raise ValueError("edge references a vertex not in the problem")
         if edge.measurement is None:
             raise ValueError("edge has no measurement")
@@ -207,7 +203,11 @@ class BaseProblem:
         """Remove a vertex and every edge touching it (reference
         eraseVertex, base_problem.cpp:145-157)."""
         v = self._vertices.pop(vertex_id)
+        self._vertex_ids.discard(id(v))
         self._edges = [e for e in self._edges if all(u is not v for u in e.vertices)]
+        self._engine = None
+        if not self._edges:
+            self._edge_type = None
 
     # -- lowering + solve ----------------------------------------------------
     def _lower(self):
@@ -249,7 +249,9 @@ class BaseProblem:
             and self._edge_type.forward is not BaseEdge.forward
         )
         if custom_forward:
-            residual_jac_fn = _edge_residual_jac_fn(self._edges[0])
+            if self._engine is None:
+                self._engine = _edge_residual_jac_fn(self._edges[0])
+            residual_jac_fn = self._engine
         else:
             residual_jac_fn = make_residual_jacobian_fn(mode=opt.jacobian_mode)
 

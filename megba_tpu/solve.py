@@ -31,13 +31,17 @@ from megba_tpu.parallel.mesh import distributed_lm_solve, make_mesh, shard_edge_
 def _cached_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted,
                          pallas_plan):
     """Jitted single-device solve, cached per configuration (same pitfall
-    and remedy as parallel.mesh._cached_sharded_solve)."""
+    and remedy as parallel.mesh._cached_sharded_solve).  The trust-region
+    resume state rides as dynamic operands so chunked/checkpointed solves
+    reuse one compilation."""
 
-    def fn(cameras, points, obs, cam_idx, pt_idx, mask, *extras):
+    def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
+           *extras):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, verbose=verbose, cam_sorted=cam_sorted,
-            pallas_plan=pallas_plan, **dict(zip(keys, extras)))
+            pallas_plan=pallas_plan, initial_region=init_region,
+            initial_v=init_v, **dict(zip(keys, extras)))
 
     return jax.jit(fn)
 
@@ -55,6 +59,8 @@ def flat_solve(
     pt_fixed: Optional[np.ndarray] = None,
     verbose: bool = False,
     pallas_plan: Optional[Tuple[int, int]] = None,
+    initial_region: Optional[float] = None,
+    initial_v: Optional[float] = None,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
 
@@ -109,7 +115,8 @@ def flat_solve(
             jnp.asarray(obs_p), jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p),
             jnp.asarray(mask), option, mesh,
             sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
-            verbose=verbose, cam_sorted=True, pallas_plan=pallas_plan)
+            verbose=verbose, cam_sorted=True, pallas_plan=pallas_plan,
+            initial_region=initial_region, initial_v=initial_v)
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
                 ("pt_fixed", pt_fixed_j)]
@@ -117,10 +124,13 @@ def flat_solve(
     extras = [v for _, v in optional if v is not None]
     jitted = _cached_single_solve(
         residual_jac_fn, option, keys, verbose, True, pallas_plan)
+    ir = option.algo_option.initial_region if initial_region is None else initial_region
+    iv = 2.0 if initial_v is None else initial_v
     return jitted(
         jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
         jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-        jnp.ones(obs.shape[0], dtype=dtype), *extras)
+        jnp.ones(obs.shape[0], dtype=dtype),
+        jnp.asarray(ir, dtype), jnp.asarray(iv, dtype), *extras)
 
 
 def solve_bal(

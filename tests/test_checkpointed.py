@@ -18,13 +18,14 @@ def setup(seed=0):
         solver_option=SolverOption(max_iter=100, tol=1e-13, refuse_ratio=1e30))
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
     args = (jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
-            jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)))
-    return f, args, option
+            jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx))
+    lm_args = args + (jnp.ones(len(s.obs)),)
+    return f, args, lm_args, option
 
 
 def test_checkpointed_equals_straight_run(tmp_path):
-    f, args, option = setup()
-    straight = lm_solve(f, *args, option)
+    f, args, lm_args, option = setup()
+    straight = lm_solve(f, *lm_args, option)
     ck = str(tmp_path / "run.npz")
     chunked = solve_checkpointed(f, *args, option, checkpoint_path=ck,
                                  checkpoint_every=3)
@@ -38,7 +39,7 @@ def test_checkpointed_equals_straight_run(tmp_path):
 
 
 def test_resume_from_partial_checkpoint(tmp_path):
-    f, args, option = setup(seed=1)
+    f, args, lm_args, option = setup(seed=1)
     ck = str(tmp_path / "run.npz")
     # Simulate preemption: run only the first chunk.
     import dataclasses
@@ -50,16 +51,16 @@ def test_resume_from_partial_checkpoint(tmp_path):
     # Resume with the full budget: picks up at iteration 4.
     resumed = solve_checkpointed(f, *args, option, checkpoint_path=ck,
                                  checkpoint_every=4)
-    straight = lm_solve(f, *args, option)
+    straight = lm_solve(f, *lm_args, option)
     np.testing.assert_allclose(float(resumed.cost), float(straight.cost), rtol=1e-10)
 
 
 def test_checkpointed_aggregates_whole_run(tmp_path):
-    f, args, option = setup(seed=2)
+    f, args, lm_args, option = setup(seed=2)
     ck = str(tmp_path / "agg.npz")
     chunked = solve_checkpointed(f, *args, option, checkpoint_path=ck,
                                  checkpoint_every=4)
-    straight = lm_solve(f, *args, option)
+    straight = lm_solve(f, *lm_args, option)
     assert int(chunked.iterations) == int(straight.iterations)
     assert int(chunked.accepted) == int(straight.accepted)
     np.testing.assert_allclose(float(chunked.initial_cost),
@@ -68,7 +69,7 @@ def test_checkpointed_aggregates_whole_run(tmp_path):
 
 def test_resume_preserves_initial_cost_and_converged_state(tmp_path):
     import dataclasses
-    f, args, option = setup(seed=3)
+    f, args, lm_args, option = setup(seed=3)
     ck = str(tmp_path / "r.npz")
     short = dataclasses.replace(
         option, algo_option=dataclasses.replace(option.algo_option, max_iter=4))
@@ -89,7 +90,7 @@ def test_resume_preserves_initial_cost_and_converged_state(tmp_path):
 
 def test_checkpoint_every_validated(tmp_path):
     import pytest
-    f, args, option = setup()
+    f, args, lm_args, option = setup()
     with pytest.raises(ValueError, match="checkpoint_every"):
         solve_checkpointed(f, *args, option,
                            checkpoint_path=str(tmp_path / "x.npz"),

@@ -92,6 +92,68 @@ def test_erase_camera_vertex():
     assert np.isfinite(float(res.cost))
 
 
+def test_custom_edge_attribute_not_served_stale_across_problems():
+    # Two problems using the same custom edge CLASS but different
+    # per-instance constants: each solve must trace ITS OWN prototype
+    # (a class-level engine cache once served problem 1's constant to
+    # problem 2).
+    class ScaledEdge(BaseEdge):
+        def __init__(self, *args, scale=1.0, **kw):
+            super().__init__(*args, **kw)
+            self.scale = scale
+
+        def forward(self):
+            cam = self.vertex_estimation(0)
+            pt = self.vertex_estimation(1)
+            from megba_tpu.ops.residuals import bal_residual
+            return self.scale * bal_residual(cam, pt, self.get_measurement())
+
+    s = make_synthetic_bal(num_cameras=3, num_points=12, obs_per_point=2, seed=6)
+
+    def initial_cost(scale):
+        pb = BaseProblem(ProblemOption(
+            algo_option=AlgoOption(max_iter=1),
+            solver_option=SolverOption(max_iter=5)))
+        cams = [CameraVertex(c) for c in s.cameras0]
+        pts = [PointVertex(p) for p in s.points0]
+        for i, v in enumerate(cams):
+            pb.append_vertex(i, v)
+        for j, v in enumerate(pts):
+            pb.append_vertex(100 + j, v)
+        for c, p, uv in zip(s.cam_idx, s.pt_idx, s.obs):
+            pb.append_edge(ScaledEdge([cams[c], pts[p]], measurement=uv,
+                                      scale=scale))
+        return float(pb.solve().initial_cost)
+
+    c1 = initial_cost(1.0)
+    c10 = initial_cost(10.0)
+    np.testing.assert_allclose(c10 / c1, 100.0, rtol=1e-6)
+
+
+def test_edge_type_resets_when_all_edges_erased():
+    s = make_synthetic_bal(num_cameras=2, num_points=4, obs_per_point=1, seed=7)
+    pb = BaseProblem()
+    cams = [CameraVertex(c) for c in s.cameras0]
+    pts = [PointVertex(p) for p in s.points0]
+    for i, v in enumerate(cams):
+        pb.append_vertex(i, v)
+    for j, v in enumerate(pts):
+        pb.append_vertex(100 + j, v)
+
+    class EdgeA(BaseEdge):
+        pass
+
+    pb.append_edge(EdgeA([cams[0], pts[0]], measurement=np.zeros(2)))
+    pb.erase_vertex(100)  # removes the only edge
+    assert not pb._edges
+
+    class EdgeB(BaseEdge):
+        pass
+
+    # Must be accepted: the problem has zero edges of any type now.
+    pb.append_edge(EdgeB([cams[0], pts[1]], measurement=np.zeros(2)))
+
+
 def test_all_vertices_fixed_is_a_noop_solve():
     s = make_synthetic_bal(num_cameras=3, num_points=12, obs_per_point=2, seed=4)
     pb = BaseProblem(ProblemOption(
