@@ -61,19 +61,26 @@ def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
         import tempfile
 
         # Prefer expanding next to the archive (default temp dirs are
-        # often small tmpfs mounts; Final-13682 expands to ~350MB), but
-        # fall back to the system temp dir for read-only dataset mounts.
-        try:
-            fd, tmp = tempfile.mkstemp(
-                suffix=".txt", dir=os.path.dirname(os.path.abspath(path)))
-        except OSError:
-            fd, tmp = tempfile.mkstemp(suffix=".txt")
-        try:
-            with bz2.open(path, "rb") as src, os.fdopen(fd, "wb") as dst:
-                shutil.copyfileobj(src, dst, length=1 << 24)
-            return load_bal(tmp, dtype)
-        finally:
-            os.unlink(tmp)
+        # often small tmpfs mounts; Final-13682 expands to ~350MB), then
+        # retry in the system temp dir (read-only mounts, full quotas).
+        last_err = None
+        for tmp_dir in (os.path.dirname(os.path.abspath(path)), None):
+            try:
+                fd, tmp = tempfile.mkstemp(suffix=".txt", dir=tmp_dir)
+            except OSError as e:
+                last_err = e
+                continue
+            try:
+                with os.fdopen(fd, "wb") as dst, bz2.open(path, "rb") as srcf:
+                    shutil.copyfileobj(srcf, dst, length=1 << 24)
+                return load_bal(tmp, dtype)
+            except OSError as e:
+                last_err = e
+                continue
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        raise last_err
 
     try:
         from megba_tpu.native import parse_bal_native
@@ -82,6 +89,10 @@ def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
         if parsed is not None:
             return parsed
     except ImportError:
+        pass
+    except ValueError:
+        # Native parse rejected the file; the NumPy tokenizer is the
+        # arbiter (it raises the user-facing error if truly malformed).
         pass
 
     with open(path, "rb") as f:

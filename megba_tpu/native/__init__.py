@@ -29,14 +29,24 @@ _build_failed = False
 
 
 def _build() -> bool:
+    # Build to a temp name then os.replace: concurrent importers (multi-
+    # host shared filesystems) never see a half-written .so, and a killed
+    # build can't leave a corrupt library with a fresh mtime.  No
+    # -march=native: the .so may be shared across heterogeneous hosts.
+    tmp = _SO + f".tmp.{os.getpid()}"
     cmd = [
-        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-        "-o", _SO,
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
     ] + [os.path.join(_DIR, s) for s in _SOURCES]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError):
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 
@@ -141,6 +151,9 @@ def degree_stats(cam_idx: np.ndarray, pt_idx: np.ndarray, num_cameras: int,
                  num_points: int):
     """Per-vertex degrees + (max_cam_degree, max_pt_degree, hpl_nnz_blocks).
 
+    The planning view of the reference's HessianEntrance sparsity
+    discovery (base_problem.cpp:17-48): solve_bal(verbose=True) prints it
+    and users can size explicit-mode memory from hpl_nnz_blocks.
     hpl_nnz_blocks is -1 unless edges are camera-sorted.  NumPy fallback
     when the native lib is unavailable.
     """
@@ -151,7 +164,10 @@ def degree_stats(cam_idx: np.ndarray, pt_idx: np.ndarray, num_cameras: int,
         from megba_tpu.core.types import is_cam_sorted
 
         sorted_ = is_cam_sorted(cam_idx)
-        nnz = int(len(set(zip(cam_idx.tolist(), pt_idx.tolist())))) if sorted_ else -1
+        nnz = (
+            int(np.unique(cam_idx.astype(np.int64) * num_points
+                          + pt_idx.astype(np.int64)).size)
+            if sorted_ else -1)
         return cam_counts, pt_counts, (int(cam_counts.max(initial=0)),
                                        int(pt_counts.max(initial=0)), nnz)
     cam_idx = np.ascontiguousarray(cam_idx, np.int32)

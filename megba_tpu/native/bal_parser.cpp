@@ -4,76 +4,77 @@
 // (reference examples/BAL_Double.cpp:74-139, which fscanf's 4.5M
 // observation lines for Final-13682) and of its host-side problem
 // construction costs (SURVEY.md section 3.1 flags SoA appends as the
-// build bottleneck).  Design is new: mmap the whole file, scan the token
-// stream once with a branch-light float reader, write straight into
-// caller-provided (numpy) buffers.  C ABI for ctypes binding — no
+// build bottleneck).  Design is new: read the file into one
+// NUL-terminated buffer (safe for token scanners even when the file ends
+// mid-token) and scan it once with std::from_chars — locale-independent,
+// allocation-free number parsing.  C ABI for ctypes binding — no
 // pybind11 in this image.
 
 #include <cctype>
 #include <cerrno>
+#include <charconv>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
-
-#include <fcntl.h>
-#include <sys/mman.h>
-#include <sys/stat.h>
-#include <unistd.h>
+#include <vector>
 
 namespace {
 
 struct Cursor {
   const char* p;
-  const char* end;
+  const char* end;  // points at the trailing '\0'
 };
 
 inline void skip_space(Cursor& c) {
   while (c.p < c.end && std::isspace(static_cast<unsigned char>(*c.p))) ++c.p;
 }
 
-// strtod on a bounded buffer; BAL files are '\0'-free text so strtod's
-// scan terminates at whitespace well before `end`.
+// Locale-independent double parse; BAL files use plain C formatting.
 inline bool next_double(Cursor& c, double* out) {
   skip_space(c);
   if (c.p >= c.end) return false;
-  char* after = nullptr;
-  *out = std::strtod(c.p, &after);
-  if (after == c.p) return false;
-  c.p = after;
+  auto res = std::from_chars(c.p, c.end, *out);
+  if (res.ec != std::errc() || res.ptr == c.p) return false;
+  c.p = res.ptr;
   return true;
 }
 
 inline bool next_long(Cursor& c, long* out) {
   skip_space(c);
   if (c.p >= c.end) return false;
-  char* after = nullptr;
-  *out = std::strtol(c.p, &after, 10);
-  if (after == c.p) return false;
-  c.p = after;
+  auto res = std::from_chars(c.p, c.end, *out, 10);
+  if (res.ec != std::errc() || res.ptr == c.p) return false;
+  c.p = res.ptr;
   return true;
 }
 
-struct Mapped {
-  const char* data = nullptr;
-  size_t size = 0;
-  int fd = -1;
+// Whole-file read with a trailing NUL so scanning can never run past the
+// buffer (mmap would leave the final token unterminated when the file
+// size is an exact multiple of the page size).
+struct Buffer {
+  std::vector<char> data;
 
-  bool open_file(const char* path) {
-    fd = ::open(path, O_RDONLY);
-    if (fd < 0) return false;
-    struct stat st;
-    if (fstat(fd, &st) != 0 || st.st_size <= 0) return false;
-    size = static_cast<size_t>(st.st_size);
-    void* m = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
-    if (m == MAP_FAILED) return false;
-    data = static_cast<const char*>(m);
-    ::madvise(const_cast<char*>(data), size, MADV_SEQUENTIAL);
+  bool load(const char* path) {
+    std::FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    long sz = std::ftell(f);
+    if (sz < 0) {
+      std::fclose(f);
+      return false;
+    }
+    std::fseek(f, 0, SEEK_SET);
+    data.resize(static_cast<size_t>(sz) + 1);
+    size_t got = sz ? std::fread(data.data(), 1, static_cast<size_t>(sz), f) : 0;
+    std::fclose(f);
+    if (got != static_cast<size_t>(sz)) return false;
+    data[static_cast<size_t>(sz)] = '\0';
     return true;
   }
 
-  ~Mapped() {
-    if (data) munmap(const_cast<char*>(data), size);
-    if (fd >= 0) ::close(fd);
+  Cursor cursor() const {
+    return Cursor{data.data(), data.data() + data.size() - 1};
   }
 };
 
@@ -84,14 +85,14 @@ extern "C" {
 // Reads only the header. Returns 0 on success.
 int megba_bal_header(const char* path, int64_t* n_cam, int64_t* n_pt,
                      int64_t* n_obs) {
-  Mapped m;
-  if (!m.open_file(path)) return -1;
-  Cursor c{m.data, m.data + m.size};
-  long a, b, d;
-  if (!next_long(c, &a) || !next_long(c, &b) || !next_long(c, &d)) return -2;
-  if (a < 0 || b < 0 || d < 0) return -3;
+  Buffer b;
+  if (!b.load(path)) return -1;
+  Cursor c = b.cursor();
+  long a, bb, d;
+  if (!next_long(c, &a) || !next_long(c, &bb) || !next_long(c, &d)) return -2;
+  if (a < 0 || bb < 0 || d < 0) return -3;
   *n_cam = a;
-  *n_pt = b;
+  *n_pt = bb;
   *n_obs = d;
   return 0;
 }
@@ -106,12 +107,12 @@ int megba_bal_header(const char* path, int64_t* n_cam, int64_t* n_pt,
 int megba_bal_parse(const char* path, int64_t n_cam, int64_t n_pt,
                     int64_t n_obs, double* obs, int32_t* cam_idx,
                     int32_t* pt_idx, double* cameras, double* points) {
-  Mapped m;
-  if (!m.open_file(path)) return -1;
-  Cursor c{m.data, m.data + m.size};
-  long a, b, d;
-  if (!next_long(c, &a) || !next_long(c, &b) || !next_long(c, &d)) return -2;
-  if (a != n_cam || b != n_pt || d != n_obs) return -3;
+  Buffer b;
+  if (!b.load(path)) return -1;
+  Cursor c = b.cursor();
+  long a, bb, d;
+  if (!next_long(c, &a) || !next_long(c, &bb) || !next_long(c, &d)) return -2;
+  if (a != n_cam || bb != n_pt || d != n_obs) return -3;
 
   for (int64_t i = 0; i < n_obs; ++i) {
     long ci, pi;

@@ -150,6 +150,18 @@ def solve_bal(
     if not isinstance(bal, BALFile):
         bal = load_bal(bal, dtype=option.dtype)
 
+    if verbose:
+        from megba_tpu.native import degree_stats
+
+        _, _, (max_cd, max_pd, nnz) = degree_stats(
+            bal.cam_idx, bal.pt_idx, bal.num_cameras, bal.num_points)
+        print(
+            f"problem: {bal.num_cameras} cameras, {bal.num_points} points, "
+            f"{bal.num_observations} observations | max camera degree "
+            f"{max_cd}, max point degree {max_pd}, Hpl blocks "
+            f"{nnz if nnz >= 0 else 'n/a (edges unsorted)'}",
+            flush=True)
+
     f = make_residual_jacobian_fn(mode=option.jacobian_mode)
     result = flat_solve(
         f, bal.cameras, bal.points, bal.obs, bal.cam_idx, bal.pt_idx,

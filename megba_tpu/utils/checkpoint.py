@@ -47,6 +47,8 @@ def save_state(path: str, cameras, points, *, region: float = None,
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())  # data durable before the rename is
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):

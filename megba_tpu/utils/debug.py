@@ -50,10 +50,13 @@ def print_blocks(name: str, blocks: Any, indices: Optional[range] = None) -> Non
 def assert_all_finite(x: jax.Array, name: str = "array", debug: bool = False) -> jax.Array:
     """Identity passthrough that raises if x contains non-finite values.
 
-    Outside jit: checks eagerly.  Inside jit: DEBUG-gated like the
-    reference's macros (macro.h:96-108) — a no-op unless `debug=True`,
-    in which case a host callback raises FloatingPointError at the
-    poisoning site (silent on clean values).
+    Outside jit: checks eagerly and raises FloatingPointError.  Inside
+    jit: DEBUG-gated like the reference's macros (macro.h:96-108) — a
+    no-op unless `debug=True`, in which case a host callback raises; note
+    JAX dispatch is asynchronous, so the error surfaces at the next
+    blocking point wrapped in a runtime error naming this message, not as
+    a catchable FloatingPointError at the call site.  For a catchable
+    check, assert on concrete outputs outside jit.
     """
     if isinstance(x, jax.core.Tracer):
         if debug:
