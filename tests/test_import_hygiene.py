@@ -1,0 +1,26 @@
+"""Import hygiene: the package must not touch devices at import time.
+
+Backend initialisation can hang when the single-client TPU tunnel is
+wedged (see utils/backend.py); every entry point defends itself with a
+probe, which only works if `import megba_tpu` itself never triggers a
+device query.
+"""
+
+import subprocess
+import sys
+
+
+def test_import_touches_no_backend():
+    code = (
+        "import jax\n"
+        "import megba_tpu\n"
+        "import megba_tpu.solve, megba_tpu.models, megba_tpu.utils\n"
+        "import megba_tpu.parallel, megba_tpu.native\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), 'import initialized a backend'\n"
+        "print('clean')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "clean" in proc.stdout
