@@ -22,6 +22,7 @@ from megba_tpu.common import (
     Device,
     JacobianMode,
     LinearSystemKind,
+    PreconditionerKind,
     ProblemOption,
     SolverKind,
     SolverOption,
@@ -53,6 +54,7 @@ __all__ = [
     "JacobianMode",
     "LinearSystemKind",
     "PointVertex",
+    "PreconditionerKind",
     "ProblemOption",
     "SolverKind",
     "SolverOption",

@@ -170,7 +170,8 @@ def lm_solve(
             refuse_ratio=solver_opt.refuse_ratio,
             tol_relative=solver_opt.tol_relative,
             compute_kind=compute_kind, axis_name=axis_name,
-            mixed_precision=option.mixed_precision_pcg, cam_sorted=cam_sorted)
+            mixed_precision=option.mixed_precision_pcg, cam_sorted=cam_sorted,
+            preconditioner=solver_opt.preconditioner)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply

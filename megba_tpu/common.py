@@ -77,6 +77,25 @@ class JacobianMode(enum.Enum):
     AUTODIFF_FORWARD = 2
 
 
+class PreconditionerKind(enum.Enum):
+    """Block-Jacobi preconditioner for the Schur PCG.
+
+    HPP = inverted damped camera blocks — the reference's choice
+    (schur_pcg_solver.cu:199: invertDistributed on Hpp) and the default.
+    SCHUR_DIAG = the TRUE block diagonal of the Schur complement,
+    diag_c(S) = Hpp_c - sum_{e in c} W_e Hll^-1_{pt(e)} W_e^T, assembled
+    by one extra segment_sum per solve.  The standard stronger choice in
+    the BA literature for sparsely-coupled problems (cameras sharing few
+    points); NOT universally better — on small densely-coupled scenes it
+    can cost more iterations — so benchmark per problem.  Costs a
+    transient [nE, cd, cd] buffer per solve (~324 B/edge for BAL): at
+    multi-million-edge scale prefer HPP until the fused build lands.
+    """
+
+    HPP = 0
+    SCHUR_DIAG = 1
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverOption:
     """Inner (PCG) solver options — reference common.h:27-33 defaults.
@@ -93,6 +112,7 @@ class SolverOption:
     tol: float = 1e-1
     refuse_ratio: float = 1.0
     tol_relative: bool = False
+    preconditioner: PreconditionerKind = PreconditionerKind.HPP
 
 
 @dataclasses.dataclass(frozen=True)

@@ -34,7 +34,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from megba_tpu.common import ComputeKind
+from megba_tpu.common import ComputeKind, PreconditionerKind
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 
 HI = jax.lax.Precision.HIGHEST
@@ -255,8 +255,13 @@ def plain_pcg_solve(
     axis_name: Optional[str] = None,
     mixed_precision: bool = False,
     cam_sorted: bool = False,
+    preconditioner: PreconditionerKind = PreconditionerKind.HPP,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
+
+    `preconditioner` is accepted for signature parity and ignored: the
+    full system's exact block diagonal (Hpp, Hll) IS this solver's
+    preconditioner, so both kinds coincide here.
 
     The path the reference left as `// TODO(Jie Ren)` behind
     `useSchur=false` (base_problem.cpp:112-123) — implemented here: PCG
@@ -315,6 +320,7 @@ def schur_pcg_solve(
     axis_name: Optional[str] = None,
     mixed_precision: bool = False,
     cam_sorted: bool = False,
+    preconditioner: PreconditionerKind = PreconditionerKind.HPP,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt).
 
@@ -360,7 +366,38 @@ def schur_pcg_solve(
             Jp = (Jp * jnp.take(d_pt, pt_idx, axis=0)[:, None, :]).astype(bf)
 
     Hll_inv = block_inv(Hll_d)
-    Minv = block_inv(Hpp_d)  # block-Jacobi preconditioner
+    if preconditioner == PreconditionerKind.SCHUR_DIAG:
+        # True Schur block diagonal: Hpp_c - sum_e W_e Hll^-1 W_e^T,
+        # one segment_sum of per-edge [cd,cd] blocks (see
+        # common.PreconditionerKind).  W_e from storage (EXPLICIT) or
+        # recomputed (IMPLICIT); Hll_inv gathered per edge.
+        if compute_kind == ComputeKind.EXPLICIT:
+            W_e = W
+        else:
+            W_e = (jnp.einsum("eoc,eop->ecp", Jc, Jp,
+                              preferred_element_type=jnp.float32)
+                   if mixed_precision else
+                   jnp.einsum("eoc,eop->ecp", Jc, Jp, precision=HI))
+        W_e = W_e.astype(Hpp_d.dtype)  # bf16 operands -> full precision
+        Hinv_e = jnp.take(Hll_inv, pt_idx, axis=0)  # [nE, pd, pd]
+        corr_e = jnp.einsum("ecp,epq,edq->ecd", W_e, Hinv_e, W_e,
+                            precision=HI)
+        corr = jax.ops.segment_sum(corr_e, cam_idx,
+                                   num_segments=num_cameras,
+                                   indices_are_sorted=cam_sorted)
+        if axis_name is not None:
+            corr = jax.lax.psum(corr, axis_name)
+        # In exact arithmetic Hpp_d - corr is SPD (a principal block of
+        # S), but rounding (especially equilibrated bf16 operands) can
+        # push a weakly-determined camera block indefinite -> Cholesky
+        # NaN.  Fall back to the Hpp preconditioner for exactly those
+        # blocks instead of letting NaN masquerade as convergence.
+        minv_hpp = block_inv(Hpp_d)
+        minv_sd = block_inv(Hpp_d - corr.astype(Hpp_d.dtype))
+        bad = ~jnp.all(jnp.isfinite(minv_sd), axis=(-2, -1), keepdims=True)
+        Minv = jnp.where(bad, minv_hpp, minv_sd)
+    else:
+        Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
 
     hpl, hlp = make_coupling_matvecs(
         W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,

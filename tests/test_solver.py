@@ -112,6 +112,25 @@ def test_pcg_mixed_precision_close_to_full(compute_kind):
     assert cos > 0.95
 
 
+@pytest.mark.parametrize("compute_kind", [ComputeKind.IMPLICIT, ComputeKind.EXPLICIT])
+def test_schur_diag_preconditioner(compute_kind):
+    # A preconditioner must not change WHAT PCG converges to, only how it
+    # gets there: SCHUR_DIAG's solution matches the dense direct solve.
+    # (Iteration counts are problem-dependent — see PreconditionerKind.)
+    from megba_tpu.common import PreconditionerKind
+    system, r, Jc, Jp, cam_idx, pt_idx = build_test_system(
+        seed=3, compute_kind=compute_kind)
+    region = jnp.asarray(100.0)
+    kw = dict(max_iter=500, tol=1e-13, tol_relative=True, refuse_ratio=1e30,
+              compute_kind=compute_kind)
+    sd = schur_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, region,
+                         preconditioner=PreconditionerKind.SCHUR_DIAG, **kw)
+    dx_cam_d, dx_pt_d = dense_reference_solve(system, Jc, Jp, cam_idx, pt_idx, region)
+    np.testing.assert_allclose(sd.dx_cam, dx_cam_d, rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(sd.dx_pt, dx_pt_d, rtol=1e-5, atol=1e-8)
+    assert int(sd.iterations) > 0
+
+
 def test_relative_tolerance_mode():
     # tol_relative reinterprets tol as a fraction of rho0: a modest 1e-8
     # relative tolerance must reach (near) the dense answer regardless of
