@@ -78,13 +78,15 @@ def make_synthetic_planar(
     cam_idx = ((base + np.arange(obs_per_point)[None, :] * stride) % num_cameras).reshape(-1)
     pt_idx = np.repeat(np.arange(num_points), obs_per_point)
 
-    theta = cameras_gt[cam_idx, 0]
-    c, s = np.cos(theta), np.sin(theta)
-    X = points_gt[pt_idx]
-    px = c * X[:, 0] - s * X[:, 1] + cameras_gt[cam_idx, 1]
-    py = s * X[:, 0] + c * X[:, 1] + cameras_gt[cam_idx, 2]
-    u = cameras_gt[cam_idx, 3] * px / py
-    obs = (u + r.normal(scale=noise, size=u.shape))[:, None]
+    # Ground-truth observations come from the MODEL ITSELF (residual with
+    # obs=0 is the projection), so generator and residual can never
+    # diverge.
+    import jax
+
+    proj = np.asarray(jax.vmap(residual)(
+        cameras_gt[cam_idx], points_gt[pt_idx],
+        np.zeros((len(cam_idx), 1))))
+    obs = proj + r.normal(scale=noise, size=proj.shape)
 
     order = np.argsort(cam_idx, kind="stable")
     cameras0 = cameras_gt + r.normal(scale=param_noise, size=cameras_gt.shape) * np.array(

@@ -56,6 +56,11 @@ def camera_window_plan(
     if n == 0:
         return False, 0
     cam_idx = np.asarray(cam_idx)
+    if np.any(np.diff(cam_idx) < 0):
+        # The kernel is only valid on camera-sorted edges; a plan computed
+        # on a different order than the kernel runs on silently drops
+        # out-of-window contributions.
+        return False, 0
     if n <= tile:
         span = int(cam_idx[-1] - cam_idx[0] + 1)
     else:
@@ -63,10 +68,12 @@ def camera_window_plan(
     window = DEFAULT_WINDOW
     while window < span:
         window *= 2
-    return (window <= max_window), window
+    if window > max_window:
+        return False, 0
+    return True, window
 
 
-def _hessian_cam_kernel(starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, window, tile, cd, od):
+def _hessian_cam_kernel(starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, window, cd, od):
     """One tile: partial (Hpp, g) sums for `window` consecutive cameras.
 
     out_ref block: [1, window, cd*cd + cd] — H flattened then g.
@@ -144,7 +151,7 @@ def camera_hessian_gradient(
 
     partials = pl.pallas_call(
         functools.partial(
-            _hessian_cam_kernel, window=window, tile=tile, cd=cd, od=od),
+            _hessian_cam_kernel, window=window, cd=cd, od=od),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_tiles, window, feat), dtype),
         interpret=interpret,

@@ -144,9 +144,17 @@ def make_residual_jacobian_fn(
     if mode == JacobianMode.AUTODIFF_FORWARD:
 
         def value_and_jac_fwd(camera, point, obs):
-            r = residual_fn(camera, point, obs)
-            Jc, Jp = jax.jacfwd(residual_fn, argnums=(0, 1))(camera, point, obs)
-            return r, Jc, Jp
+            # jax.linearize: ONE primal evaluation plus cd+pd cheap
+            # pushforwards of the linearised map (jacfwd would recompute
+            # the primal per basis vector and lean on XLA CSE).
+            r, jvp = jax.linearize(
+                lambda c, p: residual_fn(c, p, obs), camera, point)
+            cd, pd = camera.shape[0], point.shape[0]
+            eye_c = jnp.eye(cd, dtype=camera.dtype)
+            eye_p = jnp.eye(pd, dtype=point.dtype)
+            Jc = jax.vmap(lambda t: jvp(t, jnp.zeros_like(point)))(eye_c)
+            Jp = jax.vmap(lambda t: jvp(jnp.zeros_like(camera), t))(eye_p)
+            return r, Jc.T, Jp.T
 
         return jax.vmap(value_and_jac_fwd, in_axes=(0, 0, 0))
 
