@@ -22,6 +22,22 @@ from megba_tpu.common import ProblemOption
 from megba_tpu.utils.checkpoint import load_state, save_state
 
 
+def _topology_fingerprint(cameras, points, cam_idx, pt_idx) -> np.ndarray:
+    """[Nc, Np, nE, blake2b(cam_idx), blake2b(pt_idx)] as int64 — cheap,
+    order-sensitive identity of the problem graph."""
+    import hashlib
+
+    def h(a):
+        digest = hashlib.blake2b(
+            np.ascontiguousarray(np.asarray(a, np.int32)).tobytes(),
+            digest_size=8).digest()
+        return int.from_bytes(digest, "little", signed=True)
+
+    return np.asarray(
+        [cameras.shape[0], points.shape[0], np.asarray(cam_idx).shape[0],
+         h(cam_idx), h(pt_idx)], np.int64)
+
+
 def solve_checkpointed(
     residual_jac_fn,
     cameras,
@@ -51,17 +67,34 @@ def solve_checkpointed(
     region = None
     v = None
     accepted_total = 0
+    pcg_total = 0
     first_cost = None
     already_stopped = False
 
+    # Problem identity guard: a stale/foreign snapshot with mismatched
+    # shapes would otherwise be resumed silently (jnp.take clamps
+    # out-of-range indices instead of erroring) and yield garbage.  The
+    # graph topology is summarised by a cheap order-sensitive hash of the
+    # index arrays, not just the counts.
+    topo = _topology_fingerprint(cameras, points, cam_idx, pt_idx)
+
     if os.path.exists(checkpoint_path):
         st = load_state(checkpoint_path)
+        saved_topo = st.get("extra_topology")
+        if saved_topo is None or not np.array_equal(np.asarray(saved_topo), topo):
+            raise ValueError(
+                f"checkpoint {checkpoint_path!r} was written for a different "
+                f"problem (topology fingerprint "
+                f"{None if saved_topo is None else np.asarray(saved_topo).tolist()} "
+                f"!= {topo.tolist()}); refusing to resume — delete the "
+                "snapshot or point checkpoint_path elsewhere")
         cameras = jnp.asarray(st["cameras"], cameras.dtype)
         points = jnp.asarray(st["points"], points.dtype)
         region = float(st["region"])
         v = float(st["extra_v"])
         done = int(st["iteration"])
         accepted_total = int(st.get("extra_accepted", 0))
+        pcg_total = int(st.get("extra_pcg", 0))
         if "extra_first_cost" in st:
             first_cost = jnp.asarray(st["extra_first_cost"])
         already_stopped = bool(st.get("extra_stopped", False))
@@ -83,6 +116,7 @@ def solve_checkpointed(
         if first_cost is None:
             first_cost = result.initial_cost
         accepted_total += int(result.accepted)
+        pcg_total += int(result.pcg_iterations)
         ran = int(result.iterations)
         done += ran
         stopped = bool(result.stopped) or ran < chunk
@@ -91,8 +125,10 @@ def solve_checkpointed(
             region=float(region), cost=float(result.cost), iteration=done,
             extra={"v": np.asarray(float(v)),
                    "accepted": np.asarray(accepted_total),
+                   "pcg": np.asarray(pcg_total),
                    "first_cost": np.asarray(float(first_cost)),
-                   "stopped": np.asarray(stopped)})
+                   "stopped": np.asarray(stopped),
+                   "topology": topo})
         if stopped:
             break  # converged (possibly exactly on the chunk boundary)
 
@@ -114,4 +150,5 @@ def solve_checkpointed(
         initial_cost=first_cost,
         iterations=jnp.asarray(done, jnp.int32),
         accepted=jnp.asarray(accepted_total, jnp.int32),
+        pcg_iterations=jnp.asarray(pcg_total, jnp.int32),
     )

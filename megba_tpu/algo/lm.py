@@ -33,29 +33,45 @@ from megba_tpu.linear_system.builder import (
     build_schur_system,
     weight_system_inputs,
 )
+from megba_tpu.ops.accum import comp_sum, comp_sum_sq
 from megba_tpu.ops.robust import RobustKind, robustify
 from megba_tpu.solver.pcg import HI, plain_pcg_solve, schur_pcg_solve
 
 _TINY = 1e-30
 
-# Host-side clock for verbose per-iteration lines; reset by iteration 0's
-# callback so elapsed-ms is per-solve even though jitted programs (and
-# this closure) are cached across solves.  Known limits: concurrent
-# verbose solves share this clock (their lines interleave anyway), and a
-# chunked solve restarts it per chunk — elapsed is per-chunk there.
-_VERBOSE_CLOCK = {"t0": 0.0}
+# Host-side clocks for verbose per-iteration lines, keyed by a per-solve
+# token (a dynamic operand, so jitted programs stay cached across solves
+# while concurrent/chunked solves each get their own t0).  Iteration 0's
+# callback starts that solve's clock; the dict is pruned so abandoned
+# solves (e.g. an interrupted run that never reached its later
+# callbacks) can't grow it without bound.
+_VERBOSE_CLOCKS: dict = {}
 
 
-def _emit_verbose_line(k, c, a, p):
+def _emit_verbose_line(token, k, c, a, p):
     now = time.perf_counter()
-    if int(k) == 0:
-        _VERBOSE_CLOCK["t0"] = now
-    dt = (now - _VERBOSE_CLOCK["t0"]) * 1e3
+    token = int(token)
+    if int(k) == 0 or token not in _VERBOSE_CLOCKS:
+        while len(_VERBOSE_CLOCKS) > 64:
+            # Evict oldest-started first (dict preserves insertion order);
+            # never clear() — that would wipe live solves' clocks.
+            _VERBOSE_CLOCKS.pop(next(iter(_VERBOSE_CLOCKS)))
+        _VERBOSE_CLOCKS[token] = now
+    dt = (now - _VERBOSE_CLOCKS[token]) * 1e3
     print(
         f"iter {int(k)}: cost {float(c):.6e} "
         f"log10 {np.log10(max(float(c), 1e-300)):.3f} "
         f"accept {bool(a)} pcg_iters {int(p)} "
         f"elapsed {dt:.1f} ms", flush=True)
+
+
+# Monotonic per-solve token source for the verbose clock.
+_VERBOSE_TOKEN = {"next": 0}
+
+
+def _next_verbose_token() -> int:
+    _VERBOSE_TOKEN["next"] += 1
+    return _VERBOSE_TOKEN["next"]
 
 
 @jax.tree_util.register_dataclass
@@ -69,6 +85,7 @@ class LMResult:
     initial_cost: jax.Array
     iterations: jax.Array  # LM iterations executed
     accepted: jax.Array  # number of accepted steps
+    pcg_iterations: jax.Array  # total PCG iterations across the solve
     region: jax.Array  # final trust region
     v: jax.Array  # final reject back-off factor (resume state)
     stopped: jax.Array  # True when a convergence criterion fired
@@ -92,6 +109,7 @@ def lm_solve(
     pallas_plan=None,
     initial_region=None,
     initial_v=None,
+    verbose_token=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -120,16 +138,20 @@ def lm_solve(
                                     jnp.take(pts, pt_idx, axis=0), obs)
         r, Jc, Jp = weight_system_inputs(
             r, Jc, Jp, cam_idx, pt_idx, mask, sqrt_info, cam_fixed, pt_fixed)
+        # Costs use compensated f32 sums (ops/accum.py): at BAL-Final
+        # scale (~58M terms) a plain f32 sum's O(n*eps) error would flip
+        # accept/reject decisions near convergence; the reference gets
+        # this accuracy from f64 cuBLAS dots (lm_algo.cu:25-51).
         if robust == RobustKind.NONE:
-            wcost = psum(jnp.sum(r * r))
+            wcost = psum(comp_sum_sq(r))
             cost = wcost
         else:
             # IRLS reweighting (ops/robust.py); the system is built from
             # the weighted quantities, the accept test uses Sum rho, the
             # quadratic model is measured from the weighted norm.
             r, Jc, Jp, rho_e = robustify(r, Jc, Jp, robust, robust_delta)
-            cost = psum(jnp.sum(rho_e))
-            wcost = psum(jnp.sum(r * r))
+            cost = psum(comp_sum(rho_e))
+            wcost = psum(comp_sum_sq(r))
         system = build_schur_system(
             r, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
             compute_kind=compute_kind, axis_name=axis_name,
@@ -143,6 +165,7 @@ def lm_solve(
     state0 = dict(
         k=jnp.int32(0),
         accepted=jnp.int32(0),
+        pcg_total=jnp.int32(0),
         cameras=cameras,
         points=points,
         r=r0,
@@ -190,7 +213,7 @@ def lm_solve(
             + jnp.einsum("eop,ep->eo", s["Jp"], jnp.take(dx_pt, pt_idx, axis=0), precision=HI)
             + s["r"]
         )
-        predicted = psum(jnp.sum(jdx * jdx))
+        predicted = psum(comp_sum_sq(jdx))
         # The quadratic model is in the (robust-)weighted residuals; its
         # decrease is measured from the carried weighted norm, while
         # accept uses the true (robustified) cost.  For RobustKind.NONE
@@ -200,16 +223,33 @@ def lm_solve(
         # rho's sign and collapse the trust region on an accepted step.
         denominator = jnp.minimum(predicted - s["wcost"], -_TINY)
 
-        # ONE linearisation at the trial point serves both the cost test
-        # and the accept branch — the reference's second forward() per
-        # iteration whose jets feed buildLinearSystem on accept
-        # (lm_algo.cu:183-189).
-        r_n, Jc_n, Jp_n, system_n, cost_new, wcost_new = linearize(cams_new, pts_new)
+        # Trial-point cost WITHOUT paying for Jacobians or the Hessian
+        # build: only the cost outputs of this call are used, so XLA's
+        # dead-code elimination prunes the J/system computations from the
+        # loop body.  This mirrors the reference's cheap second forward()
+        # (residual jets only feed the norm unless the step is accepted,
+        # lm_algo.cu:183-189,209-214).
+        _, _, _, _, cost_new, wcost_new = linearize(cams_new, pts_new)
         rho = (cost_new - s["cost"]) / denominator
 
         # Reference lm_algo.cu breaks BEFORE edges.update() when the
         # step-size test fires — a converged step is never applied.
         accept = (cost_new < s["cost"]) & (~converged)
+
+        # Relinearise ONLY on accept (lax.cond; `accept` is replicated
+        # across shards, so all replicas take the same branch and the
+        # psums inside stay collective-safe).  The reference's reject
+        # path likewise skips buildLinearSystem (lm_algo.cu:206-214);
+        # round 1 paid a full rebuild per rejected step.
+        def _relinearize(_):
+            r_n, Jc_n, Jp_n, system_n, _, _ = linearize(cams_new, pts_new)
+            return r_n, Jc_n, Jp_n, system_n
+
+        def _keep_old(_):
+            return s["r"], s["Jc"], s["Jp"], s["system"]
+
+        r_n, Jc_n, Jp_n, system_n = jax.lax.cond(
+            accept, _relinearize, _keep_old, None)
 
         g_inf = jnp.maximum(jnp.max(jnp.abs(system_n.g_cam)),
                             jnp.max(jnp.abs(system_n.g_pt)))
@@ -228,12 +268,14 @@ def lm_solve(
         s_next = dict(
             k=s["k"] + 1,
             accepted=s["accepted"] + jnp.where(accept, 1, 0).astype(jnp.int32),
+            pcg_total=s["pcg_total"] + pcg.iterations,
             cameras=pick(cams_new, s["cameras"]),
             points=pick(pts_new, s["points"]),
-            r=pick(r_n, s["r"]),
-            Jc=pick(Jc_n, s["Jc"]),
-            Jp=pick(Jp_n, s["Jp"]),
-            system=pick(system_n, s["system"]),
+            # r/Jc/Jp/system already selected by the cond above.
+            r=r_n,
+            Jc=Jc_n,
+            Jp=Jp_n,
+            system=system_n,
             cost=jnp.where(accept, cost_new, s["cost"]),
             wcost=jnp.where(accept, wcost_new, s["wcost"]),
             region=jnp.where(accept, region_accept, region_reject),
@@ -245,12 +287,15 @@ def lm_solve(
                 # Host callback: prints the reference's per-iteration line
                 # (cost, log10 cost, elapsed ms — lm_algo.cu:149-162).
                 # Elapsed is measured host-side from this solve's first
-                # iteration callback (iteration 0 resets the clock — the
-                # jitted program is cached across solves, so a trace-time
-                # baseline would be frozen at the FIRST solve's start).
+                # iteration callback (iteration 0 starts the clock keyed
+                # by the per-solve token — the jitted program is cached
+                # across solves, so a trace-time baseline would be frozen
+                # at the FIRST solve's start).
                 jax.debug.callback(_emit_verbose_line, *args)
 
-            args = (s["k"], cost_new, accept, pcg.iterations)
+            token = (jnp.int32(0) if verbose_token is None
+                     else jnp.asarray(verbose_token, jnp.int32))
+            args = (token, s["k"], cost_new, accept, pcg.iterations)
             if axis_name is None:
                 _print(args)
             else:
@@ -268,6 +313,7 @@ def lm_solve(
         initial_cost=cost0,
         iterations=out["k"],
         accepted=out["accepted"],
+        pcg_iterations=out["pcg_total"],
         region=out["region"],
         v=out["v"],
         stopped=out["stop"],

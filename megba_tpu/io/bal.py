@@ -48,6 +48,33 @@ class BALFile:
         return self.obs.shape[0]
 
 
+def _is_ram_backed(directory: str) -> bool:
+    """True when `directory` sits on tmpfs/ramfs (Linux; False elsewhere).
+
+    shutil.disk_usage on tmpfs reports a RAM cap as 'free' space, so a
+    size check alone would route large decompressions into memory.
+    """
+    try:
+        best_fs, best_len = "", -1
+        with open("/proc/mounts") as f:
+            real = os.path.realpath(directory)
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                # /proc/mounts octal-escapes specials (space -> \040).
+                mnt = parts[1].encode().decode("unicode_escape")
+                fstype = parts[2]
+                # >= : of duplicate mountpoint entries the LAST one listed
+                # is the effective (over)mount.
+                if (real == mnt or real.startswith(mnt.rstrip("/") + "/")) \
+                        and len(mnt) >= best_len:
+                    best_fs, best_len = fstype, len(mnt)
+        return best_fs in ("tmpfs", "ramfs")
+    except OSError:
+        return False
+
+
 def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
     """Parse a BAL text file (.txt or the .bz2 the BAL site distributes)."""
     if not os.path.exists(path):
@@ -60,11 +87,23 @@ def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
         import shutil
         import tempfile
 
-        # Prefer expanding next to the archive (default temp dirs are
-        # often small tmpfs mounts; Final-13682 expands to ~350MB), then
-        # retry in the system temp dir (read-only mounts, full quotas).
+        # Prefer the system temp dir when it is disk-backed and has room
+        # for the expanded text (~5x the archive, Final-13682 ~350MB) —
+        # expanding next to the archive can fill shared dataset mounts
+        # when several jobs load concurrently.  RAM-backed tmpfs temp
+        # dirs are skipped (the expansion would eat physical memory the
+        # Final-scale parse itself needs); so are full/small mounts.
+        need = 5 * os.path.getsize(path) + (64 << 20)
+        tmp = tempfile.gettempdir()
+        try:
+            tmp_ok = (shutil.disk_usage(tmp).free >= need
+                      and not _is_ram_backed(tmp))
+        except OSError:
+            tmp_ok = False
+        archive_dir = os.path.dirname(os.path.abspath(path))
+        candidates = (None, archive_dir) if tmp_ok else (archive_dir, None)
         last_err = None
-        for tmp_dir in (os.path.dirname(os.path.abspath(path)), None):
+        for tmp_dir in candidates:
             try:
                 fd, tmp = tempfile.mkstemp(suffix=".txt", dir=tmp_dir)
             except OSError as e:

@@ -49,7 +49,7 @@ def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
 def make_synthetic_bal(
     num_cameras: int = 4,
     num_points: int = 24,
-    obs_per_point: int = 3,
+    obs_per_point: float = 3,
     pixel_noise: float = 0.5,
     param_noise: float = 1e-2,
     seed: int = 0,
@@ -62,9 +62,15 @@ def make_synthetic_bal(
     scene depth is negative in the camera frame, matching the -P/P.z
     projection).  Each point is observed by `obs_per_point` distinct
     cameras; every camera gets at least one observation.
+
+    `obs_per_point` may be fractional: a `frac(obs_per_point)` share of
+    points gets `ceil` observations, the rest `floor`, so the total edge
+    count tracks `num_points * obs_per_point` — this is how the bench
+    matches the real BAL datasets' observation counts while keeping the
+    point count exact.
     """
     r = np.random.default_rng(seed)
-    obs_per_point = min(obs_per_point, num_cameras)
+    obs_per_point = min(float(obs_per_point), float(num_cameras))
 
     points_gt = r.uniform(-1.0, 1.0, size=(num_points, 3))
     cameras_gt = np.zeros((num_cameras, 9))
@@ -76,20 +82,39 @@ def make_synthetic_bal(
     cameras_gt[:, 8] = r.normal(scale=1e-6, size=num_cameras)  # k2
 
     # k distinct cameras per point, fully vectorised: (base + j*stride) mod
-    # Nc for j < k is duplicate-free whenever stride*k <= Nc.
-    k = obs_per_point
+    # Nc for j < k is duplicate-free whenever stride*k <= Nc.  Fractional
+    # obs_per_point: the first n_hi points get kc=ceil observations, the
+    # rest kf=floor, so the total matches num_points*obs_per_point.
+    kf = max(int(np.floor(obs_per_point)), 1)
+    kc = int(np.ceil(obs_per_point))
+    n_hi = int(round((obs_per_point - kf) * num_points)) if kc > kf else 0
     base = r.integers(0, num_cameras, size=(num_points, 1))
-    max_stride = max(num_cameras // max(k, 1), 1)
+    max_stride = max(num_cameras // max(kc, 1), 1)
     stride = 1 + r.integers(0, max_stride, size=(num_points, 1))
-    cam_idx = ((base + np.arange(k)[None, :] * stride) % num_cameras).reshape(-1)
-    pt_idx = np.repeat(np.arange(num_points), k)
+    grid = (base + np.arange(kc)[None, :] * stride) % num_cameras
+    keep = np.ones((num_points, kc), dtype=bool)
+    if kc > kf:
+        keep[n_hi:, kf:] = False
+    cam_idx = grid[keep]
+    pt_idx = np.broadcast_to(np.arange(num_points)[:, None], (num_points, kc))[keep]
     # Guarantee every camera appears (random draws may miss some).
     missing = np.setdiff1d(np.arange(num_cameras), cam_idx, assume_unique=False)
     if missing.size:
         cam_idx = np.concatenate([cam_idx, missing])
         pt_idx = np.concatenate(
             [pt_idx, r.integers(0, num_points, size=missing.size)])
-    uv = _project_batch(cameras_gt[cam_idx], points_gt[pt_idx])
+    # Chunk the projection: at BAL-Final scale (~29M edges) one shot would
+    # materialise ~10 float64 [nE,3] temporaries (~7 GB host RAM).
+    n_edge_total = cam_idx.shape[0]
+    chunk = 4_000_000
+    if n_edge_total <= chunk:
+        uv = _project_batch(cameras_gt[cam_idx], points_gt[pt_idx])
+    else:
+        uv = np.empty((n_edge_total, 2))
+        for lo in range(0, n_edge_total, chunk):
+            hi = min(lo + chunk, n_edge_total)
+            uv[lo:hi] = _project_batch(
+                cameras_gt[cam_idx[lo:hi]], points_gt[pt_idx[lo:hi]])
     obs = uv + r.normal(scale=pixel_noise, size=uv.shape)
 
     order = np.argsort(cam_idx, kind="stable")  # BAL files are cam-sorted

@@ -110,17 +110,19 @@ def make_residual_fn(
     return jax.vmap(residual_fn, in_axes=(0, 0, 0))
 
 
-@functools.lru_cache(maxsize=64)
-def make_residual_jacobian_fn(
+def build_residual_jacobian_fn(
     residual_fn: ResidualFn = bal_residual,
     mode: JacobianMode = JacobianMode.AUTODIFF,
     analytical_fn: Optional[Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]] = None,
 ) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
-    """Build the vectorised residual+Jacobian evaluator.
+    """Build the vectorised residual+Jacobian evaluator (uncached).
 
-    Memoised so repeated construction with the same engine config returns
-    the identical callable — keeping jax.jit / the distributed solve cache
-    hot across separate solves.
+    Use this directly for per-problem closure engines (BaseProblem's
+    custom edges): routing those through the memoised wrapper would pin
+    each closure — and the prototype edge it captures — in a global
+    cache long after the problem is dropped.  `make_residual_jacobian_fn`
+    below is the memoised front for hashable, long-lived configs
+    (built-in engines, module-level residual functions).
 
     Returns fn(cam_params[nE,cd], pt_params[nE,pd], obs[nE,od])
       -> (r[nE,od], Jc[nE,od,cd], Jp[nE,od,pd]).
@@ -172,6 +174,20 @@ def make_residual_jacobian_fn(
         return r, Jc, Jp
 
     return jax.vmap(value_and_jac, in_axes=(0, 0, 0))
+
+
+@functools.lru_cache(maxsize=64)
+def make_residual_jacobian_fn(
+    residual_fn: ResidualFn = bal_residual,
+    mode: JacobianMode = JacobianMode.AUTODIFF,
+    analytical_fn: Optional[Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]] = None,
+) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """Memoised `build_residual_jacobian_fn` — same engine config returns
+    the identical callable, keeping jax.jit / the distributed solve cache
+    hot across separate solves.  Only pass long-lived hashable
+    `residual_fn`s (module-level functions); per-problem closures go
+    through `build_residual_jacobian_fn` to avoid cache retention."""
+    return build_residual_jacobian_fn(residual_fn, mode, analytical_fn)
 
 
 def apply_sqrt_info(

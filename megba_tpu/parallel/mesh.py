@@ -105,6 +105,7 @@ def distributed_lm_solve(
     pallas_plan=None,
     initial_region=None,
     initial_v=None,
+    jit_cache: Optional[dict] = None,
 ) -> LMResult:
     """Run the full LM solve SPMD over the mesh's edge axis.
 
@@ -130,9 +131,12 @@ def distributed_lm_solve(
     dtype = cameras.dtype
     ir = option.algo_option.initial_region if initial_region is None else initial_region
     iv = 2.0 if initial_v is None else initial_v
+    from megba_tpu.algo.lm import _next_verbose_token
+
     args = [cameras, points, obs, cam_idx, pt_idx, mask,
-            jnp.asarray(ir, dtype), jnp.asarray(iv, dtype)]
-    in_specs = [rep, rep, edge, edge, edge, edge, rep, rep]
+            jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
+            jnp.asarray(_next_verbose_token(), jnp.int32)]
+    in_specs = [rep, rep, edge, edge, edge, edge, rep, rep, rep]
     optional = [
         ("sqrt_info", sqrt_info, edge),
         ("cam_fixed", cam_fixed, rep),
@@ -142,7 +146,8 @@ def distributed_lm_solve(
     args += [v for _, v, _ in optional if v is not None]
     in_specs += [spec for _, v, spec in optional if v is not None]
 
-    jitted = _cached_sharded_solve(
+    jitted = get_or_build_program(
+        jit_cache, _cached_sharded_solve, _build_sharded_solve,
         residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
         cam_sorted, pallas_plan)
 
@@ -150,25 +155,48 @@ def distributed_lm_solve(
         return jitted(*args)
 
 
-@functools.lru_cache(maxsize=64)
-def _cached_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
-                          cam_sorted=False, pallas_plan=None):
-    """Build-and-cache the jitted shard_map'ed solve.
+def get_or_build_program(jit_cache, cached_fn, build_fn, engine, *cfg):
+    """Fetch/compile a jitted solve program.
 
-    jax.jit caches by callable identity, so rebuilding the closure every
-    call would recompile the full LM+PCG program per solve; caching on
-    (engine fn, mesh, option, operand layout) pays tracing + compilation
-    once per configuration.  ProblemOption is frozen/hashable for exactly
-    this purpose.
+    `jit_cache is None` -> the global lru (`cached_fn`) for long-lived
+    engines.  Otherwise the caller-owned dict, keyed by the FULL builder
+    argument list `(engine, *cfg)` — the key is exactly what `build_fn`
+    receives, so it cannot drift out of sync with the configuration and
+    serve a program compiled for different options (and a shared dict can
+    never return a program compiled for a different engine).  Used by both
+    solve.flat_solve and distributed_lm_solve; per-problem closure engines
+    go through the dict path so their programs die with the problem.
     """
+    if jit_cache is None:
+        return cached_fn(engine, *cfg)
+    key = (engine, *cfg)
+    prog = jit_cache.get(key)
+    if prog is None:
+        prog = jit_cache[key] = build_fn(engine, *cfg)
+    return prog
+
+
+def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
+                         cam_sorted=False, pallas_plan=None):
+    """Build the jitted shard_map'ed solve (uncached)."""
 
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
-           *extras):
+           verbose_token, *extras):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
             pallas_plan=pallas_plan, initial_region=init_region,
-            initial_v=init_v, **dict(zip(keys, extras)))
+            initial_v=init_v, verbose_token=verbose_token,
+            **dict(zip(keys, extras)))
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
     return jax.jit(sharded)
+
+
+# Global program cache for long-lived engines.  jax.jit caches by callable
+# identity, so rebuilding the closure every call would recompile the full
+# LM+PCG program per solve; caching on (engine fn, mesh, option, operand
+# layout) pays tracing + compilation once per configuration.  ProblemOption
+# is frozen/hashable for exactly this purpose.  Per-problem closure engines
+# use the caller-owned jit_cache path above instead.
+_cached_sharded_solve = functools.lru_cache(maxsize=64)(_build_sharded_solve)

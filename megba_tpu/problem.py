@@ -30,6 +30,7 @@ from megba_tpu.common import JacobianMode, ProblemOption, validate_options
 from megba_tpu.ops.residuals import (
     bal_residual,
     bal_residual_jacobian_analytical,
+    build_residual_jacobian_fn,
     make_residual_jacobian_fn,
 )
 
@@ -139,7 +140,7 @@ def _edge_residual_jac_fn(proto: BaseEdge):
             proto._traced_estimations = None
             proto._traced_measurement = None
 
-    return make_residual_jacobian_fn(
+    return build_residual_jacobian_fn(
         residual_fn=residual, mode=JacobianMode.AUTODIFF)
 
 
@@ -162,6 +163,11 @@ class BaseProblem:
         self._edges: List[BaseEdge] = []
         self._edge_type: Optional[type] = None
         self._engine: Optional[Callable] = None  # cached custom-edge engine
+        # Problem-owned jitted-program cache for custom-edge engines: the
+        # engine closure bakes in THIS problem's prototype edge, so its
+        # compiled programs must die with the problem, not sit in the
+        # global lru (see solve.flat_solve jit_cache).
+        self._jit_cache: dict = {}
         self.result: Optional[LMResult] = None
 
     # -- graph construction ------------------------------------------------
@@ -206,6 +212,7 @@ class BaseProblem:
         self._vertex_ids.discard(id(v))
         self._edges = [e for e in self._edges if all(u is not v for u in e.vertices)]
         self._engine = None
+        self._jit_cache.clear()
         if not self._edges:
             self._edge_type = None
 
@@ -248,10 +255,12 @@ class BaseProblem:
             self._edge_type is not None
             and self._edge_type.forward is not BaseEdge.forward
         )
+        jit_cache = None
         if custom_forward:
             if self._engine is None:
                 self._engine = _edge_residual_jac_fn(self._edges[0])
             residual_jac_fn = self._engine
+            jit_cache = self._jit_cache
         else:
             residual_jac_fn = make_residual_jacobian_fn(mode=opt.jacobian_mode)
 
@@ -264,7 +273,7 @@ class BaseProblem:
             sqrt_info=sqrt_info,
             cam_fixed=cam_fixed if cam_fixed.any() else None,
             pt_fixed=pt_fixed if pt_fixed.any() else None,
-            verbose=verbose)
+            verbose=verbose, jit_cache=jit_cache)
 
         # Write back (reference base_problem.cpp:249-272).
         cams_out = np.asarray(result.cameras, dtype=np.float64)

@@ -24,26 +24,38 @@ from megba_tpu.common import ProblemOption, validate_options
 from megba_tpu.core.types import is_cam_sorted
 from megba_tpu.io.bal import BALFile, load_bal
 from megba_tpu.ops.residuals import make_residual_jacobian_fn
-from megba_tpu.parallel.mesh import distributed_lm_solve, make_mesh, shard_edge_arrays
+from megba_tpu.parallel.mesh import (
+    distributed_lm_solve,
+    get_or_build_program,
+    make_mesh,
+    shard_edge_arrays,
+)
 
 
-@functools.lru_cache(maxsize=64)
-def _cached_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted,
-                         pallas_plan):
-    """Jitted single-device solve, cached per configuration (same pitfall
-    and remedy as parallel.mesh._cached_sharded_solve).  The trust-region
-    resume state rides as dynamic operands so chunked/checkpointed solves
-    reuse one compilation."""
+def _build_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted,
+                        pallas_plan):
+    """Jitted single-device solve.  The trust-region resume state rides as
+    dynamic operands so chunked/checkpointed solves reuse one
+    compilation."""
 
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
-           *extras):
+           verbose_token, *extras):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, verbose=verbose, cam_sorted=cam_sorted,
             pallas_plan=pallas_plan, initial_region=init_region,
-            initial_v=init_v, **dict(zip(keys, extras)))
+            initial_v=init_v, verbose_token=verbose_token,
+            **dict(zip(keys, extras)))
 
     return jax.jit(fn)
+
+
+# Global program cache for long-lived engines (same pitfall and remedy as
+# parallel.mesh._cached_sharded_solve).  Per-problem closure engines must
+# NOT land here — a global entry would pin the closure (and the prototype
+# edge it captures) past its problem's lifetime; they use a caller-owned
+# jit_cache instead (see flat_solve).
+_cached_single_solve = functools.lru_cache(maxsize=64)(_build_single_solve)
 
 
 def flat_solve(
@@ -61,12 +73,16 @@ def flat_solve(
     pallas_plan: Optional[Tuple[int, int]] = None,
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
+    jit_cache: Optional[dict] = None,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
 
     Edges are camera-sorted here (native counting sort) if they are not
     already; `sqrt_info` rides the same permutation.  `option.world_size`
-    selects the mesh; jitted programs are cached per configuration.
+    selects the mesh; jitted programs are cached per configuration —
+    globally for long-lived engines, or in the caller-owned `jit_cache`
+    dict when the engine is a per-problem closure whose lifetime must not
+    exceed its problem's (BaseProblem passes its own dict).
     """
     dtype = np.dtype(option.dtype)
     if dtype == np.float64 and not jax.config.jax_enable_x64:
@@ -116,21 +132,26 @@ def flat_solve(
             jnp.asarray(mask), option, mesh,
             sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
             verbose=verbose, cam_sorted=True, pallas_plan=pallas_plan,
-            initial_region=initial_region, initial_v=initial_v)
+            initial_region=initial_region, initial_v=initial_v,
+            jit_cache=jit_cache)
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
                 ("pt_fixed", pt_fixed_j)]
     keys = tuple(k for k, v in optional if v is not None)
     extras = [v for _, v in optional if v is not None]
-    jitted = _cached_single_solve(
+    jitted = get_or_build_program(
+        jit_cache, _cached_single_solve, _build_single_solve,
         residual_jac_fn, option, keys, verbose, True, pallas_plan)
     ir = option.algo_option.initial_region if initial_region is None else initial_region
     iv = 2.0 if initial_v is None else initial_v
+    from megba_tpu.algo.lm import _next_verbose_token
+
     return jitted(
         jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
         jnp.asarray(cam_idx), jnp.asarray(pt_idx),
         jnp.ones(obs.shape[0], dtype=dtype),
-        jnp.asarray(ir, dtype), jnp.asarray(iv, dtype), *extras)
+        jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
+        jnp.asarray(_next_verbose_token(), jnp.int32), *extras)
 
 
 def solve_bal(

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from megba_tpu.common import ComputeKind, PreconditionerKind
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
+from megba_tpu.ops.accum import comp_dot
 
 HI = jax.lax.Precision.HIGHEST
 
@@ -103,11 +104,13 @@ def block_inv(H: jax.Array) -> jax.Array:
 
 
 def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
-    # Elementwise multiply + sum stays on the VPU at full precision (a
-    # dot_general could drop to bf16 on TPU).  Vectors are replicated
-    # across shards, so no psum is needed — unlike the reference's
-    # per-rank sliced dots + host sum (schur_pcg_solver.cu:277-287).
-    return jnp.sum(a * b)
+    # Compensated elementwise multiply + two-sum tree (ops/accum.py):
+    # stays on the VPU, f64-class accuracy in f32 — alpha/beta from
+    # noisy dots stall CG convergence at BAL-Final scale.  Vectors are
+    # replicated across shards, so no psum is needed — unlike the
+    # reference's per-rank sliced dots + host sum
+    # (schur_pcg_solver.cu:277-287).
+    return comp_dot(a, b)
 
 
 def make_coupling_matvecs(

@@ -102,3 +102,30 @@ def test_multihost_helper_single_process():
     info = initialize_multihost()
     assert info["process_count"] >= 1
     assert info["global_devices"] >= info["local_devices"] >= 1
+
+
+def test_resume_rejects_foreign_checkpoint(tmp_path):
+    """A snapshot from a different problem must be refused, not silently
+    resumed (jnp.take would clamp mismatched indices into garbage)."""
+    import pytest
+
+    f, args, _, option = setup(seed=2)
+    ck = str(tmp_path / "run.npz")
+    solve_checkpointed(f, *args, option, checkpoint_path=ck,
+                       checkpoint_every=4)
+    # Same shapes, different topology (different seed -> different graph).
+    s2 = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                            seed=3, param_noise=4e-2, pixel_noise=0.3)
+    args2 = (jnp.asarray(s2.cameras0), jnp.asarray(s2.points0),
+             jnp.asarray(s2.obs), jnp.asarray(s2.cam_idx),
+             jnp.asarray(s2.pt_idx))
+    with pytest.raises(ValueError, match="different problem"):
+        solve_checkpointed(f, *args2, option, checkpoint_path=ck,
+                           checkpoint_every=4)
+    # Pre-guard snapshots (no fingerprint recorded) are refused too.
+    st = load_state(ck)
+    st.pop("extra_topology")
+    np.savez(ck, **st)
+    with pytest.raises(ValueError, match="different problem"):
+        solve_checkpointed(f, *args, option, checkpoint_path=ck,
+                           checkpoint_every=4)
