@@ -20,6 +20,7 @@ on-device: no host-blocking residual-norm or dot reductions
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 from typing import Callable, Optional, Tuple
 
@@ -65,13 +66,9 @@ def _emit_verbose_line(token, k, c, a, p):
         f"elapsed {dt:.1f} ms", flush=True)
 
 
-# Monotonic per-solve token source for the verbose clock.
-_VERBOSE_TOKEN = {"next": 0}
-
-
-def _next_verbose_token() -> int:
-    _VERBOSE_TOKEN["next"] += 1
-    return _VERBOSE_TOKEN["next"]
+# Monotonic per-solve token source for the verbose clock.  count().__next__
+# is atomic under the GIL, so concurrent solves can never share a token.
+_next_verbose_token = itertools.count(1).__next__
 
 
 @jax.tree_util.register_dataclass

@@ -56,22 +56,29 @@ def _is_ram_backed(directory: str) -> bool:
     """
     try:
         best_fs, best_len = "", -1
-        with open("/proc/mounts") as f:
-            real = os.path.realpath(directory)
+        # surrogateescape: the kernel passes non-UTF-8 mountpoint bytes
+        # through raw; they must not raise out of a path heuristic.
+        with open("/proc/mounts", errors="surrogateescape") as f:
+            real = os.fsencode(os.path.realpath(directory))
             for line in f:
                 parts = line.split()
                 if len(parts) < 3:
                     continue
-                # /proc/mounts octal-escapes specials (space -> \040).
-                mnt = parts[1].encode().decode("unicode_escape")
+                # /proc/mounts octal-escapes exactly \040 \011 \012 \134
+                # (space, tab, newline, backslash); decode those at the
+                # byte level so non-ASCII mountpoints compare correctly.
+                mnt = os.fsencode(parts[1])
+                for esc, raw in ((rb"\040", b" "), (rb"\011", b"\t"),
+                                 (rb"\012", b"\n"), (rb"\134", b"\\")):
+                    mnt = mnt.replace(esc, raw)
                 fstype = parts[2]
                 # >= : of duplicate mountpoint entries the LAST one listed
                 # is the effective (over)mount.
-                if (real == mnt or real.startswith(mnt.rstrip("/") + "/")) \
+                if (real == mnt or real.startswith(mnt.rstrip(b"/") + b"/")) \
                         and len(mnt) >= best_len:
                     best_fs, best_len = fstype, len(mnt)
         return best_fs in ("tmpfs", "ramfs")
-    except OSError:
+    except (OSError, ValueError):
         return False
 
 

@@ -73,33 +73,51 @@ def camera_window_plan(
     return True, window
 
 
-def _hessian_cam_kernel(starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, window, cd, od):
+def _hessian_cam_kernel(
+    starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, window, cd, od
+):
     """One tile: partial (Hpp, g) sums for `window` consecutive cameras.
 
     out_ref block: [1, window, cd*cd + cd] — H flattened then g.
+
+    Strategy: build the per-edge feature matrix [tile, cd*cd + cd]
+    (outer-product columns of J_o^T J_o summed over residual components,
+    then -J^T r columns) with cheap elementwise ops, and reduce it onto
+    the window axis with ONE MXU matmul `onehot^T @ feat` per tile.
+    This keeps VMEM tiny (one [tile, ~90] buffer) and avoids both the
+    (cd,cd)->(cd*cd,) vector reshape Mosaic cannot lower and the
+    window*od unrolled small-dot pattern that overflowed scoped VMEM.
     """
     i = pl.program_id(0)
     base = starts_ref[i]
+    tile = cam_idx_ref.shape[0]
     local = cam_idx_ref[:, 0] - base  # [tile] ints in [0, window) by plan
 
-    for w in range(window):  # static unroll: window small (16-64)
-        oh = (local == w).astype(jc_ref.dtype)[:, None]  # [tile, 1]
-        acc_h = jnp.zeros((cd, cd), dtype=jnp.float32)
-        acc_g = jnp.zeros((cd,), dtype=jnp.float32)
-        for o in range(od):  # residual components (BAL: 2)
+    cols = []
+    for a in range(cd):  # static: cd small (BAL: 9)
+        acc = None
+        for o in range(od):
             jo = jc_ref[:, o * cd : (o + 1) * cd]  # [tile, cd]
-            jom = jo * oh
-            acc_h = acc_h + jax.lax.dot_general(
-                jom, jo, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)
-            ro = r_ref[:, o : o + 1]  # [tile, 1]
-            acc_g = acc_g - jax.lax.dot_general(
-                jom, ro, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)[:, 0]
-        out_ref[0, w, 0 : cd * cd] = acc_h.reshape(cd * cd).astype(out_ref.dtype)
-        out_ref[0, w, cd * cd : cd * cd + cd] = acc_g.astype(out_ref.dtype)
+            term = jo[:, a : a + 1] * jo  # [tile, cd]
+            acc = term if acc is None else acc + term
+        cols.append(acc)  # row a of the (cd, cd) outer-product block
+    ge = None
+    for o in range(od):
+        jo = jc_ref[:, o * cd : (o + 1) * cd]
+        term = jo * r_ref[:, o : o + 1]
+        ge = term if ge is None else ge + term
+    cols.append(-ge)
+    feat_mat = jnp.concatenate(cols, axis=1)  # [tile, cd*cd + cd]
+
+    onehot = (
+        local[:, None]
+        == jax.lax.broadcasted_iota(jnp.int32, (tile, window), 1)
+    ).astype(feat_mat.dtype)
+    out_ref[0, :, :] = jax.lax.dot_general(
+        onehot, feat_mat, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(out_ref.dtype)
 
 
 @functools.partial(
@@ -158,8 +176,8 @@ def camera_hessian_gradient(
     )(starts, cam_idx[:, None].astype(jnp.int32), jc_flat, r)
 
     # Combine: scatter-add each tile's window into the (padded) camera
-    # axis.  [n_tiles, window, feat] is tiny next to the per-edge outer
-    # products the XLA path would materialise.
+    # axis.  The [n_tiles, window, feat] partials are tiny next to the
+    # per-edge outer products the XLA path would materialise.
     cam_targets = starts[:, None] + jnp.arange(window)[None, :]  # [n_tiles, window]
     out = jnp.zeros((num_cameras + window, feat), dtype)
     out = out.at[cam_targets.reshape(-1)].add(partials.reshape(-1, feat))
