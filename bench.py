@@ -141,7 +141,11 @@ def _probe_pallas(cam_idx):
     import jax
     import jax.numpy as jnp
 
-    from megba_tpu.ops.pallas_kernels import camera_hessian_gradient, camera_window_plan
+    from megba_tpu.ops.pallas_kernels import (
+        DEFAULT_TILE,
+        camera_hessian_gradient,
+        camera_window_plan,
+    )
 
     mode = os.environ.get("MEGBA_BENCH_PALLAS", "auto")
     if mode == "0":
@@ -149,7 +153,7 @@ def _probe_pallas(cam_idx):
     ok, window = camera_window_plan(cam_idx)
     if not ok:
         return None
-    plan = (512, window)
+    plan = (DEFAULT_TILE, window)
     if mode == "1":
         return plan
     if jax.default_backend() != "tpu":
@@ -157,15 +161,15 @@ def _probe_pallas(cam_idx):
         # only the real TPU lowering is a performance win.
         return None
     try:
-        n, cd, od = 1024, 9, 2
-        jc = jnp.ones((n, od, cd), jnp.float32)
-        r = jnp.ones((n, od), jnp.float32)
+        n, cd, od = 2 * DEFAULT_TILE, 9, 2
+        jc = jnp.ones((od * cd, n), jnp.float32)
+        r = jnp.ones((od, n), jnp.float32)
         ci = jnp.asarray(np.repeat(np.arange(8), n // 8), jnp.int32)
-        hpp, g = camera_hessian_gradient(
-            jc, r, ci, num_cameras=8, tile=512, window=window,
+        hpp_rows, g = camera_hessian_gradient(
+            jc, r, ci, num_cameras=8, tile=DEFAULT_TILE, window=window,
             interpret=False)  # probe only runs on the TPU backend
         expect = float(n // 8 * od)
-        assert abs(float(hpp[0, 0, 0]) - expect) < 1e-2
+        assert abs(float(hpp_rows[0, 0]) - expect) < 1e-2
         return plan
     except Exception as e:  # pragma: no cover - backend specific
         import sys
@@ -233,20 +237,25 @@ def main() -> None:
     )
     f = make_residual_jacobian_fn(mode=jac_mode)
 
-    args = (
-        jnp.asarray(s.cameras0),
-        jnp.asarray(s.points0),
-        jnp.asarray(s.obs),
-        jnp.asarray(s.cam_idx),
-        jnp.asarray(s.pt_idx),
-        jnp.ones(n_edge, dtype=dtype),
-    )
+    # Feature-major lowering (core/fm.py): params/obs transposed, edge
+    # axis padded to the Pallas/chunk quantum with masked edges.
+    from megba_tpu.core.fm import EDGE_QUANTUM
+    from megba_tpu.core.types import is_cam_sorted, pad_edges
 
-    from megba_tpu.core.types import is_cam_sorted
+    obs_p, cam_idx_p, pt_idx_p, mask = pad_edges(
+        s.obs, s.cam_idx, s.pt_idx, EDGE_QUANTUM, dtype=dtype)
+    args = (
+        jnp.asarray(s.cameras0.T),
+        jnp.asarray(s.points0.T),
+        jnp.asarray(np.ascontiguousarray(obs_p.T)),
+        jnp.asarray(cam_idx_p),
+        jnp.asarray(pt_idx_p),
+        jnp.asarray(mask),
+    )
 
     cam_sorted = is_cam_sorted(s.cam_idx)
     pallas_plan = (
-        _probe_pallas(s.cam_idx)
+        _probe_pallas(cam_idx_p)
         if cam_sorted and dtype == np.float32 else None
     )
     solve = jax.jit(

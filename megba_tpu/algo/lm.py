@@ -110,16 +110,18 @@ def lm_solve(
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
-    `residual_jac_fn(cam_params, pt_params, obs) -> (r, Jc, Jp)` is the
-    vectorised engine from ops.residuals.  Edge-axis arrays (obs, cam_idx,
+    FEATURE-MAJOR contract (core/fm.py): cameras [cd, Nc], points
+    [pd, Np], obs [od, nE], sqrt_info [od*od, nE];
+    `residual_jac_fn(cam_rows, pt_rows, obs) -> (r, Jc, Jp)` is the
+    row-form engine from ops.residuals.  Edge-axis arrays (obs, cam_idx,
     pt_idx, mask, sqrt_info) may be shard-local when `axis_name` names a
     mesh axis; cameras/points are replicated.
 
     `initial_region`/`initial_v` override the trust-region start state —
     the resume hook used by utils.checkpoint / solve_checkpointed.
     """
-    num_cameras = cameras.shape[0]
-    num_points = points.shape[0]
+    num_cameras = cameras.shape[1]
+    num_points = points.shape[1]
     algo_opt = option.algo_option
     solver_opt = option.solver_option
     compute_kind = option.compute_kind
@@ -131,8 +133,8 @@ def lm_solve(
     robust_delta = option.robust_delta
 
     def linearize(cams, pts):
-        r, Jc, Jp = residual_jac_fn(jnp.take(cams, cam_idx, axis=0),
-                                    jnp.take(pts, pt_idx, axis=0), obs)
+        r, Jc, Jp = residual_jac_fn(jnp.take(cams, cam_idx, axis=1),
+                                    jnp.take(pts, pt_idx, axis=1), obs)
         r, Jc, Jp = weight_system_inputs(
             r, Jc, Jp, cam_idx, pt_idx, mask, sqrt_info, cam_fixed, pt_fixed)
         # Costs use compensated f32 sums (ops/accum.py): at BAL-Final
@@ -204,12 +206,18 @@ def lm_solve(
         pts_new = s["points"] + dx_pt
 
         # Gain-ratio denominator: linearised cost at dx minus old cost
-        # (the JdxpF kernel, lm_algo.cu:60-126).  J dx + e per edge:
-        jdx = (
-            jnp.einsum("eoc,ec->eo", s["Jc"], jnp.take(dx_cam, cam_idx, axis=0), precision=HI)
-            + jnp.einsum("eop,ep->eo", s["Jp"], jnp.take(dx_pt, pt_idx, axis=0), precision=HI)
-            + s["r"]
-        )
+        # (the JdxpF kernel, lm_algo.cu:60-126).  J dx + e, row form:
+        dxc_e = jnp.take(dx_cam, cam_idx, axis=1)  # [cd, nE]
+        dxp_e = jnp.take(dx_pt, pt_idx, axis=1)  # [pd, nE]
+        od = s["r"].shape[0]
+        cd = dx_cam.shape[0]
+        pd = dx_pt.shape[0]
+        jdx = jnp.stack([
+            sum(s["Jc"][o * cd + a] * dxc_e[a] for a in range(cd))
+            + sum(s["Jp"][o * pd + b] * dxp_e[b] for b in range(pd))
+            + s["r"][o]
+            for o in range(od)
+        ])
         predicted = psum(comp_sum_sq(jdx))
         # The quadratic model is in the (robust-)weighted residuals; its
         # decrease is measured from the carried weighted norm, while

@@ -1,21 +1,29 @@
-"""Block-structured Schur linear system assembly.
+"""Block-structured Schur linear system assembly (feature-major).
 
 TPU-native replacement for the reference's Hessian assembly + CSR
 machinery: the `makeHSchur` atomicAdd kernels
 (reference src/edge/build_linear_system.cu:88-146), the CSR skeleton
 builders (reference src/linear_system/schur_LM_linear_system.cpp:20-84)
 and the positionContainer relativePosition indexing
-(reference src/edge/base_edge.cpp:224-262) all collapse into
-`jax.ops.segment_sum` over gather indices on block-dense arrays:
+(reference src/edge/base_edge.cpp:224-262) all collapse into chunked
+scatter-adds of per-edge outer-product ROWS (see core/fm.py for the
+feature-major layout rationale):
 
-  Hpp [num_cameras, cd, cd]   block-diagonal camera Hessian
-  Hll [num_points,  pd, pd]   block-diagonal point Hessian
-  g   ([num_cameras, cd], [num_points, pd])   gradient -J^T r
+  Hpp [num_cameras, cd, cd]   block-diagonal camera Hessian (small)
+  Hll [pd*pd, num_points]     block-diagonal point Hessian, row form
+  g_cam [cd, num_cameras], g_pt [pd, num_points]   gradient -J^T r
 
-The camera-point coupling Hpl is either materialised as per-edge blocks
-W_e = Jc_e^T Jp_e (EXPLICIT — the analog of the reference's Hpl/Hlp CSR,
-schur_linear_system.h:22-29) or recomputed from the stored Jacobians at
-every matvec (IMPLICIT — the analog of
+The per-edge outer products are never materialised over the full edge
+axis: the build scans edge CHUNKS, building each chunk's feature rows
+[~F, chunk] in registers/VMEM-sized transients and scatter-adding into
+the accumulators — bounding transient HBM to ~100 MB at ANY problem
+scale (the edge-major einsum+segment_sum form needs 41 GB at Venice
+scale from (8,128) tile padding alone).
+
+The camera-point coupling Hpl is either materialised as per-edge block
+rows W [cd*pd, nE] (EXPLICIT — the analog of the reference's Hpl/Hlp
+CSR, schur_linear_system.h:22-29) or recomputed from the stored
+Jacobians at every matvec (IMPLICIT — the analog of
 reference src/solver/implicit_schur_pcg_solver.cu:20-90).  In both modes
 Hpl stays shard-local when the edge axis is sharded: only the
 block-diagonals and the gradient are psum-reduced, mirroring the
@@ -32,12 +40,13 @@ import jax
 import jax.numpy as jnp
 
 from megba_tpu.common import ComputeKind
+from megba_tpu.core.fm import chunked_edge_reduce, coupling_rows, slice_fm
 from megba_tpu.ops.residuals import apply_sqrt_info
 
-# Hessian contractions (J^T J outer products, batched small matmuls) always
-# run at full float32: on TPU the default bf16 matmul precision would
-# corrupt the normal equations.  bf16 is an explicit opt-in for the PCG
-# matvecs only (ProblemOption.mixed_precision_pcg).
+# Hessian contractions always run at full float32: on TPU the default
+# bf16 matmul precision would corrupt the normal equations.  bf16 is an
+# explicit opt-in for the PCG matvecs only
+# (ProblemOption.mixed_precision_pcg).
 HI = jax.lax.Precision.HIGHEST
 
 
@@ -48,18 +57,20 @@ class SchurSystem:
 
     Equivalent of the reference's SchurLMLinearSystem containers
     (include/linear_system/schur_linear_system.h:22-29): csrVal[2]=Hpp,
-    csrVal[3]=Hll, g — plus the per-edge W blocks in EXPLICIT mode
+    csrVal[3]=Hll, g — plus the per-edge W rows in EXPLICIT mode
     (csrVal[0]/csrVal[1]=Hpl/Hlp there).  Undamped; LM damping is applied
-    functionally by `damp_blocks` (the reference's in-place
-    processDiag/recoverDiag save-restore dance,
-    schur_LM_linear_system.cu:112-185, is unnecessary in functional form).
+    functionally (`damp_blocks` / `core.fm.damp_rows_fm` — the
+    reference's in-place processDiag/recoverDiag save-restore dance,
+    schur_LM_linear_system.cu:112-185, is unnecessary in functional
+    form).  Point-side containers are feature-major rows; the camera side
+    is small enough to stay block-batched.
     """
 
     Hpp: jax.Array  # [Nc, cd, cd], psum-reduced (replicated across shards)
-    Hll: jax.Array  # [Np, pd, pd], psum-reduced
-    g_cam: jax.Array  # [Nc, cd], psum-reduced
-    g_pt: jax.Array  # [Np, pd], psum-reduced
-    W: Optional[jax.Array] = None  # [nE_local, cd, pd], shard-local (EXPLICIT)
+    Hll: jax.Array  # [pd*pd, Np] rows, psum-reduced
+    g_cam: jax.Array  # [cd, Nc], psum-reduced
+    g_pt: jax.Array  # [pd, Np], psum-reduced
+    W: Optional[jax.Array] = None  # [cd*pd, nE_local], shard-local (EXPLICIT)
 
 
 def weight_system_inputs(
@@ -75,6 +86,7 @@ def weight_system_inputs(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Apply sqrt-information, padding mask and fixed-vertex masks ONCE.
 
+    Feature-major: r [od, nE], Jc [od*cd, nE], Jp [od*pd, nE], mask [nE].
     The returned (r, Jc, Jp) are what both `build_schur_system` and the
     PCG matvecs consume, so masking can never be double-applied.  Covers
     the reference's JMulInfo pre-weighting
@@ -84,14 +96,30 @@ def weight_system_inputs(
     edges contribute exactly nothing.
     """
     r, Jc, Jp = apply_sqrt_info(r, Jc, Jp, sqrt_info)
-    r = r * mask[:, None]
-    Jc = Jc * mask[:, None, None]
-    Jp = Jp * mask[:, None, None]
+    m = mask[None, :]
+    r = r * m
+    Jc = Jc * m
+    Jp = Jp * m
     if cam_fixed is not None:
-        Jc = jnp.where(cam_fixed[cam_idx][:, None, None], 0.0, Jc)
+        Jc = jnp.where(cam_fixed[cam_idx][None, :], 0.0, Jc)
     if pt_fixed is not None:
-        Jp = jnp.where(pt_fixed[pt_idx][:, None, None], 0.0, Jp)
+        Jp = jnp.where(pt_fixed[pt_idx][None, :], 0.0, Jp)
     return r, Jc, Jp
+
+
+def _outer_rows(J: jax.Array, od: int, d: int) -> jax.Array:
+    """[od*d, n] Jacobian rows -> [d*d, n] rows of J^T J (sum over od)."""
+    return jnp.stack([
+        sum(J[o * d + a] * J[o * d + b] for o in range(od))
+        for a in range(d) for b in range(d)
+    ])
+
+
+def _grad_rows(J: jax.Array, r: jax.Array, od: int, d: int) -> jax.Array:
+    """[od*d, n] Jacobian rows, [od, n] residual -> [d, n] rows of -J^T r."""
+    return jnp.stack([
+        -sum(J[o * d + a] * r[o] for o in range(od)) for a in range(d)
+    ])
 
 
 def build_schur_system(
@@ -111,104 +139,135 @@ def build_schur_system(
 ) -> SchurSystem:
     """Assemble the Schur-form normal equations from per-edge Jacobians.
 
-    `cam_sorted=True` asserts edges are ordered by cam_idx (BAL files are;
-    BaseProblem sorts at lowering) — the camera-side scatter-reduces then
-    run as sorted segment reductions, the cheap path on TPU.
+    Feature-major inputs (already weighted by `weight_system_inputs`):
+    r [od, nE], Jc [od*cd, nE], Jp [od*pd, nE]; cam_idx/pt_idx [nE] int32.
+
+    `cam_sorted=True` asserts edges are ordered by cam_idx (BAL files
+    are; BaseProblem sorts at lowering) — camera-side scatters then run
+    as sorted segment reductions.
 
     `pallas_plan=(tile, window)` (requires cam_sorted) routes the
     camera-side build through the fused Pallas kernel
-    (ops/pallas_kernels.py) instead of materialising per-edge outer
-    products; obtain the plan from `camera_window_plan` host-side.
+    (ops/pallas_kernels.py) instead of scatter-adding chunk partials;
+    obtain the plan from `camera_window_plan` host-side.
 
-    Args:
-      r: [nE, od] residuals, Jc: [nE, od, cd], Jp: [nE, od, pd] — all
-        already weighted by `weight_system_inputs`.
-      cam_idx / pt_idx: [nE] int32 gather indices.
-      axis_name: mesh axis to psum over when the edge axis is sharded
-        (the reference's ncclAllReduce of Hpp/Hll/g,
-        build_linear_system.cu:403-422); None on a single device.
-      cam_fixed / pt_fixed: optional bool masks; fixed vertices get an
-        identity Hessian block and zero gradient so their update is
-        exactly zero.
+    `axis_name`: mesh axis to psum over when the edge axis is sharded
+    (the reference's ncclAllReduce of Hpp/Hll/g,
+    build_linear_system.cu:403-422); None on a single device.
+    `cam_fixed` / `pt_fixed`: optional bool masks; fixed vertices get an
+    identity Hessian block and zero gradient so their update is exactly
+    zero.
     """
-    # Per-edge outer products, then scatter-reduce by vertex — the
-    # race-free functional form of the reference's atomicAdd makeHpp /
-    # makeHll (build_linear_system.cu:116-134).
-    if pallas_plan is not None:
-        from megba_tpu.ops.pallas_kernels import camera_hessian_gradient
+    od = r.shape[0]
+    cd = Jc.shape[0] // od
+    pd = Jp.shape[0] // od
+    nE = r.shape[1]
+    dtype = r.dtype
 
+    use_pallas = pallas_plan is not None
+    if use_pallas:
         if not cam_sorted:
             # The kernel's windowed one-hot silently drops out-of-window
             # edges; without the sortedness guarantee that is data loss,
             # not an optimisation.
             raise ValueError("pallas_plan requires cam_sorted=True")
-        if r.dtype != jnp.float32:
+        if dtype != jnp.float32:
             # The kernel accumulates in float32; silently downgrading a
             # float64 build would corrupt the double-precision pipeline.
             raise ValueError(
-                f"pallas_plan requires float32 inputs, got {r.dtype}; "
+                f"pallas_plan requires float32 inputs, got {dtype}; "
                 "use the XLA path (pallas_plan=None) for other dtypes"
             )
+        from megba_tpu.ops.pallas_kernels import camera_hessian_gradient
+
         tile, window = pallas_plan
-        Hpp, g_cam = camera_hessian_gradient(
+        hpp_rows, g_cam = camera_hessian_gradient(
             Jc, r, cam_idx, num_cameras=num_cameras, tile=tile,
             window=window, interpret=jax.default_backend() != "tpu")
-    else:
-        hpp_e = jnp.einsum("eoi,eoj->eij", Jc, Jc, precision=HI)
-        g_cam_e = -jnp.einsum("eoi,eo->ei", Jc, r, precision=HI)
-        Hpp = jax.ops.segment_sum(hpp_e, cam_idx, num_segments=num_cameras,
-                                  indices_are_sorted=cam_sorted)
-        g_cam = jax.ops.segment_sum(g_cam_e, cam_idx, num_segments=num_cameras,
-                                    indices_are_sorted=cam_sorted)
 
-    hll_e = jnp.einsum("eoi,eoj->eij", Jp, Jp, precision=HI)
-    g_pt_e = -jnp.einsum("eoi,eo->ei", Jp, r, precision=HI)
-    Hll = jax.ops.segment_sum(hll_e, pt_idx, num_segments=num_points)
-    g_pt = jax.ops.segment_sum(g_pt_e, pt_idx, num_segments=num_points)
+    # Chunked scatter-add build: per chunk, form the outer-product rows
+    # [d*d + d, chunk] and accumulate — the race-free functional form of
+    # the reference's atomicAdd makeHpp / makeHll
+    # (build_linear_system.cu:116-134) with bounded transients.
+    def body(start, size, accs):
+        hpp_a, hll_a = accs
+        jp = slice_fm(Jp, start, size)
+        rr = slice_fm(r, start, size)
+        pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
+        if not use_pallas:
+            jc = slice_fm(Jc, start, size)
+            ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
+            cam_feat = jnp.concatenate(
+                [_outer_rows(jc, od, cd), _grad_rows(jc, rr, od, cd)])
+            hpp_a = hpp_a.at[:, ci].add(
+                cam_feat, indices_are_sorted=cam_sorted, mode="drop")
+        pt_feat = jnp.concatenate(
+            [_outer_rows(jp, od, pd), _grad_rows(jp, rr, od, pd)])
+        hll_a = hll_a.at[:, pi].add(pt_feat, mode="drop")
+        return hpp_a, hll_a
+
+    hpp_init = jnp.zeros(
+        (0 if use_pallas else cd * cd + cd, num_cameras), dtype)
+    hll_init = jnp.zeros((pd * pd + pd, num_points), dtype)
+    hpp_acc, hll_acc = chunked_edge_reduce(
+        nE, (hpp_init, hll_init), body)
+
+    if not use_pallas:
+        hpp_rows = hpp_acc[: cd * cd]
+        g_cam = hpp_acc[cd * cd:]
+    Hll = hll_acc[: pd * pd]
+    g_pt = hll_acc[pd * pd:]
 
     if axis_name is not None:
-        Hpp, Hll, g_cam, g_pt = jax.lax.psum((Hpp, Hll, g_cam, g_pt), axis_name)
+        hpp_rows, g_cam, Hll, g_pt = jax.lax.psum(
+            (hpp_rows, g_cam, Hll, g_pt), axis_name)
+
+    # Camera blocks to batched [Nc, cd, cd] (small; dense-block ops and
+    # the 9x9 Cholesky inverse want this form).
+    Hpp = jnp.moveaxis(hpp_rows.reshape(cd, cd, num_cameras), -1, 0)
 
     # Fixed vertices: identity block + zero gradient pins delta to zero.
-    eye_c = jnp.eye(Hpp.shape[-1], dtype=Hpp.dtype)
-    eye_p = jnp.eye(Hll.shape[-1], dtype=Hll.dtype)
+    eye_c = jnp.eye(cd, dtype=dtype)
+    eye_p_rows = jnp.asarray(
+        [1.0 if i % (pd + 1) == 0 else 0.0 for i in range(pd * pd)], dtype)
     if cam_fixed is not None:
         Hpp = jnp.where(cam_fixed[:, None, None], eye_c, Hpp)
-        g_cam = jnp.where(cam_fixed[:, None], 0.0, g_cam)
+        g_cam = jnp.where(cam_fixed[None, :], 0.0, g_cam)
     if pt_fixed is not None:
-        Hll = jnp.where(pt_fixed[:, None, None], eye_p, Hll)
-        g_pt = jnp.where(pt_fixed[:, None], 0.0, g_pt)
+        Hll = jnp.where(pt_fixed[None, :], eye_p_rows[:, None], Hll)
+        g_pt = jnp.where(pt_fixed[None, :], 0.0, g_pt)
 
-    # Edge-less vertices (possible in filtered real datasets) would leave a
-    # zero block that stays singular through multiplicative damping and
-    # NaN-poisons the Cholesky in block_inv.  J^T J is PSD, so a zero
-    # trace identifies exactly the empty blocks; give them an identity
-    # (their gradient is already zero, so their update is exactly zero).
+    # Edge-less vertices (possible in filtered real datasets) would leave
+    # a zero block that stays singular through multiplicative damping and
+    # NaN-poisons the inverse.  J^T J is PSD, so a zero trace identifies
+    # exactly the empty blocks; give them an identity (their gradient is
+    # already zero, so their update is exactly zero).
     empty_c = jnp.trace(Hpp, axis1=-2, axis2=-1) == 0.0
-    empty_p = jnp.trace(Hll, axis1=-2, axis2=-1) == 0.0
     Hpp = jnp.where(empty_c[:, None, None], eye_c, Hpp)
-    Hll = jnp.where(empty_p[:, None, None], eye_p, Hll)
+    tr_rows = [i for i in range(pd * pd) if i % (pd + 1) == 0]
+    empty_p = sum(Hll[i] for i in tr_rows) == 0.0
+    Hll = jnp.where(empty_p[None, :], eye_p_rows[:, None], Hll)
 
     W = None
     if compute_kind == ComputeKind.EXPLICIT:
-        # Shard-local coupling blocks (NOT reduced — the distributed
-        # matvec psums the product instead, mirroring the reference's
+        # Shard-local coupling rows (NOT reduced — the distributed matvec
+        # psums the product instead, mirroring the reference's
         # beta=1/worldSize trick + product allreduce,
         # schur_pcg_solver.cu:478-509).
-        W = jnp.einsum("eoi,eoj->eij", Jc, Jp, precision=HI)
+        W = coupling_rows(Jc, Jp, od)
     return SchurSystem(Hpp=Hpp, Hll=Hll, g_cam=g_cam, g_pt=g_pt, W=W)
 
 
 def damp_blocks(H: jax.Array, region: jax.Array) -> jax.Array:
-    """LM damping: scale block-diagonal entries by (1 + 1/region).
+    """LM damping on batched [N, d, d] blocks: diagonal scales by
+    (1 + 1/region).
 
     The multiplicative damping of the reference's
     extractOldAndApplyNewDiag kernel (schur_LM_linear_system.cu:112-160);
     being functional, there is nothing to save or recover on reject.
+    Row-form point blocks use `core.fm.damp_rows_fm`.
     """
     d = H.shape[-1]
     eye = jnp.eye(d, dtype=H.dtype)
     factor = 1.0 + eye / region
     return H * factor
-
-

@@ -1,25 +1,29 @@
-"""Pallas TPU kernels for the Hessian-assembly hot path.
+"""Pallas TPU kernels for the Hessian-assembly hot path (feature-major).
 
 The fusion the reference gets from its hand-written `makeHSchur` CUDA
 kernel (src/edge/build_linear_system.cu:88-146 — one pass over the
 Jacobians, accumulating Hpp and g in shared memory/atomics), rebuilt for
-the TPU memory hierarchy: the XLA path materialises the per-edge outer
-products `hpp_e [nE,9,9]` in HBM (~728 B/edge of traffic for Hpp at
-float32: write + re-read + the Jacobian read); this kernel computes them
-in VMEM and reduces tile-locally, so HBM sees only the Jacobian/residual
-read (~80 B/edge) plus a tiny per-tile partial buffer.
+the TPU memory hierarchy: the XLA path scatter-adds chunked outer-product
+rows (builder.py) — still one extra HBM round-trip of the [90, chunk]
+feature rows; this kernel computes those rows in VMEM and reduces them
+tile-locally with ONE MXU matmul per tile, so HBM sees only the
+Jacobian/residual read plus a tiny per-tile partial buffer.
 
 Layout exploited: edges are camera-sorted (BaseProblem lowering
 guarantees it), so each tile of `tile` edges touches a narrow window of
 consecutive cameras.  Each grid step emits its window's partial sums
 `[window, cd*cd + cd]`; a cheap XLA scatter-add combines the
-`[n_tiles, window, ...]` partials (a few MB) into the final blocks.
+`[n_tiles, window, ...]` partials (a few MB) into the final rows.
 
 The camera window start per tile is just `cam_idx[i*tile]` — data-
 dependent, delivered via `PrefetchScalarGridSpec` scalar prefetch.
 Feasibility (every tile spans < window cameras) is a static property of
 the problem topology; `camera_window_plan` checks it host-side at
 lowering.
+
+Mosaic constraints honoured (learned the hard way): no in-register
+reshapes that move data across lanes (e.g. (9,9)->(81,)), everything
+2-D, reductions expressed as lane-contracting `dot_general`s.
 """
 
 from __future__ import annotations
@@ -33,7 +37,9 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_TILE = 512
+from megba_tpu.core.fm import EDGE_QUANTUM
+
+DEFAULT_TILE = EDGE_QUANTUM  # lowering pads the edge axis to this quantum
 DEFAULT_WINDOW = 16
 
 
@@ -49,8 +55,8 @@ def camera_window_plan(
     stays valid for any shard boundary when the edge axis is split by
     shard_map.  Returns the smallest power-of-two window covering the
     worst tile (min DEFAULT_WINDOW), or (False, 0) when it would exceed
-    `max_window` — the kernel statically unrolls the window loop, so
-    large windows mean huge programs; fall back to the XLA path instead.
+    `max_window` — wide windows mean most one-hot matmul work is zeros;
+    fall back to the XLA path instead.
     """
     n = len(cam_idx)
     if n == 0:
@@ -76,45 +82,42 @@ def camera_window_plan(
 def _hessian_cam_kernel(
     starts_ref, cam_idx_ref, jc_ref, r_ref, out_ref, *, window, cd, od
 ):
-    """One tile: partial (Hpp, g) sums for `window` consecutive cameras.
+    """One tile: partial (Hpp rows, g rows) sums for `window` cameras.
 
-    out_ref block: [1, window, cd*cd + cd] — H flattened then g.
+    jc_ref block [od*cd, tile], r_ref [od, tile], cam_idx_ref [1, tile];
+    out_ref block [1, window, cd*cd + cd].
 
-    Strategy: build the per-edge feature matrix [tile, cd*cd + cd]
-    (outer-product columns of J_o^T J_o summed over residual components,
-    then -J^T r columns) with cheap elementwise ops, and reduce it onto
-    the window axis with ONE MXU matmul `onehot^T @ feat` per tile.
-    This keeps VMEM tiny (one [tile, ~90] buffer) and avoids both the
-    (cd,cd)->(cd*cd,) vector reshape Mosaic cannot lower and the
-    window*od unrolled small-dot pattern that overflowed scoped VMEM.
+    Build the per-edge feature rows (outer-product rows of J^T J summed
+    over residual components, then -J^T r rows) with elementwise ops on
+    (1, tile) slices, and reduce onto the window axis with ONE MXU
+    matmul `onehot @ feat^T` per tile.
     """
     i = pl.program_id(0)
     base = starts_ref[i]
-    tile = cam_idx_ref.shape[0]
-    local = cam_idx_ref[:, 0] - base  # [tile] ints in [0, window) by plan
+    tile = cam_idx_ref.shape[1]
 
-    cols = []
+    rows = []
     for a in range(cd):  # static: cd small (BAL: 9)
+        for b in range(cd):
+            acc = None
+            for o in range(od):
+                term = jc_ref[o * cd + a, :] * jc_ref[o * cd + b, :]
+                acc = term if acc is None else acc + term
+            rows.append(acc[None, :])
+    for a in range(cd):
         acc = None
         for o in range(od):
-            jo = jc_ref[:, o * cd : (o + 1) * cd]  # [tile, cd]
-            term = jo[:, a : a + 1] * jo  # [tile, cd]
+            term = jc_ref[o * cd + a, :] * r_ref[o, :]
             acc = term if acc is None else acc + term
-        cols.append(acc)  # row a of the (cd, cd) outer-product block
-    ge = None
-    for o in range(od):
-        jo = jc_ref[:, o * cd : (o + 1) * cd]
-        term = jo * r_ref[:, o : o + 1]
-        ge = term if ge is None else ge + term
-    cols.append(-ge)
-    feat_mat = jnp.concatenate(cols, axis=1)  # [tile, cd*cd + cd]
+        rows.append(-acc[None, :])
+    feat_mat = jnp.concatenate(rows, axis=0)  # [cd*cd + cd, tile]
 
+    local = cam_idx_ref[:, :] - base  # [1, tile] ints in [0, window)
     onehot = (
-        local[:, None]
-        == jax.lax.broadcasted_iota(jnp.int32, (tile, window), 1)
+        jax.lax.broadcasted_iota(jnp.int32, (window, tile), 0) == local
     ).astype(feat_mat.dtype)
     out_ref[0, :, :] = jax.lax.dot_general(
-        onehot, feat_mat, (((0,), (0,)), ((), ())),
+        onehot, feat_mat, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     ).astype(out_ref.dtype)
@@ -133,36 +136,39 @@ def camera_hessian_gradient(
     window: int = DEFAULT_WINDOW,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Fused camera-side Hessian diagonal + gradient.
+    """Fused camera-side Hessian diagonal + gradient, feature-major.
 
-    Jc: [nE, od, cd] weighted camera Jacobians (camera-sorted edges),
-    r: [nE, od] weighted residuals, cam_idx: [nE] int32 nondecreasing.
-    Returns (Hpp [num_cameras, cd, cd], g_cam [num_cameras, cd]) equal to
-    the segment_sum path up to float addition order.
+    Jc: [od*cd, nE] weighted camera Jacobian rows (camera-sorted edges),
+    r: [od, nE] weighted residual rows, cam_idx: [nE] int32 nondecreasing.
+    Returns (hpp_rows [cd*cd, num_cameras], g_cam [cd, num_cameras])
+    equal to the scatter-add path up to float addition order.
     """
-    nE, od, cd = Jc.shape
+    ocd, nE = Jc.shape
+    od = r.shape[0]
+    cd = ocd // od
     dtype = Jc.dtype
 
     # Pad edge axis to a tile multiple with inert rows (zero J/r; camera
-    # index repeats the last edge so tiles stay sorted).
+    # index repeats the last edge so tiles stay sorted).  Lowering pads
+    # to EDGE_QUANTUM already, so this is normally a no-op.
     n_pad = (-nE) % tile
     if n_pad:
-        Jc = jnp.concatenate([Jc, jnp.zeros((n_pad, od, cd), dtype)])
-        r = jnp.concatenate([r, jnp.zeros((n_pad, od), dtype)])
-        cam_idx = jnp.concatenate([cam_idx, jnp.broadcast_to(cam_idx[-1], (n_pad,))])
-    n_tiles = Jc.shape[0] // tile
+        Jc = jnp.pad(Jc, ((0, 0), (0, n_pad)))
+        r = jnp.pad(r, ((0, 0), (0, n_pad)))
+        cam_idx = jnp.concatenate(
+            [cam_idx, jnp.broadcast_to(cam_idx[-1], (n_pad,))])
+    n_tiles = Jc.shape[1] // tile
 
-    jc_flat = Jc.reshape(Jc.shape[0], od * cd)
-    starts = cam_idx[:: tile].astype(jnp.int32)  # [n_tiles]
+    starts = cam_idx[::tile].astype(jnp.int32)  # [n_tiles]
     feat = cd * cd + cd
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((tile, 1), lambda i, s: (i, 0)),
-            pl.BlockSpec((tile, od * cd), lambda i, s: (i, 0)),
-            pl.BlockSpec((tile, od), lambda i, s: (i, 0)),
+            pl.BlockSpec((1, tile), lambda i, s: (0, i)),
+            pl.BlockSpec((ocd, tile), lambda i, s: (0, i)),
+            pl.BlockSpec((od, tile), lambda i, s: (0, i)),
         ],
         out_specs=pl.BlockSpec((1, window, feat), lambda i, s: (i, 0, 0)),
     )
@@ -173,15 +179,14 @@ def camera_hessian_gradient(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n_tiles, window, feat), dtype),
         interpret=interpret,
-    )(starts, cam_idx[:, None].astype(jnp.int32), jc_flat, r)
+    )(starts, cam_idx[None, :].astype(jnp.int32), Jc, r)
 
     # Combine: scatter-add each tile's window into the (padded) camera
     # axis.  The [n_tiles, window, feat] partials are tiny next to the
-    # per-edge outer products the XLA path would materialise.
-    cam_targets = starts[:, None] + jnp.arange(window)[None, :]  # [n_tiles, window]
-    out = jnp.zeros((num_cameras + window, feat), dtype)
-    out = out.at[cam_targets.reshape(-1)].add(partials.reshape(-1, feat))
-    out = out[:num_cameras]
-    Hpp = out[:, : cd * cd].reshape(num_cameras, cd, cd)
-    g = out[:, cd * cd :]
-    return Hpp, g
+    # per-edge rows the kernel consumed.
+    cam_targets = (starts[:, None] + jnp.arange(window)[None, :]).reshape(-1)
+    out = jnp.zeros((feat, num_cameras + window), dtype)
+    out = out.at[:, cam_targets].add(
+        jnp.swapaxes(partials.reshape(-1, feat), 0, 1))
+    out = out[:, :num_cameras]
+    return out[: cd * cd], out[cd * cd :]

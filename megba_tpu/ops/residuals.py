@@ -1,19 +1,26 @@
-"""Residual + Jacobian engine.
+"""Residual + Jacobian engine (feature-major).
 
 The TPU-native replacement for the reference's entire operator layer: the
 JetVector forward-mode dual numbers (reference include/operator/jet_vector.h,
 src/operator/jet_vector_math_impl.cu — ~40 CUDA kernels), the Eigen
 injector (include/operator/eigen_injector.h) and the hand-fused geo kernels
-all collapse into ONE jitted function: a per-edge residual written in plain
-JAX numpy, vmapped over the edge axis, with Jacobians from reverse-mode
-`jax.vjp` (AUTODIFF — od pullbacks, the cheap direction for short
-residuals), forward-mode `jax.jacfwd` (AUTODIFF_FORWARD — the
-reference-faithful direction), or a hand-derived closed form (ANALYTICAL,
-the equivalent of reference src/geo/analytical_derivatives.cu:162-322).
+all collapse into ONE jitted function over feature-major rows (see
+core/fm.py for the layout rationale): a per-edge residual written in plain
+JAX numpy, vmapped over the minor edge axis, with Jacobians from
+reverse-mode `jax.vjp` (AUTODIFF — od pullbacks, the cheap direction for
+short residuals), forward-mode (AUTODIFF_FORWARD — the reference-faithful
+direction), or a hand-derived closed form (ANALYTICAL, the equivalent of
+reference src/geo/analytical_derivatives.cu:162-322).
+
+Engine contract: fn(cam [cd, nE], pt [pd, nE], obs [od, nE]) ->
+  (r [od, nE], Jc [od*cd, nE], Jp [od*pd, nE]) with row o*d+a = dr_o/dx_a.
 
 In the reference every JetVector op is its own kernel launch
 (jet_vector.cpp:207-224); here XLA fuses the whole forward pass into a
-single TPU program.
+single TPU program of row-wise VPU ops — the feature-major twin of how
+the reference's analytical kernel unrolls per-thread scalar math
+(analytical_derivatives.cu:162-285), but vectorised across 128-edge lanes
+instead of CUDA threads.
 """
 
 from __future__ import annotations
@@ -25,9 +32,11 @@ import jax
 import jax.numpy as jnp
 
 from megba_tpu.common import JacobianMode
-from megba_tpu.ops import geo
 
-# A residual function maps (camera[cd], point[pd], obs[od]) -> r[od].
+_SMALL_ANGLE = 1e-12
+
+# A residual function maps (camera[cd], point[pd], obs[od]) -> r[od]
+# for ONE edge; engines vectorise it over the minor edge axis.
 ResidualFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
@@ -39,6 +48,8 @@ def bal_residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> j
     examples/BAL_Double.cpp:18-33: rotate, translate, perspective divide
     with the BAL minus convention, radial distortion, subtract observation.
     """
+    from megba_tpu.ops import geo
+
     w = camera[0:3]
     t = camera[3:6]
     f, k1, k2 = camera[6], camera[7], camera[8]
@@ -49,65 +60,177 @@ def bal_residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> j
     return proj - obs
 
 
+def bal_residual_jacobian_analytical_fm(
+    cam: jnp.ndarray, pt: jnp.ndarray, obs: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Hand-derived residual + full Jacobian for the BAL model, row form.
+
+    cam [9, nE], pt [3, nE], obs [2, nE] ->
+      (r [2, nE], Jc [18, nE], Jp [6, nE]).
+
+    The closed-form equivalent of the fused kernel in reference
+    src/geo/analytical_derivatives.cu:162-285 (which hand-propagates
+    partials through rotate/translate/divide/distort; README.md:16 credits
+    that path with -30% time / -40% memory vs the reference's autodiff).
+    Here each scalar of the derivation is one [nE] row — the whole
+    function is a straight line of VPU ops over 128-edge lanes.
+    Rotation derivative d(R(w)x)/dw is the Gallego & Yezzi (2015) closed
+    form with the small-angle limit -[x]_x.
+    """
+    w0, w1, w2 = cam[0], cam[1], cam[2]
+    t0, t1, t2 = cam[3], cam[4], cam[5]
+    f, k1, k2 = cam[6], cam[7], cam[8]
+    x0, x1, x2 = pt[0], pt[1], pt[2]
+    one = jnp.ones_like(w0)
+
+    # --- Rodrigues rotation, small-angle guarded (both branches always
+    # evaluated under jit; the untaken one must stay finite).
+    theta2 = w0 * w0 + w1 * w1 + w2 * w2
+    safe = theta2 > _SMALL_ANGLE
+    th2s = jnp.where(safe, theta2, 1.0)
+    th = jnp.sqrt(th2s)
+    ct, st = jnp.cos(th), jnp.sin(th)
+    inv_th = 1.0 / th
+    e0, e1, e2 = w0 * inv_th, w1 * inv_th, w2 * inv_th
+    one_ct = 1.0 - ct
+
+    def W(val_full, val_small):
+        return jnp.where(safe, val_full, val_small)
+
+    # Rotation matrix rows R_ij (full: ct I + one_ct e e^T + st [e]_x;
+    # small: I + [w]_x).
+    R00 = W(ct + one_ct * e0 * e0, one)
+    R01 = W(one_ct * e0 * e1 - st * e2, -w2)
+    R02 = W(one_ct * e0 * e2 + st * e1, w1)
+    R10 = W(one_ct * e1 * e0 + st * e2, w2)
+    R11 = W(ct + one_ct * e1 * e1, one)
+    R12 = W(one_ct * e1 * e2 - st * e0, -w0)
+    R20 = W(one_ct * e2 * e0 - st * e1, -w1)
+    R21 = W(one_ct * e2 * e1 + st * e0, w0)
+    R22 = W(ct + one_ct * e2 * e2, one)
+
+    RX0 = R00 * x0 + R01 * x1 + R02 * x2
+    RX1 = R10 * x0 + R11 * x1 + R12 * x2
+    RX2 = R20 * x0 + R21 * x1 + R22 * x2
+
+    # --- project + distort
+    P0, P1, P2 = RX0 + t0, RX1 + t1, RX2 + t2
+    iz = 1.0 / P2
+    px = -P0 * iz
+    py = -P1 * iz
+    n = px * px + py * py
+    rd = 1.0 + k1 * n + k2 * n * n
+    r0 = f * rd * px - obs[0]
+    r1 = f * rd * py - obs[1]
+
+    # d proj / d p = f (rd I + 2 (k1 + 2 k2 n) p p^T)
+    c2 = 2.0 * (k1 + 2.0 * k2 * n)
+    D00 = f * (rd + c2 * px * px)
+    D01 = f * (c2 * px * py)
+    D11 = f * (rd + c2 * py * py)
+
+    # d r / d P = D @ [[-iz, 0, P0 iz^2], [0, -iz, P1 iz^2]]
+    iz2 = iz * iz
+    G00 = -D00 * iz
+    G01 = -D01 * iz
+    G02 = (D00 * P0 + D01 * P1) * iz2
+    G10 = -D01 * iz
+    G11 = -D11 * iz
+    G12 = (D01 * P0 + D11 * P1) * iz2
+
+    # --- Jp = G @ R  (dP/dX = R)
+    Jp00 = G00 * R00 + G01 * R10 + G02 * R20
+    Jp01 = G00 * R01 + G01 * R11 + G02 * R21
+    Jp02 = G00 * R02 + G01 * R12 + G02 * R22
+    Jp10 = G10 * R00 + G11 * R10 + G12 * R20
+    Jp11 = G10 * R01 + G11 * R11 + G12 * R21
+    Jp12 = G10 * R02 + G11 * R12 + G12 * R22
+
+    # --- d(Rx)/dw: M = -(R [x]_x)(w w^T + (R^T - I)[w]_x)/theta^2,
+    # small-angle limit -[x]_x.
+    # B = R @ skew(x)
+    B00 = R01 * x2 - R02 * x1
+    B01 = -R00 * x2 + R02 * x0
+    B02 = R00 * x1 - R01 * x0
+    B10 = R11 * x2 - R12 * x1
+    B11 = -R10 * x2 + R12 * x0
+    B12 = R10 * x1 - R11 * x0
+    B20 = R21 * x2 - R22 * x1
+    B21 = -R20 * x2 + R22 * x0
+    B22 = R20 * x1 - R21 * x0
+    # C = R^T - I; A = w w^T + C @ skew(w)
+    C00, C01, C02 = R00 - 1.0, R10, R20
+    C10, C11, C12 = R01, R11 - 1.0, R21
+    C20, C21, C22 = R02, R12, R22 - 1.0
+    A00 = w0 * w0 + (C01 * w2 - C02 * w1)
+    A01 = w0 * w1 + (-C00 * w2 + C02 * w0)
+    A02 = w0 * w2 + (C00 * w1 - C01 * w0)
+    A10 = w1 * w0 + (C11 * w2 - C12 * w1)
+    A11 = w1 * w1 + (-C10 * w2 + C12 * w0)
+    A12 = w1 * w2 + (C10 * w1 - C11 * w0)
+    A20 = w2 * w0 + (C21 * w2 - C22 * w1)
+    A21 = w2 * w1 + (-C20 * w2 + C22 * w0)
+    A22 = w2 * w2 + (C20 * w1 - C21 * w0)
+    inv_t2 = 1.0 / th2s
+    zero = jnp.zeros_like(x0)
+    M00 = W(-(B00 * A00 + B01 * A10 + B02 * A20) * inv_t2, zero)
+    M01 = W(-(B00 * A01 + B01 * A11 + B02 * A21) * inv_t2, x2)
+    M02 = W(-(B00 * A02 + B01 * A12 + B02 * A22) * inv_t2, -x1)
+    M10 = W(-(B10 * A00 + B11 * A10 + B12 * A20) * inv_t2, -x2)
+    M11 = W(-(B10 * A01 + B11 * A11 + B12 * A21) * inv_t2, zero)
+    M12 = W(-(B10 * A02 + B11 * A12 + B12 * A22) * inv_t2, x0)
+    M20 = W(-(B20 * A00 + B21 * A10 + B22 * A20) * inv_t2, x1)
+    M21 = W(-(B20 * A01 + B21 * A11 + B22 * A21) * inv_t2, -x0)
+    M22 = W(-(B20 * A02 + B21 * A12 + B22 * A22) * inv_t2, zero)
+
+    # J_w = G @ M
+    Jw00 = G00 * M00 + G01 * M10 + G02 * M20
+    Jw01 = G00 * M01 + G01 * M11 + G02 * M21
+    Jw02 = G00 * M02 + G01 * M12 + G02 * M22
+    Jw10 = G10 * M00 + G11 * M10 + G12 * M20
+    Jw11 = G10 * M01 + G11 * M11 + G12 * M21
+    Jw12 = G10 * M02 + G11 * M12 + G12 * M22
+
+    # Intrinsics columns.
+    Jf0, Jf1 = rd * px, rd * py
+    Jk10, Jk11 = f * n * px, f * n * py
+    Jk20, Jk21 = f * n * n * px, f * n * n * py
+
+    r = jnp.stack([r0, r1])
+    Jc = jnp.stack([
+        Jw00, Jw01, Jw02, G00, G01, G02, Jf0, Jk10, Jk20,
+        Jw10, Jw11, Jw12, G10, G11, G12, Jf1, Jk11, Jk21,
+    ])
+    Jp = jnp.stack([Jp00, Jp01, Jp02, Jp10, Jp11, Jp12])
+    return r, Jc, Jp
+
+
 def bal_residual_jacobian_analytical(
     camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Hand-derived residual + full Jacobian for the BAL model, one edge.
+    """Single-edge view of the analytical BAL Jacobian.
 
-    Returns (r[2], Jc[2,9], Jp[2,3]).  The closed-form equivalent of the
-    fused kernel in reference src/geo/analytical_derivatives.cu:162-285
-    (which hand-propagates partials through rotate/translate/divide/distort)
-    — README.md:16 credits this path with -30% time / -40% memory vs the
-    autodiff module.
+    (camera[9], point[3], obs[2]) -> (r[2], Jc[2,9], Jp[2,3]).  A thin
+    per-edge lens over the row-form engine for callers (tests, custom
+    models) that think in one-edge terms; the solver pipeline uses the
+    feature-major form directly.
     """
-    w = camera[0:3]
-    t = camera[3:6]
-    f, k1, k2 = camera[6], camera[7], camera[8]
-
-    RX = geo.angle_axis_rotate_point(w, point)
-    P = RX + t
-    inv_z = 1.0 / P[2]
-    p = -P[0:2] * inv_z
-
-    n = jnp.dot(p, p)
-    rd = 1.0 + k1 * n + k2 * n * n
-    proj = f * rd * p
-    r = proj - obs
-
-    # d proj / d p = f * (rd I + 2 (k1 + 2 k2 n) p p^T)
-    dproj_dp = f * (rd * jnp.eye(2, dtype=camera.dtype) + 2.0 * (k1 + 2.0 * k2 * n) * jnp.outer(p, p))
-    # d p / d P = [[-1/z, 0, x/z^2], [0, -1/z, y/z^2]]
-    zero = jnp.zeros((), dtype=camera.dtype)
-    dp_dP = jnp.array(
-        [
-            [-inv_z, zero, P[0] * inv_z * inv_z],
-            [zero, -inv_z, P[1] * inv_z * inv_z],
-        ]
-    )
-    dr_dP = geo.mm(dproj_dp, dp_dP)  # (2,3)
-
-    J_t = dr_dP
-    J_w = geo.mm(dr_dP, geo.drotated_dangle_axis(w, point))  # (2,3)
-    J_X = geo.mm(dr_dP, geo.angle_axis_to_rotation_matrix(w))  # (2,3)
-    J_f = (rd * p)[:, None]  # (2,1)
-    J_k1 = (f * n * p)[:, None]
-    J_k2 = (f * n * n * p)[:, None]
-
-    Jc = jnp.concatenate([J_w, J_t, J_f, J_k1, J_k2], axis=1)  # (2,9)
-    return r, Jc, J_X
+    r, Jc, Jp = bal_residual_jacobian_analytical_fm(
+        camera[:, None], point[:, None], obs[:, None])
+    return r[:, 0], Jc[:, 0].reshape(2, 9), Jp[:, 0].reshape(2, 3)
 
 
 @functools.lru_cache(maxsize=64)
 def make_residual_fn(
     residual_fn: ResidualFn = bal_residual,
 ) -> Callable[..., jnp.ndarray]:
-    """Vectorised residual evaluation over gathered per-edge params.
+    """Vectorised residual evaluation over feature-major per-edge params.
 
-    Returns fn(cam_params[nE,cd], pt_params[nE,pd], obs[nE,od]) -> r[nE,od].
+    Returns fn(cam [cd, nE], pt [pd, nE], obs [od, nE]) -> r [od, nE].
     The equivalent of reference EdgeVector::forward (base_edge.cpp:160-163)
     value plane only.
     """
-    return jax.vmap(residual_fn, in_axes=(0, 0, 0))
+    return jax.vmap(residual_fn, in_axes=(-1, -1, -1), out_axes=-1)
 
 
 def build_residual_jacobian_fn(
@@ -124,14 +247,14 @@ def build_residual_jacobian_fn(
     below is the memoised front for hashable, long-lived configs
     (built-in engines, module-level residual functions).
 
-    Returns fn(cam_params[nE,cd], pt_params[nE,pd], obs[nE,od])
-      -> (r[nE,od], Jc[nE,od,cd], Jp[nE,od,pd]).
+    Returns fn(cam [cd, nE], pt [pd, nE], obs [od, nE])
+      -> (r [od, nE], Jc [od*cd, nE], Jp [od*pd, nE]).
 
-    AUTODIFF (reverse-mode vjp) and AUTODIFF_FORWARD (jacfwd — the
+    AUTODIFF (reverse-mode vjp) and AUTODIFF_FORWARD (jax.linearize — the
     direction the reference's JetVector pass uses, SURVEY.md §3.4)
-    compute the same Jacobian; ANALYTICAL uses a closed-form function
-    (default: the BAL one above).  See common.JacobianMode for when each
-    direction wins.
+    compute the same Jacobian; ANALYTICAL uses a closed-form row-form
+    function (default: the BAL one above).  See common.JacobianMode for
+    when each direction wins.
     """
     if mode == JacobianMode.ANALYTICAL:
         fn = analytical_fn
@@ -140,8 +263,8 @@ def build_residual_jacobian_fn(
                 raise ValueError(
                     "ANALYTICAL mode needs analytical_fn for custom residuals"
                 )
-            fn = bal_residual_jacobian_analytical
-        return jax.vmap(fn, in_axes=(0, 0, 0))
+            fn = bal_residual_jacobian_analytical_fm
+        return fn
 
     if mode == JacobianMode.AUTODIFF_FORWARD:
 
@@ -156,24 +279,35 @@ def build_residual_jacobian_fn(
             eye_p = jnp.eye(pd, dtype=point.dtype)
             Jc = jax.vmap(lambda t: jvp(t, jnp.zeros_like(point)))(eye_c)
             Jp = jax.vmap(lambda t: jvp(jnp.zeros_like(camera), t))(eye_p)
-            return r, Jc.T, Jp.T
+            return r, Jc.T, Jp.T  # -> [od, cd], [od, pd]
 
-        return jax.vmap(value_and_jac_fwd, in_axes=(0, 0, 0))
+        per_edge = value_and_jac_fwd
+    else:
 
-    def value_and_jac(camera, point, obs):
-        # Reverse mode: od pullbacks instead of (cd+pd) pushforwards —
-        # the cheap direction for short residuals (see JacobianMode).
-        r, pull = jax.vjp(lambda c, p: residual_fn(c, p, obs), camera, point)
-        # Stamp the primal's varying-axes type onto the cotangent basis so
-        # the pullback is well-typed inside shard_map.  Routing through
-        # isfinite keeps the stamp exactly zero even when a residual
-        # component is inf/NaN (0*inf would poison the whole basis).
-        stamp = (jnp.isfinite(r).astype(r.dtype) * 0.0)[None, :]
-        eye = jnp.eye(r.shape[0], dtype=r.dtype) + stamp
-        Jc, Jp = jax.vmap(pull)(eye)
-        return r, Jc, Jp
+        def value_and_jac(camera, point, obs):
+            # Reverse mode: od pullbacks instead of (cd+pd) pushforwards —
+            # the cheap direction for short residuals (see JacobianMode).
+            r, pull = jax.vjp(lambda c, p: residual_fn(c, p, obs), camera, point)
+            # Stamp the primal's varying-axes type onto the cotangent basis
+            # so the pullback is well-typed inside shard_map.  Routing
+            # through isfinite keeps the stamp exactly zero even when a
+            # residual component is inf/NaN (0*inf would poison the basis).
+            stamp = (jnp.isfinite(r).astype(r.dtype) * 0.0)[None, :]
+            eye = jnp.eye(r.shape[0], dtype=r.dtype) + stamp
+            Jc, Jp = jax.vmap(pull)(eye)
+            return r, Jc, Jp  # [od], [od, cd], [od, pd]
 
-    return jax.vmap(value_and_jac, in_axes=(0, 0, 0))
+        per_edge = value_and_jac
+
+    mapped = jax.vmap(per_edge, in_axes=(-1, -1, -1), out_axes=(-1, -1, -1))
+
+    def fm_fn(cam, pt, obs):
+        r, Jc, Jp = mapped(cam, pt, obs)
+        od, cd, nE = Jc.shape
+        pd = Jp.shape[1]
+        return r, Jc.reshape(od * cd, nE), Jp.reshape(od * pd, nE)
+
+    return fm_fn
 
 
 @functools.lru_cache(maxsize=64)
@@ -198,6 +332,7 @@ def apply_sqrt_info(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Pre-whiten residuals and Jacobians by the sqrt information matrix.
 
+    Row form: sqrt_info is [od*od, nE] (row o*od+j = L_oj per edge).
     Weighted least squares: with information Sigma^-1 = L^T L this scales
     r~ = L r, J~ = L J so that H = J~^T J~ and g = -J~^T r~.  Covers the
     reference's information-matrix path (BaseEdge information,
@@ -205,8 +340,18 @@ def apply_sqrt_info(
     """
     if sqrt_info is None:
         return r, Jc, Jp
-    hi = jax.lax.Precision.HIGHEST
-    r = jnp.einsum("eij,ej->ei", sqrt_info, r, precision=hi)
-    Jc = jnp.einsum("eij,ejk->eik", sqrt_info, Jc, precision=hi)
-    Jp = jnp.einsum("eij,ejk->eik", sqrt_info, Jp, precision=hi)
-    return r, Jc, Jp
+    od = r.shape[0]
+    cd = Jc.shape[0] // od
+    pd = Jp.shape[0] // od
+
+    def rows(J, d):
+        return jnp.stack([
+            sum(sqrt_info[o * od + j] * J[j * d + a] for j in range(od))
+            for o in range(od) for a in range(d)
+        ])
+
+    r_w = jnp.stack([
+        sum(sqrt_info[o * od + j] * r[j] for j in range(od))
+        for o in range(od)
+    ])
+    return r_w, rows(Jc, cd), rows(Jp, pd)

@@ -63,11 +63,13 @@ def robustify(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Reweight (r, Jc, Jp) per edge; also return per-edge rho(s).
 
-    Inputs are the already info/mask-weighted residual [nE, od] and
-    Jacobians; the returned rho [nE] sums to the robustified cost.
-    The weighted quantities satisfy Sum ||w r||^2 ~ first-order model of
-    Sum rho, which is what the Gauss-Newton/LM step needs.
+    Feature-major: the already info/mask-weighted residual r [od, nE] and
+    Jacobian rows Jc [od*cd, nE] / Jp [od*pd, nE]; the returned rho [nE]
+    sums to the robustified cost.  The weighted quantities satisfy
+    Sum ||w r||^2 ~ first-order model of Sum rho, which is what the
+    Gauss-Newton/LM step needs.
     """
-    s = jnp.sum(r * r, axis=1)
+    s = jnp.sum(r * r, axis=0)
     rho, w = rho_and_weight(s, kind, delta)
-    return r * w[:, None], Jc * w[:, None, None], Jp * w[:, None, None], rho
+    wm = w[None, :]
+    return r * wm, Jc * wm, Jp * wm, rho

@@ -116,14 +116,17 @@ def distributed_lm_solve(
     program; per-iteration synchronisation is the psum set documented in
     builder.py/pcg.py.
     """
-    n_edge = obs.shape[0]
+    n_edge = obs.shape[-1]
     if n_edge % mesh.devices.size != 0:
         raise ValueError(
             f"edge count {n_edge} not divisible by mesh size "
             f"{mesh.devices.size}; pad with shard_edge_arrays first"
         )
 
-    edge = P(EDGE_AXIS)
+    # Feature-major edge arrays [F, nE] split on the MINOR axis; 1-D
+    # index/mask arrays on their only axis; parameters replicated.
+    edge = P(None, EDGE_AXIS)
+    edge1d = P(EDGE_AXIS)
     rep = P()
 
     # Optional operands can't be None inside shard_map specs; pass the
@@ -136,7 +139,7 @@ def distributed_lm_solve(
     args = [cameras, points, obs, cam_idx, pt_idx, mask,
             jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
             jnp.asarray(_next_verbose_token(), jnp.int32)]
-    in_specs = [rep, rep, edge, edge, edge, edge, rep, rep, rep]
+    in_specs = [rep, rep, edge, edge1d, edge1d, edge1d, rep, rep, rep]
     optional = [
         ("sqrt_info", sqrt_info, edge),
         ("cam_fixed", cam_fixed, rep),

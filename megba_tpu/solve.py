@@ -21,14 +21,14 @@ import numpy as np
 
 from megba_tpu.algo.lm import LMResult, lm_solve
 from megba_tpu.common import ProblemOption, validate_options
-from megba_tpu.core.types import is_cam_sorted
+from megba_tpu.core.fm import EDGE_QUANTUM
+from megba_tpu.core.types import is_cam_sorted, pad_edges
 from megba_tpu.io.bal import BALFile, load_bal
 from megba_tpu.ops.residuals import make_residual_jacobian_fn
 from megba_tpu.parallel.mesh import (
     distributed_lm_solve,
     get_or_build_program,
     make_mesh,
-    shard_edge_arrays,
 )
 
 
@@ -77,12 +77,21 @@ def flat_solve(
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
 
+    PUBLIC BOUNDARY: accepts the conventional edge-major numpy layout
+    (cameras [Nc, cd], obs [nE, od], sqrt_info [nE, od, od]) and returns
+    an LMResult with edge-major cameras/points.  Internally everything is
+    feature-major (core/fm.py) — the transposes happen exactly once,
+    here, on host numpy.
+
     Edges are camera-sorted here (native counting sort) if they are not
-    already; `sqrt_info` rides the same permutation.  `option.world_size`
-    selects the mesh; jitted programs are cached per configuration —
-    globally for long-lived engines, or in the caller-owned `jit_cache`
-    dict when the engine is a per-problem closure whose lifetime must not
-    exceed its problem's (BaseProblem passes its own dict).
+    already; `sqrt_info` rides the same permutation.  The edge axis is
+    padded to a multiple of world_size * EDGE_QUANTUM (masked-out edges)
+    so chunked builds, the Pallas assembly tiles and equal shards all get
+    static shapes.  `option.world_size` selects the mesh; jitted programs
+    are cached per configuration — globally for long-lived engines, or in
+    the caller-owned `jit_cache` dict when the engine is a per-problem
+    closure whose lifetime must not exceed its problem's (BaseProblem
+    passes its own dict).
     """
     dtype = np.dtype(option.dtype)
     if dtype == np.float64 and not jax.config.jax_enable_x64:
@@ -111,29 +120,43 @@ def flat_solve(
         if sqrt_info is not None:
             sqrt_info = np.asarray(sqrt_info)[perm]
 
-    sqrt_info_j = None if sqrt_info is None else jnp.asarray(
-        np.asarray(sqrt_info).astype(dtype, copy=False))
+    # Pad the edge axis: every shard must be a multiple of EDGE_QUANTUM
+    # so chunk slices and Pallas tiles are static-shape and copy-free.
+    ws = option.world_size
+    obs, cam_idx, pt_idx, mask = pad_edges(
+        obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
+    n_padded = obs.shape[0]
+    if sqrt_info is not None:
+        si = np.asarray(sqrt_info).astype(dtype, copy=False)
+        if si.shape[0] != n_padded:
+            pad = n_padded - si.shape[0]
+            eye = np.broadcast_to(
+                np.eye(si.shape[1], dtype=dtype), (pad,) + si.shape[1:])
+            si = np.concatenate([si, eye])
+        # [nE, od, od] -> feature-major rows [od*od, nE]
+        sqrt_info_j = jnp.asarray(
+            np.ascontiguousarray(si.reshape(n_padded, -1).T))
+    else:
+        sqrt_info_j = None
     cam_fixed_j = None if cam_fixed is None else jnp.asarray(cam_fixed)
     pt_fixed_j = None if pt_fixed is None else jnp.asarray(pt_fixed)
 
-    if option.world_size > 1:
-        obs_p, cam_idx_p, pt_idx_p, mask = shard_edge_arrays(
-            obs, cam_idx, pt_idx, option.world_size, dtype=dtype)
-        if sqrt_info_j is not None and mask.shape[0] != obs.shape[0]:
-            pad = mask.shape[0] - obs.shape[0]
-            eye = np.broadcast_to(
-                np.eye(obs.shape[1], dtype=dtype),
-                (pad,) + sqrt_info_j.shape[1:])
-            sqrt_info_j = jnp.concatenate([sqrt_info_j, jnp.asarray(eye)])
-        mesh = make_mesh(option.world_size)
-        return distributed_lm_solve(
-            residual_jac_fn, jnp.asarray(cameras), jnp.asarray(points),
-            jnp.asarray(obs_p), jnp.asarray(cam_idx_p), jnp.asarray(pt_idx_p),
+    # Feature-major boundary transposes (host numpy, once per solve).
+    cameras_fm = jnp.asarray(np.ascontiguousarray(cameras.T))
+    points_fm = jnp.asarray(np.ascontiguousarray(points.T))
+    obs_fm = jnp.asarray(np.ascontiguousarray(obs.T))
+
+    if ws > 1:
+        mesh = make_mesh(ws)
+        result = distributed_lm_solve(
+            residual_jac_fn, cameras_fm, points_fm,
+            obs_fm, jnp.asarray(cam_idx), jnp.asarray(pt_idx),
             jnp.asarray(mask), option, mesh,
             sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
             verbose=verbose, cam_sorted=True, pallas_plan=pallas_plan,
             initial_region=initial_region, initial_v=initial_v,
             jit_cache=jit_cache)
+        return _result_to_edge_major(result)
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
                 ("pt_fixed", pt_fixed_j)]
@@ -146,12 +169,22 @@ def flat_solve(
     iv = 2.0 if initial_v is None else initial_v
     from megba_tpu.algo.lm import _next_verbose_token
 
-    return jitted(
-        jnp.asarray(cameras), jnp.asarray(points), jnp.asarray(obs),
-        jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-        jnp.ones(obs.shape[0], dtype=dtype),
+    result = jitted(
+        cameras_fm, points_fm, obs_fm,
+        jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
         jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
         jnp.asarray(_next_verbose_token(), jnp.int32), *extras)
+    return _result_to_edge_major(result)
+
+
+def _result_to_edge_major(result: LMResult) -> LMResult:
+    """Transpose the solved parameters back to the public [N, d] layout."""
+    import dataclasses
+
+    return dataclasses.replace(
+        result,
+        cameras=jnp.swapaxes(result.cameras, 0, 1),
+        points=jnp.swapaxes(result.points, 0, 1))
 
 
 def solve_bal(

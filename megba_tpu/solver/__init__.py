@@ -1,11 +1,17 @@
-from megba_tpu.solver.pcg import PCGResult, block_inv, block_matvec, plain_pcg_solve, schur_pcg_solve
+from megba_tpu.solver.pcg import (
+    PCGResult,
+    block_inv,
+    cam_block_matvec,
+    plain_pcg_solve,
+    schur_pcg_solve,
+)
 from megba_tpu.solver.dense import dense_reference_solve
 
 __all__ = [
     "PCGResult",
     "block_inv",
-    "block_matvec",
-    "dense_reference_solve",
+    "cam_block_matvec",
     "plain_pcg_solve",
     "schur_pcg_solve",
+    "dense_reference_solve",
 ]

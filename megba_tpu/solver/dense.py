@@ -4,7 +4,8 @@ Builds the full damped normal-equations matrix from the Schur blocks and
 solves it directly — the ground truth the PCG solver is unit-tested
 against (SURVEY.md §4c: "Schur/PCG unit tests vs dense np.linalg.solve on
 tiny synthetic BA problems").  Test-scale only: O((Nc*cd + Np*pd)^2)
-memory.
+memory.  Consumes the feature-major containers (core/fm.py) and returns
+feature-major updates, matching the PCG solvers.
 """
 
 from __future__ import annotations
@@ -14,6 +15,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from megba_tpu.core.fm import coupling_rows, damp_rows_fm
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 
 
@@ -25,13 +27,18 @@ def dense_reference_solve(
     pt_idx: jax.Array,
     region: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Direct solve of the damped system H dx = g; returns (dx_cam, dx_pt)."""
+    """Direct solve of the damped system H dx = g.
+
+    Returns (dx_cam [cd, Nc], dx_pt [pd, Np]).
+    """
     Nc, cd, _ = system.Hpp.shape
-    Np, pd, _ = system.Hll.shape
+    pdpd, Np = system.Hll.shape
+    pd = int(round(pdpd ** 0.5))
+    od = Jc.shape[0] // cd
     n = Nc * cd + Np * pd
 
     Hpp_d = damp_blocks(system.Hpp, region)
-    Hll_d = damp_blocks(system.Hll, region)
+    Hll_d = damp_rows_fm(system.Hll, region)
 
     H = jnp.zeros((n, n), dtype=system.Hpp.dtype)
     # Diagonal blocks.
@@ -39,17 +46,27 @@ def dense_reference_solve(
         H = H.at[i * cd : (i + 1) * cd, i * cd : (i + 1) * cd].set(Hpp_d[i])
     off = Nc * cd
     for j in range(Np):
-        H = H.at[off + j * pd : off + (j + 1) * pd, off + j * pd : off + (j + 1) * pd].set(Hll_d[j])
+        blk = Hll_d[:, j].reshape(pd, pd)
+        H = H.at[off + j * pd : off + (j + 1) * pd,
+                 off + j * pd : off + (j + 1) * pd].set(blk)
     # Coupling: W_e = Jc_e^T Jp_e accumulated at (camera row, point col).
-    W = jnp.einsum("eoc,eop->ecp", Jc, Jp, precision=jax.lax.Precision.HIGHEST)
-    for e in range(Jc.shape[0]):
+    W = coupling_rows(Jc, Jp, od)  # [cd*pd, nE]
+    for e in range(Jc.shape[1]):
         ci = int(cam_idx[e])
         pi = int(pt_idx[e])
+        blk = W[:, e].reshape(cd, pd)
         rows = slice(ci * cd, (ci + 1) * cd)
         cols = slice(off + pi * pd, off + (pi + 1) * pd)
-        H = H.at[rows, cols].add(W[e])
-        H = H.at[cols, rows].add(W[e].T)
+        H = H.at[rows, cols].add(blk)
+        H = H.at[cols, rows].add(blk.T)
 
-    g = jnp.concatenate([system.g_cam.reshape(-1), system.g_pt.reshape(-1)])
+    # Feature-major [d, N] rows flatten to the block order (vertex-major)
+    # via the transpose.
+    g = jnp.concatenate([
+        jnp.swapaxes(system.g_cam, 0, 1).reshape(-1),
+        jnp.swapaxes(system.g_pt, 0, 1).reshape(-1),
+    ])
     dx = jnp.linalg.solve(H, g)
-    return dx[: Nc * cd].reshape(Nc, cd), dx[Nc * cd :].reshape(Np, pd)
+    dx_cam = jnp.swapaxes(dx[: Nc * cd].reshape(Nc, cd), 0, 1)
+    dx_pt = jnp.swapaxes(dx[Nc * cd :].reshape(Np, pd), 0, 1)
+    return dx_cam, dx_pt

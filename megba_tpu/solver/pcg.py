@@ -1,11 +1,12 @@
-"""Distributed Schur-complement preconditioned conjugate gradients.
+"""Distributed Schur-complement preconditioned conjugate gradients
+(feature-major).
 
 TPU-native replacement for the reference's SchurPCGSolver /
 ImplicitSchurPCGSolver (src/solver/schur_pcg_solver.cu:598-639,
 src/solver/implicit_schur_pcg_solver.cu): the same pipeline —
 
-  1. invert the damped Hll blocks (cublasGmatinvBatched there, a vmapped
-     batched inverse here);
+  1. invert the damped Hll blocks (cublasGmatinvBatched there, row-form
+     closed-form adjugates here — core/fm.py);
   2. reduced RHS v = g_cam - Hpl Hll^-1 g_pt     [1 psum]
   3. PCG on S x = v with S = Hpp - Hpl Hll^-1 Hlp, block-Jacobi
      preconditioner M^-1 = Hpp^-1                 [2 psums / iteration]
@@ -16,14 +17,15 @@ reference's per-iteration host-blocking dot products
 (schur_pcg_solver.cu:277-287,368-384) become plain on-device reductions
 over replicated vectors, and its NCCL allreduces of the coupling products
 (schur_pcg_solver.cu:211-242,325-357,502-509,568-575) become
-`jax.lax.psum` of the segment_sum outputs.
+`jax.lax.psum` of the scatter-add outputs.
 
 The Hpl/Hlp products never materialise a sparse matrix: EXPLICIT mode
-uses the per-edge W_e = Jc^T Jp blocks (gather -> batched matmul ->
-segment_sum), IMPLICIT mode recomputes Jc^T (Jp x) from the stored
-Jacobians (matrix-free, the reference's implicitEMulx / implicitETMulx,
-implicit_schur_pcg_solver.cu:20-90).  Both are dense batched einsums —
-the natural MXU mapping; there is no cuSPARSE analog to port.
+uses the per-edge W rows (gather -> row products -> scatter-add),
+IMPLICIT mode recomputes Jc^T (Jp x) from the stored Jacobian rows
+(matrix-free, the reference's implicitEMulx / implicitETMulx,
+implicit_schur_pcg_solver.cu:20-90).  All per-edge work is row-wise VPU
+math over 128-edge lanes (see core/fm.py for the layout rationale);
+there is no cuSPARSE analog to port.
 """
 
 from __future__ import annotations
@@ -35,6 +37,16 @@ import jax
 import jax.numpy as jnp
 
 from megba_tpu.common import ComputeKind, PreconditionerKind
+from megba_tpu.core.fm import (
+    block_inv_fm,
+    block_matvec_fm,
+    chunked_edge_reduce,
+    coupling_rows,
+    damp_rows_fm,
+    gather_fm,
+    segsum_fm,
+    slice_fm,
+)
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 from megba_tpu.ops.accum import comp_dot
 
@@ -47,56 +59,27 @@ _TINY_RHO = 1e-30
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PCGResult:
-    """Solve output: the Schur update and diagnostics."""
+    """Solve output: the Schur update and diagnostics (feature-major)."""
 
-    dx_cam: jax.Array  # [Nc, cd]
-    dx_pt: jax.Array  # [Np, pd]
+    dx_cam: jax.Array  # [cd, Nc]
+    dx_pt: jax.Array  # [pd, Np]
     iterations: jax.Array  # scalar int32
     rho: jax.Array  # final residual-energy <r, M^-1 r>
 
 
-def block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
-    """[N,d,d] block-diagonal times [N,d] -> [N,d]."""
-    return jnp.einsum("nij,nj->ni", H, x, precision=HI)
+def cam_block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
+    """[Nc, d, d] camera blocks times [d, Nc] rows -> [d, Nc] rows."""
+    return jnp.einsum("nij,jn->in", H, x, precision=HI)
 
 
 def block_inv(H: jax.Array) -> jax.Array:
-    """Batched inverse of SPD blocks [N,d,d].
+    """Batched inverse of SPD camera blocks [N, d, d] via Cholesky.
 
     The analog of the reference's cublasGmatinvBatched calls
-    (schur_pcg_solver.cu:60-97).  Point blocks (d<=3) use the closed-form
-    adjugate — branch-free elementwise VPU math, no factorisation —
-    while larger (camera 9x9) blocks use Cholesky, which is stable on the
-    damped SPD blocks.
+    (schur_pcg_solver.cu:60-97); stable on the damped SPD blocks.
+    Point blocks use the row-form closed-form `core.fm.block_inv_fm`.
     """
     d = H.shape[-1]
-    if d == 1:
-        return 1.0 / H
-    if d == 2:
-        a, b = H[..., 0, 0], H[..., 0, 1]
-        c, e = H[..., 1, 0], H[..., 1, 1]
-        det = a * e - b * c
-        inv = jnp.stack([jnp.stack([e, -b], -1), jnp.stack([-c, a], -1)], -2)
-        return inv / det[..., None, None]
-    if d == 3:
-        a, b, c = H[..., 0, 0], H[..., 0, 1], H[..., 0, 2]
-        dd, e, f = H[..., 1, 0], H[..., 1, 1], H[..., 1, 2]
-        g, h, i = H[..., 2, 0], H[..., 2, 1], H[..., 2, 2]
-        A = e * i - f * h
-        B = c * h - b * i
-        C = b * f - c * e
-        D = f * g - dd * i
-        E = a * i - c * g
-        F = c * dd - a * f
-        G = dd * h - e * g
-        Hc = b * g - a * h
-        I = a * e - b * dd
-        det = a * A + b * D + c * G
-        adj = jnp.stack(
-            [jnp.stack([A, B, C], -1), jnp.stack([D, E, F], -1), jnp.stack([G, Hc, I], -1)],
-            -2,
-        )
-        return adj / det[..., None, None]
     chol = jnp.linalg.cholesky(H)
     eye = jnp.broadcast_to(jnp.eye(d, dtype=H.dtype), H.shape)
     inv_l = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
@@ -126,59 +109,78 @@ def make_coupling_matvecs(
     mixed_precision: bool = False,
     cam_sorted: bool = False,
 ) -> Tuple[Callable[[jax.Array], jax.Array], Callable[[jax.Array], jax.Array]]:
-    """Build hpl(q_pt)->[Nc,cd] and hlp(p_cam)->[Np,pd] matvec closures.
+    """Build hpl(q_pt [pd,Np])->[cd,Nc] and hlp(p_cam [cd,Nc])->[pd,Np].
 
-    EXPLICIT mode reads only `W` (per-edge coupling blocks); IMPLICIT mode
-    reads only `Jc`/`Jp`.  Edge arrays are shard-local; outputs are
-    psum-reduced to replicated.
+    EXPLICIT mode reads only `W` (per-edge coupling rows [cd*pd, nE]);
+    IMPLICIT mode reads only `Jc`/`Jp` rows.  Edge arrays are
+    shard-local; outputs are psum-reduced to replicated.
 
-    `mixed_precision` (BASELINE.md config 5) expects the used operands to
-    be pre-equilibrated and bf16-cast (see schur_pcg_solve) and
-    accumulates in float32 (`preferred_element_type`) — the coupling
-    products are the PCG's bandwidth-dominant work, so this halves HBM
-    traffic while the Krylov vectors, reductions and preconditioner stay
-    float32.
+    `mixed_precision` (BASELINE.md config 5) expects the edge operands to
+    be pre-equilibrated and bf16-cast (see schur_pcg_solve); products are
+    computed after upcast to float32, so only the stored rows — the PCG's
+    bandwidth-dominant traffic — are halved, while Krylov vectors,
+    reductions and the preconditioner stay float32.
     """
-    ed = jnp.bfloat16 if mixed_precision else None
-
-    def cast(x):
-        return x.astype(ed) if ed is not None else x
-
-    def ee(spec, a, b):
-        if mixed_precision:
-            return jnp.einsum(spec, a, b, preferred_element_type=jnp.float32)
-        return jnp.einsum(spec, a, b, precision=HI)
+    def up(x):
+        return x.astype(jnp.float32) if mixed_precision else x
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
 
     if compute_kind == ComputeKind.EXPLICIT:
+        cdpd = W.shape[0]
+        # cd/pd from the gathered vector shapes at call time.
 
         def hlp(p_cam: jax.Array) -> jax.Array:
-            pe = cast(jnp.take(p_cam, cam_idx, axis=0))  # [nE, cd]
-            te = ee("ecp,ec->ep", W, pe)
-            return psum(jax.ops.segment_sum(te, pt_idx, num_segments=num_points))
+            cd = p_cam.shape[0]
+            pd = cdpd // cd
+            pe = gather_fm(p_cam, cam_idx)  # [cd, nE]
+            te = jnp.stack([
+                sum(up(W[a * pd + b]) * pe[a] for a in range(cd))
+                for b in range(pd)
+            ])
+            return psum(segsum_fm(te, pt_idx, num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
-            qe = cast(jnp.take(q_pt, pt_idx, axis=0))  # [nE, pd]
-            te = ee("ecp,ep->ec", W, qe)
-            return psum(jax.ops.segment_sum(te, cam_idx, num_segments=num_cameras,
-                                            indices_are_sorted=cam_sorted))
+            pd = q_pt.shape[0]
+            cd = cdpd // pd
+            qe = gather_fm(q_pt, pt_idx)  # [pd, nE]
+            te = jnp.stack([
+                sum(up(W[a * pd + b]) * qe[b] for b in range(pd))
+                for a in range(cd)
+            ])
+            return psum(segsum_fm(te, cam_idx, num_cameras,
+                                  indices_are_sorted=cam_sorted))
 
     else:
+        ocd, opd = Jc.shape[0], Jp.shape[0]
 
         def hlp(p_cam: jax.Array) -> jax.Array:
-            pe = cast(jnp.take(p_cam, cam_idx, axis=0))
-            u = ee("eoc,ec->eo", Jc, pe)  # Jc p
-            te = ee("eop,eo->ep", Jp, cast(u))  # Jp^T (Jc p)
-            return psum(jax.ops.segment_sum(te, pt_idx, num_segments=num_points))
+            cd = p_cam.shape[0]
+            od = ocd // cd
+            pd = opd // od
+            pe = gather_fm(p_cam, cam_idx)
+            u = [sum(up(Jc[o * cd + a]) * pe[a] for a in range(cd))
+                 for o in range(od)]  # Jc p, per residual component
+            te = jnp.stack([
+                sum(up(Jp[o * pd + b]) * u[o] for o in range(od))
+                for b in range(pd)
+            ])  # Jp^T (Jc p)
+            return psum(segsum_fm(te, pt_idx, num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
-            qe = cast(jnp.take(q_pt, pt_idx, axis=0))
-            u = ee("eop,ep->eo", Jp, qe)  # Jp q
-            te = ee("eoc,eo->ec", Jc, cast(u))  # Jc^T (Jp q)
-            return psum(jax.ops.segment_sum(te, cam_idx, num_segments=num_cameras,
-                                            indices_are_sorted=cam_sorted))
+            pd = q_pt.shape[0]
+            od = opd // pd
+            cd = ocd // od
+            qe = gather_fm(q_pt, pt_idx)
+            u = [sum(up(Jp[o * pd + b]) * qe[b] for b in range(pd))
+                 for o in range(od)]  # Jp q
+            te = jnp.stack([
+                sum(up(Jc[o * cd + a]) * u[o] for o in range(od))
+                for a in range(cd)
+            ])  # Jc^T (Jp q)
+            return psum(segsum_fm(te, cam_idx, num_cameras,
+                                  indices_are_sorted=cam_sorted))
 
     return hpl, hlp
 
@@ -270,22 +272,22 @@ def plain_pcg_solve(
     `useSchur=false` (base_problem.cpp:112-123) — implemented here: PCG
     over the concatenated (camera, point) unknowns with the block-diagonal
     H as preconditioner, coupling applied by the same matrix-free /
-    per-edge-block matvecs as the Schur solver.  Useful when the point
+    per-edge-row matvecs as the Schur solver.  Useful when the point
     blocks are ill-conditioned enough that the Schur complement's
     Hll^-1 amplifies error, and as an independent cross-check of the
     Schur pipeline (both solve the same damped normal equations).
     """
     num_cameras = system.Hpp.shape[0]
-    num_points = system.Hll.shape[0]
+    num_points = system.Hll.shape[1]
 
     if mixed_precision:
         raise NotImplementedError(
             "mixed_precision is only implemented for the Schur solver")
 
     Hpp_d = damp_blocks(system.Hpp, region)
-    Hll_d = damp_blocks(system.Hll, region)
+    Hll_d = damp_rows_fm(system.Hll, region)
     Minv_c = block_inv(Hpp_d)
-    Minv_p = block_inv(Hll_d)
+    Minv_p = block_inv_fm(Hll_d)
 
     hpl, hlp = make_coupling_matvecs(
         system.W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
@@ -295,17 +297,72 @@ def plain_pcg_solve(
     def h_matvec(x):
         # [Hpp Hpl; Hlp Hll] applied blockwise      [2 psums]
         xc, xp = x
-        return (block_matvec(Hpp_d, xc) + hpl(xp),
-                hlp(xc) + block_matvec(Hll_d, xp))
+        return (cam_block_matvec(Hpp_d, xc) + hpl(xp),
+                hlp(xc) + block_matvec_fm(Hll_d, xp))
 
     def precond(r):
         rc, rp = r
-        return block_matvec(Minv_c, rc), block_matvec(Minv_p, rp)
+        return cam_block_matvec(Minv_c, rc), block_matvec_fm(Minv_p, rp)
 
     (xc, xp), k, rho = _pcg_core(
         h_matvec, precond, (system.g_cam, system.g_pt),
         max_iter, tol, refuse_ratio, tol_relative)
     return PCGResult(dx_cam=xc, dx_pt=xp, iterations=k, rho=rho)
+
+
+def _schur_diag_precond(
+    Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
+    compute_kind, axis_name, cam_sorted,
+):
+    """True Schur block diagonal: Hpp_c - sum_e W_e Hll^-1 W_e^T.
+
+    Chunked over edges (like the Hessian build) so the [cd*cd, chunk]
+    correction rows never materialise at full edge scale — the round-1
+    [nE, 9, 9] transient that made this preconditioner unusable at
+    Final scale is gone.
+    """
+    cd = Hpp_d.shape[-1]
+    pd = int(round(Hll_inv.shape[0] ** 0.5))
+    dtype = Hpp_d.dtype
+    nE = cam_idx.shape[0]
+    od = None if Jc is None else Jc.shape[0] // cd
+
+    def body(start, size, accs):
+        (corr_a,) = accs
+        ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
+        pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
+        hinv = gather_fm(Hll_inv, pi)  # [pd*pd, size]
+        if compute_kind == ComputeKind.EXPLICIT:
+            w_rows = slice_fm(W, start, size)
+        else:
+            w_rows = coupling_rows(
+                slice_fm(Jc, start, size).astype(dtype),
+                slice_fm(Jp, start, size).astype(dtype), od)
+        w = [w_rows[i].astype(dtype) for i in range(cd * pd)]
+        # t[a, q] = sum_p w[a, p] hinv[p, q]
+        t = [sum(w[a * pd + p] * hinv[p * pd + q] for p in range(pd))
+             for a in range(cd) for q in range(pd)]
+        corr = jnp.stack([
+            sum(t[a * pd + q] * w[b * pd + q] for q in range(pd))
+            for a in range(cd) for b in range(cd)
+        ])
+        return (corr_a.at[:, ci].add(
+            corr, indices_are_sorted=cam_sorted, mode="drop"),)
+
+    (corr_rows,) = chunked_edge_reduce(
+        nE, (jnp.zeros((cd * cd, num_cameras), dtype),), body)
+    if axis_name is not None:
+        corr_rows = jax.lax.psum(corr_rows, axis_name)
+    corr = jnp.moveaxis(corr_rows.reshape(cd, cd, num_cameras), -1, 0)
+    # In exact arithmetic Hpp_d - corr is SPD (a principal block of S),
+    # but rounding (especially equilibrated bf16 operands) can push a
+    # weakly-determined camera block indefinite -> Cholesky NaN.  Fall
+    # back to the Hpp preconditioner for exactly those blocks instead of
+    # letting NaN masquerade as convergence.
+    minv_hpp = block_inv(Hpp_d)
+    minv_sd = block_inv(Hpp_d - corr)
+    bad = ~jnp.all(jnp.isfinite(minv_sd), axis=(-2, -1), keepdims=True)
+    return jnp.where(bad, minv_hpp, minv_sd)
 
 
 def schur_pcg_solve(
@@ -325,7 +382,7 @@ def schur_pcg_solve(
     cam_sorted: bool = False,
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
 ) -> PCGResult:
-    """Solve the damped Schur system for (dx_cam, dx_pt).
+    """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
     Semantics follow the reference (SolverOption defaults common.h:27-33):
     `tol` is the absolute threshold on rho = <r, M^-1 r> (loop exits when
@@ -336,10 +393,11 @@ def schur_pcg_solve(
     (1 + 1/region).
     """
     num_cameras = system.Hpp.shape[0]
-    num_points = system.Hll.shape[0]
+    num_points = system.Hll.shape[1]
+    pd = int(round(system.Hll.shape[0] ** 0.5))
 
     Hpp_d = damp_blocks(system.Hpp, region)
-    Hll_d = damp_blocks(system.Hll, region)
+    Hll_d = damp_rows_fm(system.Hll, region)
     g_cam, g_pt = system.g_cam, system.g_pt
     W = system.W
 
@@ -351,54 +409,43 @@ def schur_pcg_solve(
         # (D S D) x~ = D v with D = diag(H)^-1/2 — unit-diagonal, so the
         # bf16-cast coupling operands are well-ranged — and unscale the
         # solution at the end.
-        d_cam = jax.lax.rsqrt(jnp.diagonal(Hpp_d, axis1=-2, axis2=-1))
-        d_pt = jax.lax.rsqrt(jnp.diagonal(Hll_d, axis1=-2, axis2=-1))
-        Hpp_d = Hpp_d * d_cam[:, :, None] * d_cam[:, None, :]
-        Hll_d = Hll_d * d_pt[:, :, None] * d_pt[:, None, :]
+        cd = Hpp_d.shape[-1]
+        dc = jax.lax.rsqrt(jnp.diagonal(Hpp_d, axis1=-2, axis2=-1))  # [Nc, cd]
+        Hpp_d = Hpp_d * dc[:, :, None] * dc[:, None, :]
+        d_cam = jnp.swapaxes(dc, 0, 1)  # [cd, Nc] rows
+        d_pt = jax.lax.rsqrt(jnp.stack(
+            [Hll_d[i * (pd + 1)] for i in range(pd)]))  # [pd, Np]
+        Hll_d = Hll_d * jnp.stack(
+            [d_pt[i] * d_pt[j] for i in range(pd) for j in range(pd)])
         g_cam = g_cam * d_cam
         g_pt = g_pt * d_pt
         bf = jnp.bfloat16
+        dc_e = gather_fm(d_cam, cam_idx)  # [cd, nE]
+        dp_e = gather_fm(d_pt, pt_idx)  # [pd, nE]
         if compute_kind == ComputeKind.EXPLICIT:
-            W = (
-                W
-                * jnp.take(d_cam, cam_idx, axis=0)[:, :, None]
-                * jnp.take(d_pt, pt_idx, axis=0)[:, None, :]
-            ).astype(bf)
+            W = jnp.stack([
+                W[a * pd + b] * dc_e[a] * dp_e[b]
+                for a in range(cd) for b in range(pd)
+            ]).astype(bf)
         else:
-            Jc = (Jc * jnp.take(d_cam, cam_idx, axis=0)[:, None, :]).astype(bf)
-            Jp = (Jp * jnp.take(d_pt, pt_idx, axis=0)[:, None, :]).astype(bf)
+            od = Jc.shape[0] // cd
+            Jc = jnp.stack([
+                Jc[o * cd + a] * dc_e[a]
+                for o in range(od) for a in range(cd)
+            ]).astype(bf)
+            Jp = jnp.stack([
+                Jp[o * pd + b] * dp_e[b]
+                for o in range(od) for b in range(pd)
+            ]).astype(bf)
 
-    Hll_inv = block_inv(Hll_d)
+    Hll_inv = block_inv_fm(Hll_d)
     if preconditioner == PreconditionerKind.SCHUR_DIAG:
-        # True Schur block diagonal: Hpp_c - sum_e W_e Hll^-1 W_e^T,
-        # one segment_sum of per-edge [cd,cd] blocks (see
-        # common.PreconditionerKind).  W_e from storage (EXPLICIT) or
-        # recomputed (IMPLICIT); Hll_inv gathered per edge.
-        if compute_kind == ComputeKind.EXPLICIT:
-            W_e = W
-        else:
-            W_e = (jnp.einsum("eoc,eop->ecp", Jc, Jp,
-                              preferred_element_type=jnp.float32)
-                   if mixed_precision else
-                   jnp.einsum("eoc,eop->ecp", Jc, Jp, precision=HI))
-        W_e = W_e.astype(Hpp_d.dtype)  # bf16 operands -> full precision
-        Hinv_e = jnp.take(Hll_inv, pt_idx, axis=0)  # [nE, pd, pd]
-        corr_e = jnp.einsum("ecp,epq,edq->ecd", W_e, Hinv_e, W_e,
-                            precision=HI)
-        corr = jax.ops.segment_sum(corr_e, cam_idx,
-                                   num_segments=num_cameras,
-                                   indices_are_sorted=cam_sorted)
-        if axis_name is not None:
-            corr = jax.lax.psum(corr, axis_name)
-        # In exact arithmetic Hpp_d - corr is SPD (a principal block of
-        # S), but rounding (especially equilibrated bf16 operands) can
-        # push a weakly-determined camera block indefinite -> Cholesky
-        # NaN.  Fall back to the Hpp preconditioner for exactly those
-        # blocks instead of letting NaN masquerade as convergence.
-        minv_hpp = block_inv(Hpp_d)
-        minv_sd = block_inv(Hpp_d - corr.astype(Hpp_d.dtype))
-        bad = ~jnp.all(jnp.isfinite(minv_sd), axis=(-2, -1), keepdims=True)
-        Minv = jnp.where(bad, minv_hpp, minv_sd)
+        # The correction rows are always accumulated in full precision
+        # (any bf16 operands are upcast in the body), so no precision
+        # flag is threaded through.
+        Minv = _schur_diag_precond(
+            Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
+            compute_kind, axis_name, cam_sorted)
     else:
         Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
 
@@ -410,18 +457,18 @@ def schur_pcg_solve(
 
     def s_matvec(p: jax.Array) -> jax.Array:
         # S p = Hpp_d p - Hpl Hll_d^-1 Hlp p     [2 psums]
-        t = block_matvec(Hll_inv, hlp(p))
-        return block_matvec(Hpp_d, p) - hpl(t)
+        t = block_matvec_fm(Hll_inv, hlp(p))
+        return cam_block_matvec(Hpp_d, p) - hpl(t)
 
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
-    v = g_cam - hpl(block_matvec(Hll_inv, g_pt))
+    v = g_cam - hpl(block_matvec_fm(Hll_inv, g_pt))
 
     x, k, rho = _pcg_core(
-        s_matvec, lambda r: block_matvec(Minv, r), v,
+        s_matvec, lambda r: cam_block_matvec(Minv, r), v,
         max_iter, tol, refuse_ratio, tol_relative)
 
     # Back-substitute the point update       [1 psum]
-    dx_pt = block_matvec(Hll_inv, g_pt - hlp(x))
+    dx_pt = block_matvec_fm(Hll_inv, g_pt - hlp(x))
     if mixed_precision:
         x = x * d_cam  # unscale back to the original variables
         dx_pt = dx_pt * d_pt

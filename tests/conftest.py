@@ -1,27 +1,53 @@
 """Test configuration.
 
-Runs everything on the CPU backend with 8 virtual devices (the
+Default lane: everything on the CPU backend with 8 virtual devices (the
 multi-device story the reference could never test — SURVEY.md §4) and
 float64 enabled for numerical verification.
+
+Opt-in hardware lane: `MEGBA_TPU_TESTS=1 pytest -m tpu` keeps the real
+accelerator backend available and runs ONLY the `tpu`-marked suite
+(tests/test_tpu.py) — serialized, foreground, f32.  Without the env var
+the tpu marker is skipped and the whole process is pinned to CPU before
+any backend init (the axon tunnel is single-client; a stray init from a
+parallel unit test could wedge it).
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import pytest
+
+TPU_LANE = os.environ.get("MEGBA_TPU_TESTS") == "1"
+
+if not TPU_LANE:
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import jax
 
-jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_x64", not TPU_LANE)
 
-# The axon TPU plugin's register() overrides jax_platforms to "axon,cpu" at
-# interpreter startup (sitecustomize), stealing the default device and —
-# when the remote TPU tunnel is busy — hanging backend init.  Backends
-# initialize lazily, so forcing CPU here (before any device query) keeps
-# the whole test suite off the TPU: unit tests are deterministic float64.
-jax.config.update("jax_platforms", "cpu")
+if not TPU_LANE:
+    # The axon TPU plugin's register() overrides jax_platforms to
+    # "axon,cpu" at interpreter startup (sitecustomize), stealing the
+    # default device and — when the remote TPU tunnel is busy — hanging
+    # backend init.  Backends initialize lazily, so forcing CPU here
+    # (before any device query) keeps the whole suite off the TPU.
+    jax.config.update("jax_platforms", "cpu")
 
-_cpus = jax.devices("cpu")
-jax.config.update("jax_default_device", _cpus[0])
+_cpus = jax.devices("cpu") if not TPU_LANE else []
+if _cpus:
+    jax.config.update("jax_default_device", _cpus[0])
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if "tpu" in item.keywords:
+            if not TPU_LANE:
+                item.add_marker(pytest.mark.skip(
+                    reason="TPU lane disabled (set MEGBA_TPU_TESTS=1)"))
+        elif TPU_LANE:
+            item.add_marker(pytest.mark.skip(
+                reason="TPU lane runs only -m tpu tests"))
 
 
 def cpu_devices(n: int):

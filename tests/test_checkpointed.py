@@ -19,7 +19,11 @@ def setup(seed=0):
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
     args = (jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
             jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx))
-    lm_args = args + (jnp.ones(len(s.obs)),)
+    # lm_solve is internal (feature-major); solve_checkpointed is public
+    # (edge-major) — hence the two arg tuples differ in orientation.
+    lm_args = (jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T),
+               jnp.asarray(s.obs.T), jnp.asarray(s.cam_idx),
+               jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)))
     return f, args, lm_args, option
 
 

@@ -26,15 +26,15 @@ from megba_tpu.ops.residuals import make_residual_jacobian_fn
 def test_duplicate_camera_point_pairs_accumulate():
     # Two identical edges must contribute exactly twice one edge's blocks.
     s = make_synthetic_bal(num_cameras=3, num_points=10, obs_per_point=2, seed=0)
-    cams, pts = jnp.asarray(s.cameras0), jnp.asarray(s.points0)
+    cams, pts = jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T)
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
 
     def build(cam_idx, pt_idx, obs):
         cam_idx, pt_idx, obs = (jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-                                jnp.asarray(obs))
-        r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+                                jnp.asarray(obs.T))
+        r, Jc, Jp = f(cams[:, cam_idx], pts[:, pt_idx], obs)
         r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx,
-                                         jnp.ones(len(obs)))
+                                         jnp.ones(obs.shape[1]))
         return build_schur_system(r, Jc, Jp, cam_idx, pt_idx, 3, 10)
 
     one = build(s.cam_idx[:1], s.pt_idx[:1], s.obs[:1])
@@ -43,8 +43,8 @@ def test_duplicate_camera_point_pairs_accumulate():
     c = int(s.cam_idx[0])
     np.testing.assert_allclose(np.asarray(two.Hpp[c]),
                                2 * np.asarray(one.Hpp[c]), rtol=1e-12)
-    np.testing.assert_allclose(np.asarray(two.g_cam[c]),
-                               2 * np.asarray(one.g_cam[c]), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(two.g_cam[:, c]),
+                               2 * np.asarray(one.g_cam[:, c]), rtol=1e-12)
 
 
 def test_facade_rejects_unknown_vertex_edge():

@@ -43,7 +43,7 @@ def test_final_cost_matches_scipy():
         solver_option=SolverOption(max_iter=300, tol=1e-16, refuse_ratio=1e30))
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
     ours = lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(obs),
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(obs.T),
         jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.ones(len(obs)), option)
 
     np.testing.assert_allclose(float(ours.cost), scipy_cost, rtol=1e-6)

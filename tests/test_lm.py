@@ -35,7 +35,7 @@ def run_lm(compute_kind=ComputeKind.IMPLICIT, mode=JacobianMode.ANALYTICAL,
         lambda cams, pts, obs, ci, pi, m: lm_solve(
             f, cams, pts, obs, ci, pi, m, option)
     )(
-        jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+        jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(s.obs.T),
         jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx),
         jnp.ones(len(s.obs)),
     )
@@ -80,8 +80,8 @@ def test_lm_mixed_precision_converges():
             mixed_precision_pcg=mixed,
             algo_option=AlgoOption(max_iter=30, epsilon1=1e-9, epsilon2=1e-12),
             solver_option=SolverOption(max_iter=100, tol=1e-14, refuse_ratio=1e30))
-        return lm_solve(f, jnp.asarray(s.cameras0), jnp.asarray(s.points0),
-                        jnp.asarray(s.obs), jnp.asarray(s.cam_idx),
+        return lm_solve(f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T),
+                        jnp.asarray(s.obs.T), jnp.asarray(s.cam_idx),
                         jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)), option)
 
     full = solve(False)
@@ -104,8 +104,8 @@ def test_lm_noop_at_optimum():
     option = ProblemOption()
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
     res = lm_solve(
-        f, jnp.asarray(s.cameras_gt), jnp.asarray(s.points_gt),
-        jnp.asarray(s.obs), jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx),
+        f, jnp.asarray(s.cameras_gt.T), jnp.asarray(s.points_gt.T),
+        jnp.asarray(s.obs.T), jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx),
         jnp.ones(len(s.obs)), option)
     assert float(res.cost) < 1e-18
-    np.testing.assert_allclose(np.asarray(res.cameras), s.cameras_gt, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(res.cameras).T, s.cameras_gt, atol=1e-9)

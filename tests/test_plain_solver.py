@@ -38,7 +38,7 @@ def test_plain_lm_converges_and_matches_schur():
     s = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
                            seed=0, param_noise=4e-2, pixel_noise=0.3)
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
-    args = (jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+    args = (jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(s.obs.T),
             jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)))
 
     def opt(use_schur):

@@ -44,7 +44,7 @@ def test_planar_lm_converges_noiseless():
     f = make_residual_jacobian_fn(residual_fn=planar.residual,
                                   mode=JacobianMode.AUTODIFF)
     res = lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(s.obs.T),
         jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)),
         make_option())
     assert float(res.initial_cost) > 1.0
@@ -58,11 +58,11 @@ def test_planar_distributed():
                                   mode=JacobianMode.AUTODIFF)
     obs, cam_idx, pt_idx, mask = shard_edge_arrays(s.obs, s.cam_idx, s.pt_idx, 4)
     res = distributed_lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(obs),
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(obs.T),
         jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
         make_option(12), make_mesh(4, cpu_devices(4)))
     single = lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(s.obs.T),
         jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)),
         make_option(12))
     np.testing.assert_allclose(float(res.cost), float(single.cost), rtol=1e-8)

@@ -61,9 +61,9 @@ def test_jacobian_finite_difference():
 def test_forward_and_reverse_autodiff_agree():
     r = np.random.default_rng(5)
     edges = [random_edge(r) for _ in range(8)]
-    cams = jnp.stack([e[0] for e in edges])
-    pts = jnp.stack([e[1] for e in edges])
-    obs = jnp.stack([e[2] for e in edges])
+    cams = jnp.stack([e[0] for e in edges], axis=-1)
+    pts = jnp.stack([e[1] for e in edges], axis=-1)
+    obs = jnp.stack([e[2] for e in edges], axis=-1)
     fa = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     fb = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF_FORWARD)
     ra, Jca, Jpa = fa(cams, pts, obs)
@@ -76,14 +76,14 @@ def test_forward_and_reverse_autodiff_agree():
 def test_vectorised_modes_agree():
     r = np.random.default_rng(2)
     edges = [random_edge(r) for _ in range(16)]
-    cams = jnp.stack([e[0] for e in edges])
-    pts = jnp.stack([e[1] for e in edges])
-    obs = jnp.stack([e[2] for e in edges])
+    cams = jnp.stack([e[0] for e in edges], axis=-1)
+    pts = jnp.stack([e[1] for e in edges], axis=-1)
+    obs = jnp.stack([e[2] for e in edges], axis=-1)
     fa = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     fb = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
     ra, Jca, Jpa = jax.jit(fa)(cams, pts, obs)
     rb, Jcb, Jpb = jax.jit(fb)(cams, pts, obs)
-    assert ra.shape == (16, 2) and Jca.shape == (16, 2, 9) and Jpa.shape == (16, 2, 3)
+    assert ra.shape == (2, 16) and Jca.shape == (18, 16) and Jpa.shape == (6, 16)
     np.testing.assert_allclose(ra, rb, rtol=1e-12)
     np.testing.assert_allclose(Jca, Jcb, rtol=1e-9, atol=1e-9)
     np.testing.assert_allclose(Jpa, Jpb, rtol=1e-9, atol=1e-9)
@@ -93,12 +93,14 @@ def test_sqrt_info_weighting():
     r = np.random.default_rng(3)
     cam, pt, obs = random_edge(r)
     res, Jc, Jp = bal_residual_jacobian_analytical(cam, pt, obs)
-    res, Jc, Jp = res[None], Jc[None], Jp[None]
-    L = jnp.asarray(np.array([[[2.0, 0.0], [1.0, 3.0]]]))
-    rw, Jcw, Jpw = apply_sqrt_info(res, Jc, Jp, L)
-    np.testing.assert_allclose(rw[0], L[0] @ res[0])
-    np.testing.assert_allclose(Jcw[0], L[0] @ Jc[0])
-    np.testing.assert_allclose(Jpw[0], L[0] @ Jp[0])
+    # Feature-major single-edge arrays: rows x 1 edge.
+    res_f, Jc_f, Jp_f = res.reshape(2, 1), Jc.reshape(18, 1), Jp.reshape(6, 1)
+    L = np.array([[2.0, 0.0], [1.0, 3.0]])
+    L_f = jnp.asarray(L.reshape(4, 1))
+    rw, Jcw, Jpw = apply_sqrt_info(res_f, Jc_f, Jp_f, L_f)
+    np.testing.assert_allclose(rw[:, 0], L @ np.asarray(res))
+    np.testing.assert_allclose(np.asarray(Jcw[:, 0]).reshape(2, 9), L @ np.asarray(Jc))
+    np.testing.assert_allclose(np.asarray(Jpw[:, 0]).reshape(2, 3), L @ np.asarray(Jp))
     # Identity passthrough.
-    r2, _, _ = apply_sqrt_info(res, Jc, Jp, None)
-    assert r2 is res
+    r2, _, _ = apply_sqrt_info(res_f, Jc_f, Jp_f, None)
+    assert r2 is res_f

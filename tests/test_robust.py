@@ -49,12 +49,13 @@ def test_weight_derivative_consistency():
 
 
 def test_none_kind_is_identity():
-    r = jnp.asarray(np.random.default_rng(0).normal(size=(8, 2)))
-    Jc = jnp.asarray(np.random.default_rng(1).normal(size=(8, 2, 9)))
-    Jp = jnp.asarray(np.random.default_rng(2).normal(size=(8, 2, 3)))
+    # Feature-major rows: r [od, nE], Jc [od*cd, nE], Jp [od*pd, nE].
+    r = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8)))
+    Jc = jnp.asarray(np.random.default_rng(1).normal(size=(18, 8)))
+    Jp = jnp.asarray(np.random.default_rng(2).normal(size=(6, 8)))
     r2, Jc2, Jp2, rho = robustify(r, Jc, Jp, RobustKind.NONE, 1.0)
     np.testing.assert_allclose(r2, r)
-    np.testing.assert_allclose(rho, jnp.sum(r * r, axis=1))
+    np.testing.assert_allclose(rho, jnp.sum(r * r, axis=0))
 
 
 def solve(s, robust_kind, delta=3.0, anchor_gauge=False):
@@ -71,7 +72,7 @@ def solve(s, robust_kind, delta=3.0, anchor_gauge=False):
         cameras0[:2] = s.cameras_gt[:2]
         cam_fixed = jnp.zeros(len(cameras0), bool).at[:2].set(True)
     return lm_solve(
-        f, jnp.asarray(cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
+        f, jnp.asarray(cameras0.T), jnp.asarray(s.points0.T), jnp.asarray(s.obs.T),
         jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)),
         option, cam_fixed=cam_fixed)
 
@@ -92,7 +93,7 @@ def test_outlier_rejection(kind):
 
     def pt_err(res):
         return float(jnp.median(jnp.linalg.norm(
-            res.points - jnp.asarray(s.points_gt), axis=1)))
+            res.points - jnp.asarray(s.points_gt.T), axis=0)))
 
     e_l2, e_rb = pt_err(res_l2), pt_err(res_rb)
     assert e_rb < e_l2 * 0.5, (e_l2, e_rb)

@@ -39,9 +39,9 @@ def solve_world(s, world_size, compute_kind=ComputeKind.IMPLICIT):
         s.obs, s.cam_idx, s.pt_idx, world_size)
     mesh = make_mesh(world_size, cpu_devices(world_size))
     return distributed_lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(obs),
-        jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
-        option, mesh)
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T),
+        jnp.asarray(obs.T), jnp.asarray(cam_idx), jnp.asarray(pt_idx),
+        jnp.asarray(mask), option, mesh)
 
 
 @pytest.mark.parametrize("world_size", [2, 8])
@@ -73,12 +73,12 @@ def test_distributed_mixed_precision():
     obs, cam_idx, pt_idx, mask = shard_edge_arrays(s.obs, s.cam_idx, s.pt_idx, 4)
     mesh = make_mesh(4, cpu_devices(4))
     res = distributed_lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(obs),
-        jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
-        option, mesh)
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T),
+        jnp.asarray(obs.T), jnp.asarray(cam_idx), jnp.asarray(pt_idx),
+        jnp.asarray(mask), option, mesh)
     single = distributed_lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0),
-        jnp.asarray(s.obs), jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx),
+        f, jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T),
+        jnp.asarray(s.obs.T), jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx),
         jnp.ones(len(s.obs)), option, make_mesh(1, cpu_devices(1)))
     assert float(res.cost) < float(res.initial_cost) * 1e-2
     # bf16 rounding differs with shard count, so the LM trajectories

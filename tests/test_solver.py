@@ -15,14 +15,14 @@ from megba_tpu.solver import dense_reference_solve, schur_pcg_solve
 def build_test_system(seed=0, num_cameras=3, num_points=12, compute_kind=ComputeKind.IMPLICIT,
                       cam_fixed=None, pt_fixed=None):
     s = make_synthetic_bal(num_cameras=num_cameras, num_points=num_points, seed=seed)
-    cams = jnp.asarray(s.cameras0)
-    pts = jnp.asarray(s.points0)
+    cams = jnp.asarray(s.cameras0.T)
+    pts = jnp.asarray(s.points0.T)
     cam_idx = jnp.asarray(s.cam_idx)
     pt_idx = jnp.asarray(s.pt_idx)
-    obs = jnp.asarray(s.obs)
-    mask = jnp.ones(obs.shape[0])
+    obs = jnp.asarray(s.obs.T)
+    mask = jnp.ones(obs.shape[1])
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
-    r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+    r, Jc, Jp = f(cams[:, cam_idx], pts[:, pt_idx], obs)
     r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx, mask,
                                      cam_fixed=cam_fixed, pt_fixed=pt_fixed)
     system = build_schur_system(
@@ -43,17 +43,19 @@ def test_block_inv_matches_numpy(d):
 
 def test_hessian_blocks_match_dense_assembly():
     system, r, Jc, Jp, cam_idx, pt_idx = build_test_system()
-    # Assemble J^T J brute-force per camera from the edge list.
-    nE = r.shape[0]
+    # Assemble J^T J brute-force per camera from the edge list (rows ->
+    # per-edge [od, cd] blocks via reshape of the feature axis).
+    nE = r.shape[1]
     for c in range(3):
         H = np.zeros((9, 9))
         g = np.zeros(9)
         for e in range(nE):
             if int(cam_idx[e]) == c:
-                H += np.asarray(Jc[e]).T @ np.asarray(Jc[e])
-                g -= np.asarray(Jc[e]).T @ np.asarray(r[e])
+                Je = np.asarray(Jc[:, e]).reshape(2, 9)
+                H += Je.T @ Je
+                g -= Je.T @ np.asarray(r[:, e])
         np.testing.assert_allclose(system.Hpp[c], H, rtol=1e-10, atol=1e-12)
-        np.testing.assert_allclose(system.g_cam[c], g, rtol=1e-10, atol=1e-12)
+        np.testing.assert_allclose(system.g_cam[:, c], g, rtol=1e-10, atol=1e-12)
 
 
 def test_damping():
@@ -176,44 +178,44 @@ def test_fixed_camera_gets_zero_update():
     system, r, Jc, Jp, cam_idx, pt_idx = build_test_system(cam_fixed=cam_fixed)
     out = schur_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, jnp.asarray(100.0),
                           max_iter=300, tol=1e-13, refuse_ratio=1e30)
-    np.testing.assert_allclose(out.dx_cam[0], np.zeros(9), atol=1e-12)
-    assert float(jnp.max(jnp.abs(out.dx_cam[1:]))) > 0
+    np.testing.assert_allclose(out.dx_cam[:, 0], np.zeros(9), atol=1e-12)
+    assert float(jnp.max(jnp.abs(out.dx_cam[:, 1:]))) > 0
 
 
 def test_edgeless_vertex_is_inert_not_nan():
     # A point with no observations (possible in filtered real datasets)
     # must get a zero update, not NaN-poison the solve.
     s = make_synthetic_bal(num_cameras=3, num_points=12, seed=2)
-    cams, pts0 = jnp.asarray(s.cameras0), np.asarray(s.points0)
-    pts = jnp.asarray(np.concatenate([pts0, [[9.0, 9.0, 9.0]]]))  # orphan point 12
-    cam_idx, pt_idx, obs = jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.asarray(s.obs)
+    cams, pts0 = jnp.asarray(s.cameras0.T), np.asarray(s.points0)
+    pts = jnp.asarray(np.concatenate([pts0, [[9.0, 9.0, 9.0]]]).T)  # orphan point 12
+    cam_idx, pt_idx, obs = jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.asarray(s.obs.T)
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
-    r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+    r, Jc, Jp = f(cams[:, cam_idx], pts[:, pt_idx], obs)
     r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx, jnp.ones(len(s.obs)))
     system = build_schur_system(r, Jc, Jp, cam_idx, pt_idx, 3, 13)
     out = schur_pcg_solve(system, Jc, Jp, cam_idx, pt_idx, jnp.asarray(100.0),
                           max_iter=300, tol=1e-13, refuse_ratio=1e30)
     assert np.all(np.isfinite(out.dx_cam)) and np.all(np.isfinite(out.dx_pt))
-    np.testing.assert_allclose(out.dx_pt[12], np.zeros(3), atol=1e-14)
+    np.testing.assert_allclose(out.dx_pt[:, 12], np.zeros(3), atol=1e-14)
 
 
 def test_padding_edges_are_inert():
     # Same system with 5 extra masked edges must produce identical blocks.
     s = make_synthetic_bal(num_cameras=3, num_points=12, seed=1)
-    cams, pts = jnp.asarray(s.cameras0), jnp.asarray(s.points0)
+    cams, pts = jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T)
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
 
     def build(cam_idx, pt_idx, obs, mask):
-        r, Jc, Jp = f(cams[cam_idx], pts[pt_idx], obs)
+        r, Jc, Jp = f(cams[:, cam_idx], pts[:, pt_idx], obs)
         r, Jc, Jp = weight_system_inputs(r, Jc, Jp, cam_idx, pt_idx, mask)
         return build_schur_system(r, Jc, Jp, cam_idx, pt_idx, 3, 12)
 
-    base = build(jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.asarray(s.obs),
+    base = build(jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.asarray(s.obs.T),
                  jnp.ones(len(s.obs)))
     pad = 5
     cam_idx_p = jnp.concatenate([jnp.asarray(s.cam_idx), jnp.zeros(pad, jnp.int32)])
     pt_idx_p = jnp.concatenate([jnp.asarray(s.pt_idx), jnp.zeros(pad, jnp.int32)])
-    obs_p = jnp.concatenate([jnp.asarray(s.obs), jnp.full((pad, 2), 123.0)])
+    obs_p = jnp.concatenate([jnp.asarray(s.obs.T), jnp.full((2, pad), 123.0)], axis=1)
     mask_p = jnp.concatenate([jnp.ones(len(s.obs)), jnp.zeros(pad)])
     padded = build(cam_idx_p, pt_idx_p, obs_p, mask_p)
     np.testing.assert_allclose(padded.Hpp, base.Hpp, rtol=1e-12)
