@@ -1,0 +1,105 @@
+"""Microbenchmark of the per-PCG-iteration primitives at venice scale.
+
+Run on the real chip: python scripts/micro_tpu.py
+Times each primitive with block_until_ready over several reps.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NE = 5_001_946 // 2048 * 2048 + 2048  # venice edges padded
+NC = 1778
+NP_ = 993_923
+
+
+def timeit(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:45s} {dt*1e3:10.3f} ms")
+    return dt
+
+
+def main():
+    print(f"backend: {jax.default_backend()}  nE={NE}")
+    rng = np.random.default_rng(0)
+    cam_idx = np.sort(rng.integers(0, NC, NE)).astype(np.int32)
+    pt_idx = rng.integers(0, NP_, NE).astype(np.int32)
+    pt_sorted = np.sort(pt_idx)
+    ci = jnp.asarray(cam_idx)
+    pi = jnp.asarray(pt_idx)
+    pis = jnp.asarray(pt_sorted)
+    perm = jnp.asarray(rng.permutation(NE).astype(np.int32))
+
+    p_cam = jnp.asarray(rng.standard_normal((9, NC)), jnp.float32)
+    q_pt = jnp.asarray(rng.standard_normal((3, NP_)), jnp.float32)
+    data9 = jnp.asarray(rng.standard_normal((9, NE)), jnp.float32)
+    data3 = jnp.asarray(rng.standard_normal((3, NE)), jnp.float32)
+    data2 = jnp.asarray(rng.standard_normal((2, NE)), jnp.float32)
+
+    g_small = jax.jit(lambda p, i: jnp.take(p, i, axis=1))
+    timeit("gather [9,Nc] by sorted cam_idx", g_small, p_cam, ci)
+    timeit("gather [3,Np] by random pt_idx", g_small, q_pt, pi)
+    timeit("gather [2,nE] by random perm", g_small, data2, perm)
+
+    def scat(data, idx, n, sorted_):
+        out = jnp.zeros((data.shape[0], n), data.dtype)
+        return out.at[:, idx].add(
+            data, indices_are_sorted=sorted_, mode="drop")
+
+    s_cam = jax.jit(lambda d, i: scat(d, i, NC, True))
+    s_pt = jax.jit(lambda d, i: scat(d, i, NP_, False))
+    s_pt_srt = jax.jit(lambda d, i: scat(d, i, NP_, True))
+    timeit("scatter-add [9,nE] -> Nc sorted", s_cam, data9, ci)
+    timeit("scatter-add [3,nE] -> Np random", s_pt, data3, pi)
+    timeit("scatter-add [3,nE] -> Np sorted", s_pt_srt, data3, pis)
+
+    # segment_sum comparison
+    from jax.ops import segment_sum
+
+    ss = jax.jit(lambda d, i: segment_sum(
+        d.T, i, num_segments=NC, indices_are_sorted=True))
+    timeit("segment_sum edge-major -> Nc sorted", ss, data9, ci)
+
+    # elementwise per-edge math: the implicit product rows
+    def rowmath(Jc, pe):
+        u = [sum(Jc[o * 9 + a] * pe[a] for a in range(9)) for o in range(2)]
+        return jnp.stack([sum(u[o] for o in range(2))])
+
+    Jc = jnp.asarray(rng.standard_normal((18, NE)), jnp.float32)
+    rm = jax.jit(rowmath)
+    pe = g_small(p_cam, ci)
+    jax.block_until_ready(pe)
+    timeit("row math Jc*pe [18,nE]", rm, Jc, pe)
+
+    # comp_dot at PCG-vector size
+    from megba_tpu.ops.accum import comp_dot
+
+    v = jnp.asarray(rng.standard_normal((9, NC)), jnp.float32)
+    cd_ = jax.jit(comp_dot)
+    timeit("comp_dot [9,Nc]", cd_, v, v)
+    big = jnp.asarray(rng.standard_normal((2, NE)), jnp.float32)
+    timeit("comp_dot [2,nE] (cost reduction)", cd_, big, big)
+    timeit("plain sum [2,nE]", jax.jit(lambda x: jnp.sum(x * x)), big, reps=5)
+
+    # Pallas camera kernel at scale
+    from megba_tpu.ops.pallas_kernels import (
+        camera_hessian_gradient, camera_window_plan)
+
+    ok, window = camera_window_plan(cam_idx)
+    print(f"pallas plan ok={ok} window={window}")
+    if ok:
+        r2 = jnp.asarray(rng.standard_normal((2, NE)), jnp.float32)
+        f = jax.jit(lambda jc, r, i: camera_hessian_gradient(
+            jc, r, i, num_cameras=NC, window=window))
+        timeit("pallas camera hessian+grad (full build)", f, Jc, r2, ci)
+
+
+if __name__ == "__main__":
+    main()
