@@ -42,24 +42,52 @@ def two_sum(a: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def comp_sum(x: jax.Array) -> jax.Array:
     """Compensated sum of all elements of `x` (any shape), in x.dtype.
 
-    Log-depth pairwise two-sum tree; the recovered rounding errors are
-    summed alongside and folded in once at the root.  For float64 (CPU
-    verification path) the plain sum is already exact enough and the
-    EFT tree would only cost time, so f64 short-circuits to jnp.sum.
+    Log-depth halves tree (fold top half onto bottom half) — same
+    error class as the classic pairwise two-sum tree, but every level
+    operates on CONTIGUOUS row ranges of a [rows, 128] reshape, so the
+    TPU lowering is plain full-width vector ops with no lane-strided
+    relayouts (the original `hi[0::2]` formulation forced a cross-lane
+    shuffle per level, which dominated the reduction cost on v5e).
+    For float64 (CPU verification path) the plain sum is already exact
+    enough, so f64 short-circuits to jnp.sum.
     """
     if x.dtype == jnp.float64:
         return jnp.sum(x)
-    hi = x.ravel()
-    if hi.shape[0] == 0:
+    flat = x.ravel()
+    n = flat.shape[0]
+    if n == 0:
         return jnp.zeros((), x.dtype)
+    lanes = 128 if n >= 128 else 1
+    rows = -(-n) // lanes if lanes == 1 else -(-n // lanes)
+    pad = rows * lanes - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    hi = flat.reshape(rows, lanes)
     lo = jnp.zeros_like(hi)
     while hi.shape[0] > 1:
-        n = hi.shape[0]
-        if n % 2:
-            hi = jnp.concatenate([hi, jnp.zeros((1,), hi.dtype)])
-            lo = jnp.concatenate([lo, jnp.zeros((1,), lo.dtype)])
-        s, e = two_sum(hi[0::2], hi[1::2])
-        lo = lo[0::2] + lo[1::2] + e
+        m = hi.shape[0]
+        half = (m + 1) // 2
+        top_h, top_l = hi[half:], lo[half:]
+        if top_h.shape[0] < half:  # odd: pad the folded half with zeros
+            z = jnp.zeros((half - top_h.shape[0], lanes), hi.dtype)
+            top_h = jnp.concatenate([top_h, z])
+            top_l = jnp.concatenate([top_l, z])
+        s, e = two_sum(hi[:half], top_h)
+        lo = lo[:half] + top_l + e
+        hi = s
+    # Fold the 128 lanes of the single remaining row the same way.
+    hi = hi[0]
+    lo = lo[0]
+    while hi.shape[0] > 1:
+        m = hi.shape[0]
+        half = (m + 1) // 2
+        top_h, top_l = hi[half:], lo[half:]
+        if top_h.shape[0] < half:
+            z = jnp.zeros((half - top_h.shape[0],), hi.dtype)
+            top_h = jnp.concatenate([top_h, z])
+            top_l = jnp.concatenate([top_l, z])
+        s, e = two_sum(hi[:half], top_h)
+        lo = lo[:half] + top_l + e
         hi = s
     return hi[0] + lo[0]
 
