@@ -130,55 +130,6 @@ LM_ITERS = 8
 PCG_ITERS = 30
 
 
-def _probe_pallas(cam_idx):
-    """Decide whether to route the Hessian build through the Pallas kernel.
-
-    MEGBA_BENCH_PALLAS=0 disables, =1 forces; default 'auto' enables only
-    if the plan is feasible AND the kernel actually compiles+matches on a
-    small input on this backend (so an unexpected Mosaic lowering failure
-    degrades to the XLA path instead of killing the benchmark).
-    """
-    import jax
-    import jax.numpy as jnp
-
-    from megba_tpu.ops.pallas_kernels import (
-        DEFAULT_TILE,
-        camera_hessian_gradient,
-        camera_window_plan,
-    )
-
-    mode = os.environ.get("MEGBA_BENCH_PALLAS", "auto")
-    if mode == "0":
-        return None
-    ok, window = camera_window_plan(cam_idx)
-    if not ok:
-        return None
-    plan = (DEFAULT_TILE, window)
-    if mode == "1":
-        return plan
-    if jax.default_backend() != "tpu":
-        # Off-TPU the kernel runs in interpret mode — correct but slow;
-        # only the real TPU lowering is a performance win.
-        return None
-    try:
-        n, cd, od = 2 * DEFAULT_TILE, 9, 2
-        jc = jnp.ones((od * cd, n), jnp.float32)
-        r = jnp.ones((od, n), jnp.float32)
-        ci = jnp.asarray(np.repeat(np.arange(8), n // 8), jnp.int32)
-        hpp_rows, g = camera_hessian_gradient(
-            jc, r, ci, num_cameras=8, tile=DEFAULT_TILE, window=window,
-            interpret=False)  # probe only runs on the TPU backend
-        expect = float(n // 8 * od)
-        assert abs(float(hpp_rows[0, 0]) - expect) < 1e-2
-        return plan
-    except Exception as e:  # pragma: no cover - backend specific
-        import sys
-
-        print(f"pallas probe failed ({type(e).__name__}); using XLA path",
-              file=sys.stderr, flush=True)
-        return None
-
-
 def main() -> None:
     import sys
 
@@ -237,13 +188,31 @@ def main() -> None:
     )
     f = make_residual_jacobian_fn(mode=jac_mode)
 
-    # Feature-major lowering (core/fm.py): params/obs transposed, edge
-    # axis padded to the Pallas/chunk quantum with masked edges.
+    # Feature-major tiled lowering (ops/segtiles.py): the dual-plan slot
+    # order replaces the camera sort + quantum padding, and every
+    # segment reduction / expansion in the solver becomes a block-aligned
+    # MXU one-hot matmul (scatter-free).  f64 (ladybug) keeps the classic
+    # chunked scatter-add path.
     from megba_tpu.core.fm import EDGE_QUANTUM
     from megba_tpu.core.types import is_cam_sorted, pad_edges
 
-    obs_p, cam_idx_p, pt_idx_p, mask = pad_edges(
-        s.obs, s.cam_idx, s.pt_idx, EDGE_QUANTUM, dtype=dtype)
+    tiled = dtype == np.float32 and os.environ.get("MEGBA_TILED", "1") != "0"
+    plans = None
+    if tiled:
+        from megba_tpu.ops.segtiles import make_dual_plans
+
+        plan_c, plans = make_dual_plans(
+            s.cam_idx, s.pt_idx, NUM_CAMERAS, NUM_POINTS)
+        perm, pmask = plan_c.perm, plan_c.mask
+        obs_p = s.obs[perm] * pmask[:, None].astype(dtype)
+        cam_idx_p = plan_c.seg
+        pt_idx_p = np.where(pmask > 0, s.pt_idx[perm], 0).astype(np.int32)
+        mask = pmask.astype(dtype)
+        cam_sorted = True
+    else:
+        obs_p, cam_idx_p, pt_idx_p, mask = pad_edges(
+            s.obs, s.cam_idx, s.pt_idx, EDGE_QUANTUM, dtype=dtype)
+        cam_sorted = is_cam_sorted(s.cam_idx)
     args = (
         jnp.asarray(s.cameras0.T),
         jnp.asarray(s.points0.T),
@@ -253,16 +222,12 @@ def main() -> None:
         jnp.asarray(mask),
     )
 
-    cam_sorted = is_cam_sorted(s.cam_idx)
-    pallas_plan = (
-        _probe_pallas(cam_idx_p)
-        if cam_sorted and dtype == np.float32 else None
-    )
     solve = jax.jit(
-        lambda cams, pts, obs, ci, pi, m: lm_solve(
+        lambda cams, pts, obs, ci, pi, m, pl: lm_solve(
             f, cams, pts, obs, ci, pi, m, option, cam_sorted=cam_sorted,
-            pallas_plan=pallas_plan)
+            plans=pl)
     )
+    args = args + (plans,)
 
     # Warmup (compile) — not timed.
     res = solve(*args)

@@ -57,7 +57,7 @@ def solve_checkpointed(
     Runs through the shared flat_solve pipeline, so all chunks of the
     same configuration reuse ONE compiled program (the resume state rides
     as dynamic operands).  Extra kwargs flow to `solve.flat_solve`
-    (sqrt_info, cam_fixed, pt_fixed, pallas_plan...).
+    (sqrt_info, cam_fixed, pt_fixed, use_tiled...).
     """
     from megba_tpu.solve import flat_solve
     if checkpoint_every < 1:

@@ -103,7 +103,7 @@ def lm_solve(
     axis_name: Optional[str] = None,
     verbose: bool = False,
     cam_sorted: bool = False,
-    pallas_plan=None,
+    plans=None,
     initial_region=None,
     initial_v=None,
     verbose_token=None,
@@ -119,6 +119,12 @@ def lm_solve(
 
     `initial_region`/`initial_v` override the trust-region start state —
     the resume hook used by utils.checkpoint / solve_checkpointed.
+
+    `plans` (ops/segtiles.DualPlans) turns on the scatter-free tiled
+    path: edge arrays must be in the cam plan's slot order (the lowering
+    in solve.py arranges this); internally Jp is carried in PT-slot
+    order so both Hessian sides and both coupling products reduce over
+    sorted block-aligned segments.
     """
     num_cameras = cameras.shape[1]
     num_points = points.shape[1]
@@ -151,11 +157,16 @@ def lm_solve(
             r, Jc, Jp, rho_e = robustify(r, Jc, Jp, robust, robust_delta)
             cost = psum(comp_sum(rho_e))
             wcost = psum(comp_sum_sq(r))
+        if plans is not None:
+            # Carry Jp in PT-slot order from here on: the point-side
+            # build and both coupling products consume it there (one
+            # cross permute per linearisation instead of one per use).
+            Jp = plans.to_pt(Jp)
         system = build_schur_system(
             r, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
             compute_kind=compute_kind, axis_name=axis_name,
             cam_fixed=cam_fixed, pt_fixed=pt_fixed, cam_sorted=cam_sorted,
-            pallas_plan=pallas_plan)
+            plans=plans)
         return r, Jc, Jp, system, cost, wcost
 
     r0, Jc0, Jp0, system0, cost0, wcost0 = linearize(cameras, points)
@@ -193,7 +204,7 @@ def lm_solve(
             tol_relative=solver_opt.tol_relative,
             compute_kind=compute_kind, axis_name=axis_name,
             mixed_precision=option.mixed_precision_pcg, cam_sorted=cam_sorted,
-            preconditioner=solver_opt.preconditioner)
+            preconditioner=solver_opt.preconditioner, plans=plans)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply
@@ -207,17 +218,36 @@ def lm_solve(
 
         # Gain-ratio denominator: linearised cost at dx minus old cost
         # (the JdxpF kernel, lm_algo.cu:60-126).  J dx + e, row form:
-        dxc_e = jnp.take(dx_cam, cam_idx, axis=1)  # [cd, nE]
-        dxp_e = jnp.take(dx_pt, pt_idx, axis=1)  # [pd, nE]
         od = s["r"].shape[0]
         cd = dx_cam.shape[0]
         pd = dx_pt.shape[0]
-        jdx = jnp.stack([
-            sum(s["Jc"][o * cd + a] * dxc_e[a] for a in range(cd))
-            + sum(s["Jp"][o * pd + b] * dxp_e[b] for b in range(pd))
-            + s["r"][o]
-            for o in range(od)
-        ])
+        if plans is not None:
+            from megba_tpu.ops.segtiles import seg_expand
+
+            uk = plans.use_kernels
+            dxc_e = seg_expand(dx_cam, plans.cam, uk)
+            # Jp is PT-ordered: form (Jp dx_pt) there, then bring the
+            # [od] rows over to cam order for the sum with Jc dx_cam + r.
+            dxp_e_pt = seg_expand(dx_pt, plans.pt, uk)
+            u_pt = jnp.stack([
+                sum(s["Jp"][o * pd + b] * dxp_e_pt[b] for b in range(pd))
+                for o in range(od)
+            ])
+            jp_dx = plans.to_cam(u_pt)
+            jdx = jnp.stack([
+                sum(s["Jc"][o * cd + a] * dxc_e[a] for a in range(cd))
+                + jp_dx[o] + s["r"][o]
+                for o in range(od)
+            ])
+        else:
+            dxc_e = jnp.take(dx_cam, cam_idx, axis=1)  # [cd, nE]
+            dxp_e = jnp.take(dx_pt, pt_idx, axis=1)  # [pd, nE]
+            jdx = jnp.stack([
+                sum(s["Jc"][o * cd + a] * dxc_e[a] for a in range(cd))
+                + sum(s["Jp"][o * pd + b] * dxp_e[b] for b in range(pd))
+                + s["r"][o]
+                for o in range(od)
+            ])
         predicted = psum(comp_sum_sq(jdx))
         # The quadratic model is in the (robust-)weighted residuals; its
         # decrease is measured from the carried weighted norm, while

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from megba_tpu.common import ComputeKind
 from megba_tpu.core.fm import chunked_edge_reduce, coupling_rows, slice_fm
 from megba_tpu.ops.residuals import apply_sqrt_info
+from megba_tpu.ops.segtiles import DualPlans, jtj_grad_reduce
 
 # Hessian contractions always run at full float32: on TPU the default
 # bf16 matmul precision would corrupt the normal equations.  bf16 is an
@@ -135,7 +136,7 @@ def build_schur_system(
     cam_fixed: Optional[jax.Array] = None,
     pt_fixed: Optional[jax.Array] = None,
     cam_sorted: bool = False,
-    pallas_plan: Optional[Tuple[int, int]] = None,
+    plans: Optional[DualPlans] = None,
 ) -> SchurSystem:
     """Assemble the Schur-form normal equations from per-edge Jacobians.
 
@@ -146,10 +147,14 @@ def build_schur_system(
     are; BaseProblem sorts at lowering) — camera-side scatters then run
     as sorted segment reductions.
 
-    `pallas_plan=(tile, window)` (requires cam_sorted) routes the
-    camera-side build through the fused Pallas kernel
-    (ops/pallas_kernels.py) instead of scatter-adding chunk partials;
-    obtain the plan from `camera_window_plan` host-side.
+    `plans` (ops/segtiles.DualPlans) selects the scatter-free tiled
+    build: `Jc`/`r` are in cam-plan slot order, `Jp` is in PT-plan slot
+    order, and both block-diagonals come from the fused
+    `jtj_grad_reduce` kernel (the reference's makeHSchur / makeHppHllSchur
+    fusion, build_linear_system.cu:88-146 /
+    build_implicit_linear_system.cu:65-111, re-expressed as one-hot MXU
+    matmuls).  Without plans, the chunked scatter-add path runs (CPU /
+    f64 / sharded mesh).
 
     `axis_name`: mesh axis to psum over when the edge axis is sharded
     (the reference's ncclAllReduce of Hpp/Hll/g,
@@ -164,55 +169,43 @@ def build_schur_system(
     nE = r.shape[1]
     dtype = r.dtype
 
-    use_pallas = pallas_plan is not None
-    if use_pallas:
-        if not cam_sorted:
-            # The kernel's windowed one-hot silently drops out-of-window
-            # edges; without the sortedness guarantee that is data loss,
-            # not an optimisation.
-            raise ValueError("pallas_plan requires cam_sorted=True")
+    if plans is not None:
         if dtype != jnp.float32:
-            # The kernel accumulates in float32; silently downgrading a
+            # The kernels accumulate in float32; silently downgrading a
             # float64 build would corrupt the double-precision pipeline.
             raise ValueError(
-                f"pallas_plan requires float32 inputs, got {dtype}; "
-                "use the XLA path (pallas_plan=None) for other dtypes"
-            )
-        from megba_tpu.ops.pallas_kernels import camera_hessian_gradient
-
-        tile, window = pallas_plan
-        hpp_rows, g_cam = camera_hessian_gradient(
-            Jc, r, cam_idx, num_cameras=num_cameras, tile=tile,
-            window=window, interpret=jax.default_backend() != "tpu")
-
-    # Chunked scatter-add build: per chunk, form the outer-product rows
-    # [d*d + d, chunk] and accumulate — the race-free functional form of
-    # the reference's atomicAdd makeHpp / makeHll
-    # (build_linear_system.cu:116-134) with bounded transients.
-    def body(start, size, accs):
-        hpp_a, hll_a = accs
-        jp = slice_fm(Jp, start, size)
-        rr = slice_fm(r, start, size)
-        pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
-        if not use_pallas:
+                f"plans requires float32 inputs, got {dtype}; "
+                "use the XLA path (plans=None) for other dtypes")
+        hpp_rows, g_cam = jtj_grad_reduce(
+            Jc, r, plans.cam, plans.use_kernels)
+        r_pt = plans.to_pt(r)
+        hll_acc = jnp.concatenate(
+            jtj_grad_reduce(Jp, r_pt, plans.pt, plans.use_kernels))
+    else:
+        # Chunked scatter-add build: per chunk, form the outer-product
+        # rows [d*d + d, chunk] and accumulate — the race-free functional
+        # form of the reference's atomicAdd makeHpp / makeHll
+        # (build_linear_system.cu:116-134) with bounded transients.
+        def body(start, size, accs):
+            hpp_a, hll_a = accs
+            jp = slice_fm(Jp, start, size)
+            rr = slice_fm(r, start, size)
+            pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
             jc = slice_fm(Jc, start, size)
             ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
             cam_feat = jnp.concatenate(
                 [_outer_rows(jc, od, cd), _grad_rows(jc, rr, od, cd)])
             hpp_a = hpp_a.at[:, ci].add(
                 cam_feat, indices_are_sorted=cam_sorted, mode="drop")
-        pt_feat = jnp.concatenate(
-            [_outer_rows(jp, od, pd), _grad_rows(jp, rr, od, pd)])
-        hll_a = hll_a.at[:, pi].add(pt_feat, mode="drop")
-        return hpp_a, hll_a
+            pt_feat = jnp.concatenate(
+                [_outer_rows(jp, od, pd), _grad_rows(jp, rr, od, pd)])
+            hll_a = hll_a.at[:, pi].add(pt_feat, mode="drop")
+            return hpp_a, hll_a
 
-    hpp_init = jnp.zeros(
-        (0 if use_pallas else cd * cd + cd, num_cameras), dtype)
-    hll_init = jnp.zeros((pd * pd + pd, num_points), dtype)
-    hpp_acc, hll_acc = chunked_edge_reduce(
-        nE, (hpp_init, hll_init), body)
-
-    if not use_pallas:
+        hpp_init = jnp.zeros((cd * cd + cd, num_cameras), dtype)
+        hll_init = jnp.zeros((pd * pd + pd, num_points), dtype)
+        hpp_acc, hll_acc = chunked_edge_reduce(
+            nE, (hpp_init, hll_init), body)
         hpp_rows = hpp_acc[: cd * cd]
         g_cam = hpp_acc[cd * cd:]
     Hll = hll_acc[: pd * pd]
@@ -253,8 +246,10 @@ def build_schur_system(
         # Shard-local coupling rows (NOT reduced — the distributed matvec
         # psums the product instead, mirroring the reference's
         # beta=1/worldSize trick + product allreduce,
-        # schur_pcg_solver.cu:478-509).
-        W = coupling_rows(Jc, Jp, od)
+        # schur_pcg_solver.cu:478-509).  W lives in cam-slot order; under
+        # plans, Jp is pt-ordered and must be brought over first.
+        Jp_cam = plans.to_cam(Jp) if plans is not None else Jp
+        W = coupling_rows(Jc, Jp_cam, od)
     return SchurSystem(Hpp=Hpp, Hll=Hll, g_cam=g_cam, g_pt=g_pt, W=W)
 
 

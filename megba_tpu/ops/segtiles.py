@@ -358,3 +358,230 @@ def reduce_fallback(data: jax.Array, plan: DevicePlan) -> jax.Array:
 def expand_fallback(table: jax.Array, plan: DevicePlan) -> jax.Array:
     seg = plan.local + plan.tile_block.repeat(plan.tile) * plan.block
     return jnp.take(table, seg[0], axis=1, mode="clip")
+
+
+def seg_reduce(
+    data: jax.Array, plan: DevicePlan, use_kernels: bool
+) -> jax.Array:
+    """Plan-ordered rows -> per-segment sums; kernel or XLA fallback."""
+    if use_kernels:
+        return tile_reduce(data, plan)
+    return reduce_fallback(data, plan)
+
+
+def seg_expand(
+    table: jax.Array, plan: DevicePlan, use_kernels: bool
+) -> jax.Array:
+    """Per-segment rows -> plan-ordered per-edge rows (gather)."""
+    if use_kernels:
+        return tile_expand(table, plan)
+    return expand_fallback(table, plan)
+
+
+# ---------------------------------------------------------------------------
+# Fused J^T J + gradient build (the makeHSchur / makeHppHllSchur analog)
+# ---------------------------------------------------------------------------
+
+
+def _jtj_kernel(tb_ref, tf_ref, local_ref, j_ref, r_ref, out_ref,
+                *, block, d, od):
+    """One tile: rows of J^T J (d*d) and -J^T r (d) reduced to its block.
+
+    The per-edge outer-product rows are built in VMEM from the [od*d, T]
+    Jacobian block and immediately contracted onto the block axis with
+    one MXU matmul — the feature rows never touch HBM (the reference
+    fuses the same way with shared-memory staging + atomicAdd,
+    build_linear_system.cu:88-146).
+    """
+    i = pl.program_id(0)
+    tile = local_ref.shape[1]
+    rows = []
+    for a in range(d):
+        for b in range(d):
+            acc = None
+            for o in range(od):
+                t = j_ref[o * d + a, :] * j_ref[o * d + b, :]
+                acc = t if acc is None else acc + t
+            rows.append(acc[None, :])
+    for a in range(d):
+        acc = None
+        for o in range(od):
+            t = j_ref[o * d + a, :] * r_ref[o, :]
+            acc = t if acc is None else acc + t
+        rows.append(-acc[None, :])
+    feat = jnp.concatenate(rows, axis=0).astype(jnp.float32)  # [d*d+d, T]
+    onehot = (
+        local_ref[:, :] == jax.lax.broadcasted_iota(
+            jnp.int32, (block, tile), 0)
+    ).astype(jnp.float32)
+    partial = jax.lax.dot_general(
+        feat, onehot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [d*d+d, B]
+
+    @pl.when(tf_ref[i] == 1)
+    def _init():
+        out_ref[:, :] = partial.astype(out_ref.dtype)
+
+    @pl.when(tf_ref[i] == 0)
+    def _acc():
+        out_ref[:, :] = (out_ref[:, :] + partial).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("d", "od", "tile", "block", "num_blocks", "interpret"))
+def _jtj_reduce_call(
+    J, r, local, tile_block, tile_first, *, d, od, tile, block, num_blocks,
+    interpret,
+):
+    n_tiles = tile_block.shape[0]
+    feat = d * d + d
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i, tb, tf: (0, i)),
+            pl.BlockSpec((od * d, tile), lambda i, tb, tf: (0, i)),
+            pl.BlockSpec((od, tile), lambda i, tb, tf: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (feat, block), lambda i, tb, tf: (0, tb[i])),
+    )
+    return pl.pallas_call(
+        functools.partial(_jtj_kernel, block=block, d=d, od=od),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (feat, num_blocks * block), jnp.float32),
+        interpret=interpret,
+    )(tile_block, tile_first, local, J, r)
+
+
+def jtj_grad_reduce(
+    J: jax.Array,
+    r: jax.Array,
+    plan: DevicePlan,
+    use_kernels: bool,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused block-diagonal Hessian + gradient for one vertex kind.
+
+    J [od*d, n_slots], r [od, n_slots] in plan slot order (weighted and
+    masked).  Returns (h_rows [d*d, nS], g_rows [d, nS]) — the rows of
+    sum_e J_e^T J_e and -J_e^T r_e per segment.
+    """
+    od = r.shape[0]
+    d = J.shape[0] // od
+    if use_kernels or interpret:
+        out = _jtj_reduce_call(
+            J, r, plan.local, plan.tile_block, plan.tile_first,
+            d=d, od=od, tile=plan.tile, block=plan.block,
+            num_blocks=plan.num_blocks, interpret=interpret)
+        out = out[:, : plan.num_segments].astype(J.dtype)
+    else:
+        rows = jnp.concatenate([
+            jnp.stack([
+                sum(J[o * d + a] * J[o * d + b] for o in range(od))
+                for a in range(d) for b in range(d)]),
+            jnp.stack([
+                -sum(J[o * d + a] * r[o] for o in range(od))
+                for a in range(d)]),
+        ])
+        out = reduce_fallback(rows, plan)
+    return out[: d * d], out[d * d:]
+
+
+# ---------------------------------------------------------------------------
+# Dual plans: camera-sorted primary order + point-sorted secondary order
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DualPlans:
+    """Both edge orderings of one BA problem + cross permutations.
+
+    The primary (slot) order of all edge arrays is `cam`'s slot order;
+    `pt.inv[s_pt]` is the cam slot holding pt-slot s_pt's edge, and
+    `cam.inv[s_cam]` the reverse.  `use_kernels` selects the Pallas
+    kernels (real TPU) vs the XLA fallback (CPU tests, interpret-free).
+    """
+
+    cam: DevicePlan
+    pt: DevicePlan
+    use_kernels: bool
+
+    # -- conversions between the two slot orders (per-edge rows) --
+    def to_pt(self, rows_cam: jax.Array) -> jax.Array:
+        return jnp.take(
+            rows_cam, self.pt.inv, axis=1, mode="clip") * self.pt.mask
+
+    def to_cam(self, rows_pt: jax.Array) -> jax.Array:
+        return jnp.take(
+            rows_pt, self.cam.inv, axis=1, mode="clip") * self.cam.mask
+
+
+jax.tree_util.register_dataclass(
+    DualPlans, data_fields=["cam", "pt"], meta_fields=["use_kernels"])
+
+
+def make_dual_plans(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    tile_cam: int = DEFAULT_TILE_CAM,
+    block_cam: int = DEFAULT_BLOCK_CAM,
+    tile_pt: int = DEFAULT_TILE_PT,
+    block_pt: int = DEFAULT_BLOCK_PT,
+    use_kernels: Optional[bool] = None,
+) -> Tuple[TilePlan, DualPlans]:
+    """Plan both orderings.  Returns (cam_host_plan, device DualPlans).
+
+    The caller must reorder every edge array into the cam plan's slot
+    order (`arr[:, cam_plan.perm] * cam_plan.mask`) — that order is the
+    canonical edge axis from here on.  The pt plan is expressed in
+    cam-slot space, so `pt.inv` indexes cam slots directly.
+    """
+    cam_idx = np.asarray(cam_idx)
+    pt_idx = np.asarray(pt_idx)
+    # Keep tiles from dwarfing tiny problems (tests, toy datasets).
+    n = cam_idx.shape[0]
+
+    def _fit(t):
+        while t > 128 and t >= 4 * n:
+            t //= 2
+        return t
+
+    plan_c = build_tile_plan(cam_idx, num_cameras, _fit(tile_cam), block_cam)
+    # The pt plan is built over the CAM-SLOT edge stream: segment id of a
+    # cam slot is its edge's point (padding slots get an out-of-range
+    # marker sorted to the end and masked).
+    pt_of_slot = np.where(
+        plan_c.mask > 0, pt_idx[plan_c.perm], num_points)
+    plan_p_raw = build_tile_plan(
+        np.minimum(pt_of_slot, num_points - 1).astype(np.int64),
+        num_points, _fit(tile_pt), block_pt)
+    # Mask out slots whose source cam slot was itself padding.
+    src_mask = (plan_c.mask > 0)[plan_p_raw.perm]
+    mask_p = plan_p_raw.mask * src_mask
+    plan_p = dataclasses.replace(plan_p_raw, mask=mask_p.astype(np.float32))
+
+    inv_pt = plan_p.perm.astype(np.int32)  # pt slot -> cam slot
+    inv_pt = np.where(plan_p.mask > 0, inv_pt, 0).astype(np.int32)
+    # cam slot -> pt slot
+    slot_of_cam = np.zeros(plan_c.n_slots, np.int64)
+    real_p = plan_p.mask > 0
+    slot_of_cam[plan_p.perm[real_p]] = np.nonzero(real_p)[0]
+    inv_cam = np.where(
+        plan_c.mask > 0, slot_of_cam[np.arange(plan_c.n_slots)], 0
+    ).astype(np.int32)
+
+    if use_kernels is None:
+        import jax as _jax
+
+        use_kernels = _jax.default_backend() == "tpu"
+    dp_c = device_plan(plan_c)
+    dp_c = dataclasses.replace(dp_c, inv=jnp.asarray(inv_cam))
+    dp_p = device_plan(plan_p)
+    dp_p = dataclasses.replace(dp_p, inv=jnp.asarray(inv_pt))
+    return plan_c, DualPlans(cam=dp_c, pt=dp_p, use_kernels=use_kernels)

@@ -102,7 +102,6 @@ def distributed_lm_solve(
     pt_fixed: Optional[jax.Array] = None,
     verbose: bool = False,
     cam_sorted: bool = False,
-    pallas_plan=None,
     initial_region=None,
     initial_v=None,
     jit_cache: Optional[dict] = None,
@@ -152,7 +151,7 @@ def distributed_lm_solve(
     jitted = get_or_build_program(
         jit_cache, _cached_sharded_solve, _build_sharded_solve,
         residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
-        cam_sorted, pallas_plan)
+        cam_sorted)
 
     with jax.default_device(mesh.devices.flat[0]):
         return jitted(*args)
@@ -180,7 +179,7 @@ def get_or_build_program(jit_cache, cached_fn, build_fn, engine, *cfg):
 
 
 def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
-                         cam_sorted=False, pallas_plan=None):
+                         cam_sorted=False):
     """Build the jitted shard_map'ed solve (uncached)."""
 
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
@@ -188,7 +187,7 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
-            pallas_plan=pallas_plan, initial_region=init_region,
+            initial_region=init_region,
             initial_v=init_v, verbose_token=verbose_token,
             **dict(zip(keys, extras)))
 

@@ -32,18 +32,18 @@ from megba_tpu.parallel.mesh import (
 )
 
 
-def _build_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted,
-                        pallas_plan):
+def _build_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted):
     """Jitted single-device solve.  The trust-region resume state rides as
-    dynamic operands so chunked/checkpointed solves reuse one
-    compilation."""
+    dynamic operands so chunked/checkpointed solves reuse one compilation;
+    `plans` (a DualPlans pytree or None) rides as an operand too, so its
+    index arrays are solver inputs rather than baked-in constants."""
 
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
-           verbose_token, *extras):
+           verbose_token, plans, *extras):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, verbose=verbose, cam_sorted=cam_sorted,
-            pallas_plan=pallas_plan, initial_region=init_region,
+            plans=plans, initial_region=init_region,
             initial_v=init_v, verbose_token=verbose_token,
             **dict(zip(keys, extras)))
 
@@ -70,7 +70,7 @@ def flat_solve(
     cam_fixed: Optional[np.ndarray] = None,
     pt_fixed: Optional[np.ndarray] = None,
     verbose: bool = False,
-    pallas_plan: Optional[Tuple[int, int]] = None,
+    use_tiled: Optional[bool] = None,
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
     jit_cache: Optional[dict] = None,
@@ -92,6 +92,12 @@ def flat_solve(
     the caller-owned `jit_cache` dict when the engine is a per-problem
     closure whose lifetime must not exceed its problem's (BaseProblem
     passes its own dict).
+
+    `use_tiled` selects the scatter-free tiled path (ops/segtiles):
+    default ON for float32 single-device solves (where it replaces every
+    per-edge scatter/gather with block-aligned MXU reductions), OFF
+    otherwise (float64 verification and the sharded mesh path keep the
+    chunked scatter-add build).  MEGBA_TILED=0 force-disables.
     """
     dtype = np.dtype(option.dtype)
     if dtype == np.float64 and not jax.config.jax_enable_x64:
@@ -112,20 +118,42 @@ def flat_solve(
     cam_idx = np.asarray(cam_idx)
     pt_idx = np.asarray(pt_idx)
 
-    if not is_cam_sorted(cam_idx):
-        from megba_tpu.native import sort_edges_by_camera
+    ws = option.world_size
+    if use_tiled is None:
+        use_tiled = (
+            dtype == np.float32 and ws == 1
+            and os.environ.get("MEGBA_TILED", "1") != "0")
 
-        perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
-        cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+    plans = None
+    if use_tiled:
+        # Tiled lowering: the cam plan's slot order IS the edge axis from
+        # here on (it subsumes the camera sort and quantum padding).
+        from megba_tpu.ops.segtiles import make_dual_plans
+
+        plan_c, plans = make_dual_plans(
+            cam_idx, pt_idx, cameras.shape[0], points.shape[0])
+        perm, pmask = plan_c.perm, plan_c.mask
+        obs = obs[perm] * pmask[:, None].astype(dtype)
+        cam_idx = plan_c.seg
+        pt_idx = np.where(pmask > 0, pt_idx[perm], 0).astype(np.int32)
+        mask = pmask.astype(dtype)
         if sqrt_info is not None:
             sqrt_info = np.asarray(sqrt_info)[perm]
+        n_padded = obs.shape[0]
+    else:
+        if not is_cam_sorted(cam_idx):
+            from megba_tpu.native import sort_edges_by_camera
 
-    # Pad the edge axis: every shard must be a multiple of EDGE_QUANTUM
-    # so chunk slices and Pallas tiles are static-shape and copy-free.
-    ws = option.world_size
-    obs, cam_idx, pt_idx, mask = pad_edges(
-        obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
-    n_padded = obs.shape[0]
+            perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
+            cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+            if sqrt_info is not None:
+                sqrt_info = np.asarray(sqrt_info)[perm]
+
+        # Pad the edge axis: every shard must be a multiple of
+        # EDGE_QUANTUM so chunk slices and shards are static-shape.
+        obs, cam_idx, pt_idx, mask = pad_edges(
+            obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
+        n_padded = obs.shape[0]
     if sqrt_info is not None:
         si = np.asarray(sqrt_info).astype(dtype, copy=False)
         if si.shape[0] != n_padded:
@@ -153,7 +181,7 @@ def flat_solve(
             obs_fm, jnp.asarray(cam_idx), jnp.asarray(pt_idx),
             jnp.asarray(mask), option, mesh,
             sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
-            verbose=verbose, cam_sorted=True, pallas_plan=pallas_plan,
+            verbose=verbose, cam_sorted=True,
             initial_region=initial_region, initial_v=initial_v,
             jit_cache=jit_cache)
         return _result_to_edge_major(result)
@@ -164,7 +192,7 @@ def flat_solve(
     extras = [v for _, v in optional if v is not None]
     jitted = get_or_build_program(
         jit_cache, _cached_single_solve, _build_single_solve,
-        residual_jac_fn, option, keys, verbose, True, pallas_plan)
+        residual_jac_fn, option, keys, verbose, True)
     ir = option.algo_option.initial_region if initial_region is None else initial_region
     iv = 2.0 if initial_v is None else initial_v
     from megba_tpu.algo.lm import _next_verbose_token
@@ -173,7 +201,7 @@ def flat_solve(
         cameras_fm, points_fm, obs_fm,
         jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
         jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
-        jnp.asarray(_next_verbose_token(), jnp.int32), *extras)
+        jnp.asarray(_next_verbose_token(), jnp.int32), plans, *extras)
     return _result_to_edge_major(result)
 
 

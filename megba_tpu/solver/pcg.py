@@ -49,6 +49,7 @@ from megba_tpu.core.fm import (
 )
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 from megba_tpu.ops.accum import comp_dot
+from megba_tpu.ops.segtiles import DualPlans, seg_expand, seg_reduce
 
 HI = jax.lax.Precision.HIGHEST
 
@@ -108,12 +109,22 @@ def make_coupling_matvecs(
     axis_name: Optional[str] = None,
     mixed_precision: bool = False,
     cam_sorted: bool = False,
+    plans: Optional[DualPlans] = None,
 ) -> Tuple[Callable[[jax.Array], jax.Array], Callable[[jax.Array], jax.Array]]:
     """Build hpl(q_pt [pd,Np])->[cd,Nc] and hlp(p_cam [cd,Nc])->[pd,Np].
 
     EXPLICIT mode reads only `W` (per-edge coupling rows [cd*pd, nE]);
     IMPLICIT mode reads only `Jc`/`Jp` rows.  Edge arrays are
     shard-local; outputs are psum-reduced to replicated.
+
+    With `plans` (the TPU fast path) every segment reduction is a
+    block-aligned tiled MXU reduction and every vertex->edge expansion a
+    tiled one-hot matmul (ops/segtiles.py): `Jc`/`W` live in cam-slot
+    order, `Jp` in pt-slot order, and per-edge intermediates hop between
+    the orders via the 2-3 row cross permutes — the only non-streaming
+    traffic in the whole product.  This replaces the reference's
+    cuSPARSE SpMVs / implicitEMulx-ETMulx scatter kernels
+    (schur_pcg_solver.cu:315-366, implicit_schur_pcg_solver.cu:20-90).
 
     `mixed_precision` (BASELINE.md config 5) expects the edge operands to
     be pre-equilibrated and bf16-cast (see schur_pcg_solve); products are
@@ -126,6 +137,70 @@ def make_coupling_matvecs(
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+    if plans is not None:
+        uk = plans.use_kernels
+
+        if compute_kind == ComputeKind.EXPLICIT:
+            cdpd = W.shape[0]
+
+            def hlp(p_cam: jax.Array) -> jax.Array:
+                cd = p_cam.shape[0]
+                pd = cdpd // cd
+                pe = seg_expand(p_cam, plans.cam, uk)  # [cd, nCamSlots]
+                te = jnp.stack([
+                    sum(up(W[a * pd + b]) * pe[a] for a in range(cd))
+                    for b in range(pd)
+                ])  # [pd, nCamSlots]
+                return psum(seg_reduce(plans.to_pt(te), plans.pt, uk))
+
+            def hpl(q_pt: jax.Array) -> jax.Array:
+                pd = q_pt.shape[0]
+                cd = cdpd // pd
+                qe = plans.to_cam(
+                    seg_expand(q_pt, plans.pt, uk))  # [pd, nCamSlots]
+                te = jnp.stack([
+                    sum(up(W[a * pd + b]) * qe[b] for b in range(pd))
+                    for a in range(cd)
+                ])
+                return psum(seg_reduce(te, plans.cam, uk))
+
+        else:
+            ocd, opd = Jc.shape[0], Jp.shape[0]
+
+            def hlp(p_cam: jax.Array) -> jax.Array:
+                cd = p_cam.shape[0]
+                od = ocd // cd
+                pd = opd // od
+                pe = seg_expand(p_cam, plans.cam, uk)
+                u = jnp.stack([
+                    sum(up(Jc[o * cd + a]) * pe[a] for a in range(cd))
+                    for o in range(od)
+                ])  # [od, nCamSlots]  (Jc p per edge)
+                u_pt = plans.to_pt(u)
+                te = jnp.stack([
+                    sum(up(Jp[o * pd + b]) * u_pt[o] for o in range(od))
+                    for b in range(pd)
+                ])  # Jp^T (Jc p), pt order
+                return psum(seg_reduce(te, plans.pt, uk))
+
+            def hpl(q_pt: jax.Array) -> jax.Array:
+                pd = q_pt.shape[0]
+                od = opd // pd
+                cd = ocd // od
+                qe = seg_expand(q_pt, plans.pt, uk)
+                u = jnp.stack([
+                    sum(up(Jp[o * pd + b]) * qe[b] for b in range(pd))
+                    for o in range(od)
+                ])  # [od, nPtSlots]  (Jp q per edge)
+                u_cam = plans.to_cam(u)
+                te = jnp.stack([
+                    sum(up(Jc[o * cd + a]) * u_cam[o] for o in range(od))
+                    for a in range(cd)
+                ])  # Jc^T (Jp q), cam order
+                return psum(seg_reduce(te, plans.cam, uk))
+
+        return hpl, hlp
 
     if compute_kind == ComputeKind.EXPLICIT:
         cdpd = W.shape[0]
@@ -261,6 +336,7 @@ def plain_pcg_solve(
     mixed_precision: bool = False,
     cam_sorted: bool = False,
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
+    plans: Optional[DualPlans] = None,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -291,7 +367,7 @@ def plain_pcg_solve(
 
     hpl, hlp = make_coupling_matvecs(
         system.W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
-        compute_kind, axis_name, cam_sorted=cam_sorted,
+        compute_kind, axis_name, cam_sorted=cam_sorted, plans=plans,
     )
 
     def h_matvec(x):
@@ -312,7 +388,7 @@ def plain_pcg_solve(
 
 def _schur_diag_precond(
     Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
-    compute_kind, axis_name, cam_sorted,
+    compute_kind, axis_name, cam_sorted, plans=None,
 ):
     """True Schur block diagonal: Hpp_c - sum_e W_e Hll^-1 W_e^T.
 
@@ -326,6 +402,10 @@ def _schur_diag_precond(
     dtype = Hpp_d.dtype
     nE = cam_idx.shape[0]
     od = None if Jc is None else Jc.shape[0] // cd
+    if plans is not None and Jp is not None:
+        # The correction is assembled edge-chunked in cam order; under
+        # plans Jp lives pt-ordered, so bring it over once per build.
+        Jp = plans.to_cam(Jp)
 
     def body(start, size, accs):
         (corr_a,) = accs
@@ -381,6 +461,7 @@ def schur_pcg_solve(
     mixed_precision: bool = False,
     cam_sorted: bool = False,
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
+    plans: Optional[DualPlans] = None,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -420,8 +501,16 @@ def schur_pcg_solve(
         g_cam = g_cam * d_cam
         g_pt = g_pt * d_pt
         bf = jnp.bfloat16
-        dc_e = gather_fm(d_cam, cam_idx)  # [cd, nE]
-        dp_e = gather_fm(d_pt, pt_idx)  # [pd, nE]
+        if plans is not None:
+            # Sorted expansions instead of random gathers; Jp's scale
+            # rows must be in PT-slot order, like Jp itself.
+            dc_e = seg_expand(d_cam, plans.cam, plans.use_kernels)
+            dp_e_pt = seg_expand(d_pt, plans.pt, plans.use_kernels)
+            dp_e = plans.to_cam(dp_e_pt) if (
+                compute_kind == ComputeKind.EXPLICIT) else dp_e_pt
+        else:
+            dc_e = gather_fm(d_cam, cam_idx)  # [cd, nE]
+            dp_e = gather_fm(d_pt, pt_idx)  # [pd, nE]
         if compute_kind == ComputeKind.EXPLICIT:
             W = jnp.stack([
                 W[a * pd + b] * dc_e[a] * dp_e[b]
@@ -445,14 +534,14 @@ def schur_pcg_solve(
         # flag is threaded through.
         Minv = _schur_diag_precond(
             Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
-            compute_kind, axis_name, cam_sorted)
+            compute_kind, axis_name, cam_sorted, plans=plans)
     else:
         Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
 
     hpl, hlp = make_coupling_matvecs(
         W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
         compute_kind, axis_name, mixed_precision=mixed_precision,
-        cam_sorted=cam_sorted,
+        cam_sorted=cam_sorted, plans=plans,
     )
 
     def s_matvec(p: jax.Array) -> jax.Array:

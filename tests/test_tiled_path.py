@@ -1,0 +1,169 @@
+"""End-to-end equivalence of the tiled (scatter-free) path vs the classic
+scatter-add path.
+
+The tiled path reorders edges (dual plans), runs the fused build kernel
+and tiled coupling products; results must agree with the plain path up
+to f32 summation order.  Kernels are additionally exercised in Pallas
+interpret mode (the real-Mosaic check lives in tests/test_tpu.py).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.common import (
+    AlgoOption,
+    ComputeKind,
+    PreconditionerKind,
+    ProblemOption,
+    SolverOption,
+)
+from megba_tpu.algo.lm import lm_solve
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.linear_system.builder import build_schur_system, weight_system_inputs
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.ops.segtiles import make_dual_plans
+from megba_tpu.solve import flat_solve
+
+
+def _problem(seed=0, num_cameras=14, num_points=200, obs_per_point=4):
+    return make_synthetic_bal(
+        num_cameras=num_cameras, num_points=num_points,
+        obs_per_point=obs_per_point, seed=seed, param_noise=3e-2,
+        pixel_noise=0.4, dtype=np.float32)
+
+
+def _option(compute, mixed=False, precond=PreconditionerKind.HPP):
+    return ProblemOption(
+        dtype=np.float32,
+        compute_kind=compute,
+        mixed_precision_pcg=mixed,
+        algo_option=AlgoOption(max_iter=6, epsilon1=1e-10, epsilon2=1e-14),
+        solver_option=SolverOption(
+            max_iter=40, tol=1e-8, refuse_ratio=1e30, preconditioner=precond),
+    )
+
+
+@pytest.mark.parametrize("compute", [ComputeKind.IMPLICIT, ComputeKind.EXPLICIT])
+def test_flat_solve_tiled_matches_plain(compute):
+    s = _problem()
+    f = make_residual_jacobian_fn()
+    opt = _option(compute)
+    plain = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                       opt, use_tiled=False)
+    tiled = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                       opt, use_tiled=True)
+    assert int(tiled.iterations) == int(plain.iterations)
+    assert int(tiled.accepted) == int(plain.accepted)
+    np.testing.assert_allclose(
+        float(tiled.cost), float(plain.cost), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(tiled.cameras), np.asarray(plain.cameras),
+        rtol=5e-3, atol=5e-4)
+
+
+def test_tiled_build_matches_plain_build():
+    s = _problem(seed=3)
+    f = make_residual_jacobian_fn()
+    nc, npts = s.cameras0.shape[0], s.points0.shape[0]
+    plan_c, plans = make_dual_plans(
+        s.cam_idx, s.pt_idx, nc, npts, use_kernels=False)
+
+    cams = jnp.asarray(s.cameras0.T.astype(np.float32))
+    pts = jnp.asarray(s.points0.T.astype(np.float32))
+
+    # Plain (unsorted, no padding) reference build.
+    obs_fm = jnp.asarray(s.obs.T.astype(np.float32))
+    ci = jnp.asarray(s.cam_idx)
+    pi = jnp.asarray(s.pt_idx)
+    r, Jc, Jp = f(jnp.take(cams, ci, axis=1), jnp.take(pts, pi, axis=1),
+                  obs_fm)
+    mask1 = jnp.ones(s.cam_idx.shape[0], jnp.float32)
+    r, Jc, Jp = weight_system_inputs(r, Jc, Jp, ci, pi, mask1)
+    ref = build_schur_system(r, Jc, Jp, ci, pi, nc, npts)
+
+    # Tiled build in plan slot order.
+    perm, pmask = plan_c.perm, plan_c.mask
+    obs_p = jnp.asarray((s.obs[perm] * pmask[:, None]).T.astype(np.float32))
+    ci_p = jnp.asarray(plan_c.seg)
+    pi_p = jnp.asarray(np.where(pmask > 0, s.pt_idx[perm], 0))
+    r2, Jc2, Jp2 = f(jnp.take(cams, ci_p, axis=1),
+                     jnp.take(pts, pi_p, axis=1), obs_p)
+    r2, Jc2, Jp2 = weight_system_inputs(
+        r2, Jc2, Jp2, ci_p, pi_p, jnp.asarray(pmask))
+    Jp2_pt = plans.to_pt(Jp2)
+
+    for uk, interp in ((False, False), (False, True)):
+        p = dataclasses.replace(plans, use_kernels=uk)
+        if interp:
+            from megba_tpu.ops.segtiles import jtj_grad_reduce
+
+            hpp_rows, g_cam = jtj_grad_reduce(
+                Jc2, r2, p.cam, use_kernels=False, interpret=True)
+            hll, g_pt = jtj_grad_reduce(
+                Jp2_pt, p.to_pt(r2), p.pt, use_kernels=False, interpret=True)
+            got = dict(hpp_rows=hpp_rows, g_cam=g_cam, hll=hll, g_pt=g_pt)
+            cd = 9
+            Hpp = jnp.moveaxis(hpp_rows.reshape(cd, cd, nc), -1, 0)
+            np.testing.assert_allclose(
+                np.asarray(Hpp), np.asarray(ref.Hpp), rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(got["hll"]), np.asarray(ref.Hll),
+                rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(got["g_pt"]), np.asarray(ref.g_pt),
+                rtol=2e-4, atol=2e-4)
+        else:
+            sys2 = build_schur_system(
+                r2, Jc2, Jp2_pt, ci_p, pi_p, nc, npts, plans=p)
+            np.testing.assert_allclose(
+                np.asarray(sys2.Hpp), np.asarray(ref.Hpp),
+                rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(sys2.Hll), np.asarray(ref.Hll),
+                rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(sys2.g_cam), np.asarray(ref.g_cam),
+                rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(
+                np.asarray(sys2.g_pt), np.asarray(ref.g_pt),
+                rtol=2e-4, atol=2e-4)
+
+
+def test_tiled_mixed_precision_converges():
+    s = _problem(seed=5)
+    f = make_residual_jacobian_fn()
+    opt = _option(ComputeKind.IMPLICIT, mixed=True)
+    res = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                     opt, use_tiled=True)
+    assert float(res.cost) < 0.1 * float(res.initial_cost)
+
+
+def test_tiled_schur_diag_preconditioner():
+    s = _problem(seed=6)
+    f = make_residual_jacobian_fn()
+    opt = _option(ComputeKind.IMPLICIT,
+                  precond=PreconditionerKind.SCHUR_DIAG)
+    res = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                     opt, use_tiled=True)
+    assert float(res.cost) < 0.1 * float(res.initial_cost)
+
+
+def test_tiled_robust_loss():
+    from megba_tpu.ops.robust import RobustKind
+
+    s = _problem(seed=7)
+    f = make_residual_jacobian_fn()
+    opt = dataclasses.replace(
+        _option(ComputeKind.IMPLICIT), robust_kind=RobustKind.HUBER,
+        robust_delta=2.0)
+    plain = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                       opt, use_tiled=False)
+    tiled = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                       opt, use_tiled=True)
+    np.testing.assert_allclose(
+        float(tiled.cost), float(plain.cost), rtol=1e-3)

@@ -56,40 +56,61 @@ def test_e2e_solve_fp32(tpu_backend):
     assert int(res.accepted) > 0
 
 
-def test_pallas_kernel_on_mosaic(tpu_backend):
-    # The fused assembly kernel must lower through real Mosaic and match
-    # an f64-accumulated reference.
+def test_segtile_kernels_on_mosaic(tpu_backend):
+    # The tiled reduce / expand / fused-build kernels must lower through
+    # real Mosaic and match f64-accumulated numpy ground truth.
     import jax.numpy as jnp
 
-    from megba_tpu.ops.pallas_kernels import (
-        DEFAULT_TILE,
-        camera_hessian_gradient,
-        camera_window_plan,
+    from megba_tpu.ops.segtiles import (
+        build_tile_plan,
+        device_plan,
+        jtj_grad_reduce,
+        tile_expand,
+        tile_reduce,
     )
 
     rng = np.random.default_rng(0)
-    n, cd, od, nc = 4 * DEFAULT_TILE, 9, 2, 16
+    n, cd, od, nc = 8192, 9, 2, 57
     cam_idx = np.sort(rng.integers(0, nc, n)).astype(np.int32)
-    ok, window = camera_window_plan(cam_idx)
-    assert ok
-    jc = rng.standard_normal((od * cd, n)).astype(np.float32)
-    r = rng.standard_normal((od, n)).astype(np.float32)
-    hpp_rows, g = camera_hessian_gradient(
-        jnp.asarray(jc), jnp.asarray(r), jnp.asarray(cam_idx),
-        num_cameras=nc, tile=DEFAULT_TILE, window=window, interpret=False)
+    plan = build_tile_plan(cam_idx, nc, tile=512, block=64)
+    dp = device_plan(plan)
 
+    # tile_reduce vs numpy scatter-add
+    data = rng.standard_normal((3, n)).astype(np.float32)
+    slot_data = (data[:, plan.perm] * plan.mask).astype(np.float32)
+    ref = np.zeros((3, nc))
+    for f_ in range(3):
+        np.add.at(ref[f_], cam_idx, data[f_].astype(np.float64))
+    got = np.asarray(tile_reduce(jnp.asarray(slot_data), dp))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # tile_expand vs numpy take
+    table = rng.standard_normal((cd, nc)).astype(np.float32)
+    ge = np.asarray(tile_expand(jnp.asarray(table), dp))
+    real = plan.mask > 0
+    np.testing.assert_array_equal(
+        ge[:, real], table[:, cam_idx[plan.perm[real]]])
+
+    # fused J^T J + gradient build vs f64 numpy
+    jc = rng.standard_normal((od * cd, plan.n_slots)).astype(np.float32)
+    r = rng.standard_normal((od, plan.n_slots)).astype(np.float32)
+    jc *= plan.mask
+    r *= plan.mask
+    h_rows, g_rows = jtj_grad_reduce(
+        jnp.asarray(jc), jnp.asarray(r), dp, use_kernels=True)
     jc64, r64 = jc.astype(np.float64), r.astype(np.float64)
+    seg = plan.seg
     hpp_ref = np.zeros((cd * cd, nc))
     g_ref = np.zeros((cd, nc))
     for a in range(cd):
         for b in range(cd):
             row = sum(jc64[o * cd + a] * jc64[o * cd + b] for o in range(od))
-            np.add.at(hpp_ref[a * cd + b], cam_idx, row)
+            np.add.at(hpp_ref[a * cd + b], seg, row)
         row = -sum(jc64[o * cd + a] * r64[o] for o in range(od))
-        np.add.at(g_ref[a], cam_idx, row)
+        np.add.at(g_ref[a], seg, row)
     scale = np.abs(hpp_ref).max()
-    assert np.abs(np.asarray(hpp_rows) - hpp_ref).max() < 1e-5 * scale
-    assert np.abs(np.asarray(g) - g_ref).max() < 1e-5 * np.abs(g_ref).max()
+    assert np.abs(np.asarray(h_rows) - hpp_ref).max() < 1e-5 * scale
+    assert np.abs(np.asarray(g_rows) - g_ref).max() < 1e-5 * np.abs(g_ref).max()
 
 
 def test_mixed_precision_solve(tpu_backend):
