@@ -222,23 +222,15 @@ def lm_solve(
         cd = dx_cam.shape[0]
         pd = dx_pt.shape[0]
         if plans is not None:
-            from megba_tpu.ops.segtiles import seg_expand
+            from megba_tpu.ops.segtiles import coupling_expand
 
             uk = plans.use_kernels
-            dxc_e = seg_expand(dx_cam, plans.cam, uk)
-            # Jp is PT-ordered: form (Jp dx_pt) there, then bring the
-            # [od] rows over to cam order for the sum with Jc dx_cam + r.
-            dxp_e_pt = seg_expand(dx_pt, plans.pt, uk)
-            u_pt = jnp.stack([
-                sum(s["Jp"][o * pd + b] * dxp_e_pt[b] for b in range(pd))
-                for o in range(od)
-            ])
-            jp_dx = plans.to_cam(u_pt)
-            jdx = jnp.stack([
-                sum(s["Jc"][o * cd + a] * dxc_e[a] for a in range(cd))
-                + jp_dx[o] + s["r"][o]
-                for o in range(od)
-            ])
+            # Fused (gather + J.dx) on each side; Jp is PT-ordered, so
+            # its [od] product rows hop to cam order for the final sum.
+            jc_dx = coupling_expand(dx_cam, s["Jc"], plans.cam, cd, uk)
+            jp_dx = plans.to_cam(
+                coupling_expand(dx_pt, s["Jp"], plans.pt, pd, uk))
+            jdx = (jc_dx + jp_dx + s["r"]).astype(s["r"].dtype)
         else:
             dxc_e = jnp.take(dx_cam, cam_idx, axis=1)  # [cd, nE]
             dxp_e = jnp.take(dx_pt, pt_idx, axis=1)  # [pd, nE]

@@ -492,6 +492,183 @@ def jtj_grad_reduce(
 
 
 # ---------------------------------------------------------------------------
+# Fused coupling-product kernels: (expand -> J.x) and (J^T.u -> reduce)
+# ---------------------------------------------------------------------------
+
+
+def _expand_matvec_kernel(tb_ref, local_ref, j_ref, table_ref, out_ref,
+                          *, block, d):
+    """u[o] = sum_a J[o*d+a] * table[a, seg]: gather + per-edge matvec.
+
+    The vertex table block lives entirely in VMEM; the gather is the
+    one-hot matmul, the [od, T] product rows are the only HBM write —
+    the [d, T] expanded rows never exist outside VMEM.
+    """
+    tile = local_ref.shape[1]
+    od = j_ref.shape[0] // d
+    onehot = (
+        local_ref[:, :] == jax.lax.broadcasted_iota(
+            jnp.int32, (block, tile), 0)
+    ).astype(jnp.float32)  # [B, T]
+    pe = jax.lax.dot_general(
+        table_ref[:, :].astype(jnp.float32), onehot,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [d, T]
+    for o in range(od):
+        acc = None
+        for a in range(d):
+            t = j_ref[o * d + a, :].astype(jnp.float32) * pe[a, :]
+            acc = t if acc is None else acc + t
+        out_ref[o, :] = acc.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("d", "tile", "block", "num_blocks", "interpret"))
+def _expand_matvec_call(
+    J, table, local, tile_block, *, d, tile, block, num_blocks, interpret
+):
+    od = J.shape[0] // d
+    n_tiles = tile_block.shape[0]
+    pad = num_blocks * block - table.shape[1]
+    table_p = jnp.pad(table, ((0, 0), (0, pad))) if pad else table
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i, tb: (0, i)),
+            pl.BlockSpec((J.shape[0], tile), lambda i, tb: (0, i)),
+            pl.BlockSpec((d, block), lambda i, tb: (0, tb[i])),
+        ],
+        out_specs=pl.BlockSpec((od, tile), lambda i, tb: (0, i)),
+    )
+    return pl.pallas_call(
+        functools.partial(_expand_matvec_kernel, block=block, d=d),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((od, n_tiles * tile), jnp.float32),
+        interpret=interpret,
+    )(tile_block, local, J, table_p)
+
+
+def _matvec_reduce_kernel(tb_ref, tf_ref, local_ref, j_ref, u_ref, out_ref,
+                          *, block, d):
+    """out[b, seg] += sum_o J[o*d+b] * u[o]: per-edge J^T u + reduce.
+
+    The [d, T] product rows are formed in VMEM and immediately
+    contracted onto the block axis — they never touch HBM.
+    """
+    i = pl.program_id(0)
+    tile = local_ref.shape[1]
+    od = u_ref.shape[0]
+    rows = []
+    for b in range(d):
+        acc = None
+        for o in range(od):
+            t = (j_ref[o * d + b, :].astype(jnp.float32)
+                 * u_ref[o, :].astype(jnp.float32))
+            acc = t if acc is None else acc + t
+        rows.append(acc[None, :])
+    te = jnp.concatenate(rows, axis=0)  # [d, T]
+    onehot = (
+        local_ref[:, :] == jax.lax.broadcasted_iota(
+            jnp.int32, (block, tile), 0)
+    ).astype(jnp.float32)
+    partial = jax.lax.dot_general(
+        te, onehot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [d, B]
+
+    @pl.when(tf_ref[i] == 1)
+    def _init():
+        out_ref[:, :] = partial.astype(out_ref.dtype)
+
+    @pl.when(tf_ref[i] == 0)
+    def _acc():
+        out_ref[:, :] = (out_ref[:, :] + partial).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("d", "tile", "block", "num_blocks", "interpret"))
+def _matvec_reduce_call(
+    J, u, local, tile_block, tile_first, *, d, tile, block, num_blocks,
+    interpret,
+):
+    n_tiles = tile_block.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i, tb, tf: (0, i)),
+            pl.BlockSpec((J.shape[0], tile), lambda i, tb, tf: (0, i)),
+            pl.BlockSpec((u.shape[0], tile), lambda i, tb, tf: (0, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (d, block), lambda i, tb, tf: (0, tb[i])),
+    )
+    return pl.pallas_call(
+        functools.partial(_matvec_reduce_kernel, block=block, d=d),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (d, num_blocks * block), jnp.float32),
+        interpret=interpret,
+    )(tile_block, tile_first, local, J, u)
+
+
+def coupling_expand(
+    table: jax.Array,
+    J: jax.Array,
+    plan: DevicePlan,
+    d: int,
+    use_kernels: bool,
+    interpret: bool = False,
+) -> jax.Array:
+    """u[o] = sum_a J[o*d+a] * table[a, seg]  -> [od, n_slots] rows.
+
+    The fused (gather + J.x) half of a coupling product: J in plan slot
+    order, table [d, num_segments].  Output is float32.
+    """
+    if use_kernels or interpret:
+        return _expand_matvec_call(
+            J, table.astype(jnp.float32), plan.local, plan.tile_block,
+            d=d, tile=plan.tile, block=plan.block,
+            num_blocks=plan.num_blocks, interpret=interpret)
+    od = J.shape[0] // d
+    pe = expand_fallback(table, plan)
+    return jnp.stack([
+        sum(J[o * d + a].astype(jnp.float32) * pe[a] for a in range(d))
+        for o in range(od)
+    ])
+
+
+def coupling_reduce(
+    J: jax.Array,
+    u: jax.Array,
+    plan: DevicePlan,
+    d: int,
+    use_kernels: bool,
+    interpret: bool = False,
+) -> jax.Array:
+    """out[b, seg] = sum_edges sum_o J[o*d+b] * u[o]  -> [d, nS].
+
+    The fused (J^T.u + segment reduce) half of a coupling product.
+    """
+    if use_kernels or interpret:
+        out = _matvec_reduce_call(
+            J, u, plan.local, plan.tile_block, plan.tile_first,
+            d=d, tile=plan.tile, block=plan.block,
+            num_blocks=plan.num_blocks, interpret=interpret)
+        return out[:, : plan.num_segments]
+    od = u.shape[0]
+    te = jnp.stack([
+        sum(J[o * d + b].astype(jnp.float32) * u[o] for o in range(od))
+        for b in range(d)
+    ])
+    return reduce_fallback(te, plan)
+
+
+# ---------------------------------------------------------------------------
 # Dual plans: camera-sorted primary order + point-sorted secondary order
 # ---------------------------------------------------------------------------
 

@@ -166,39 +166,32 @@ def make_coupling_matvecs(
                 return psum(seg_reduce(te, plans.cam, uk))
 
         else:
+            from megba_tpu.ops.segtiles import (
+                coupling_expand,
+                coupling_reduce,
+            )
+
             ocd, opd = Jc.shape[0], Jp.shape[0]
 
             def hlp(p_cam: jax.Array) -> jax.Array:
                 cd = p_cam.shape[0]
                 od = ocd // cd
                 pd = opd // od
-                pe = seg_expand(p_cam, plans.cam, uk)
-                u = jnp.stack([
-                    sum(up(Jc[o * cd + a]) * pe[a] for a in range(cd))
-                    for o in range(od)
-                ])  # [od, nCamSlots]  (Jc p per edge)
+                # u = Jc p per edge (fused gather+matvec, cam order); the
+                # [od] rows hop to pt order; J^T u reduces to points
+                # (fused matvec+reduce).  The expanded [cd]/[pd] per-edge
+                # rows never touch HBM.
+                u = coupling_expand(p_cam, Jc, plans.cam, cd, uk)
                 u_pt = plans.to_pt(u)
-                te = jnp.stack([
-                    sum(up(Jp[o * pd + b]) * u_pt[o] for o in range(od))
-                    for b in range(pd)
-                ])  # Jp^T (Jc p), pt order
-                return psum(seg_reduce(te, plans.pt, uk))
+                return psum(coupling_reduce(Jp, u_pt, plans.pt, pd, uk))
 
             def hpl(q_pt: jax.Array) -> jax.Array:
                 pd = q_pt.shape[0]
                 od = opd // pd
                 cd = ocd // od
-                qe = seg_expand(q_pt, plans.pt, uk)
-                u = jnp.stack([
-                    sum(up(Jp[o * pd + b]) * qe[b] for b in range(pd))
-                    for o in range(od)
-                ])  # [od, nPtSlots]  (Jp q per edge)
+                u = coupling_expand(q_pt, Jp, plans.pt, pd, uk)
                 u_cam = plans.to_cam(u)
-                te = jnp.stack([
-                    sum(up(Jc[o * cd + a]) * u_cam[o] for o in range(od))
-                    for a in range(cd)
-                ])  # Jc^T (Jp q), cam order
-                return psum(seg_reduce(te, plans.cam, uk))
+                return psum(coupling_reduce(Jc, u_cam, plans.cam, cd, uk))
 
         return hpl, hlp
 

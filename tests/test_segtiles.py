@@ -135,6 +135,42 @@ def test_cross_perm_roundtrip():
     np.testing.assert_array_equal(back, x_c)
 
 
+@pytest.mark.parametrize("interp", [False, True])
+def test_coupling_expand_reduce(interp):
+    # Fused (gather + J.x) and (J^T.u + reduce) vs the composition of
+    # their unfused parts.
+    rng = np.random.default_rng(9)
+    n, ns, d, od = 3000, 83, 9, 2
+    idx = rng.integers(0, ns, n).astype(np.int32)
+    plan = build_tile_plan(idx, ns, 256, 32)
+    dp = device_plan(plan)
+    nslots = plan.n_slots
+    J = rng.standard_normal((od * d, nslots)).astype(np.float32)
+    J *= plan.mask
+    table = rng.standard_normal((d, ns)).astype(np.float32)
+    u_in = rng.standard_normal((od, nslots)).astype(np.float32)
+
+    from megba_tpu.ops.segtiles import coupling_expand, coupling_reduce
+
+    got_u = np.asarray(coupling_expand(
+        jnp.asarray(table), jnp.asarray(J), dp, d,
+        use_kernels=False, interpret=interp))
+    pe = table[:, plan.seg]
+    ref_u = np.stack([
+        sum(J[o * d + a] * pe[a] for a in range(d)) for o in range(od)])
+    np.testing.assert_allclose(got_u, ref_u, rtol=2e-5, atol=2e-5)
+
+    got_r = np.asarray(coupling_reduce(
+        jnp.asarray(J), jnp.asarray(u_in), dp, d,
+        use_kernels=False, interpret=interp))
+    te = np.stack([
+        sum(J[o * d + b] * u_in[o] for o in range(od)) for b in range(d)])
+    ref_r = np.zeros((d, ns), np.float64)
+    for b in range(d):
+        np.add.at(ref_r[b], plan.seg, te[b].astype(np.float64))
+    np.testing.assert_allclose(got_r, ref_r, rtol=2e-4, atol=2e-4)
+
+
 def test_reduce_accumulation_many_tiles_per_block():
     # Forces the in-kernel accumulate branch (several tiles per block).
     rng = np.random.default_rng(5)
