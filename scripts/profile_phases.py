@@ -1,0 +1,185 @@
+"""Per-phase LM-step profiler with FLOP/byte accounting (PROFILE.md data).
+
+Times each phase of the LM iteration separately on the current backend
+(designed for the real chip) at a chosen bench config, computes
+closed-form FLOP and HBM-byte counts, and reports MFU / bandwidth
+utilisation per phase.  Writes PROFILE_RAW.json and prints a table.
+
+Usage: MEGBA_BENCH_CONFIG=venice python scripts/profile_phases.py
+Never kill this mid-run on the TPU (single-client tunnel).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIG = os.environ.get("MEGBA_BENCH_CONFIG", "venice")
+SCALE = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
+
+# v5e peaks (per chip): bf16 MXU 197 TFLOP/s, HBM 819 GB/s.  f32 matmul
+# rides the MXU at ~1/2..1/4 of bf16 depending on pass decomposition;
+# MFU is reported against the bf16 peak (the honest "of what the chip
+# can do" number).
+PEAK_FLOPS = 197e12
+PEAK_BW = 819e9
+
+
+def timeit(fn, *args, reps=10):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # The axon plugin's register() overrides jax_platforms at
+        # interpreter startup; re-assert the caller's choice so a CPU
+        # smoke run can't hang on a busy TPU tunnel.
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench as B  # noqa: E402  (bench.py at repo root)
+
+    from megba_tpu.common import ComputeKind, JacobianMode
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.linear_system.builder import (
+        build_schur_system, weight_system_inputs)
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.ops.segtiles import make_dual_plans
+    from megba_tpu.solver.pcg import make_coupling_matvecs
+
+    cfg = B.CONFIGS[CONFIG]
+    nc = max(8, int(cfg.cameras * SCALE))
+    npts = max(64, int(cfg.points * SCALE))
+    dtype = np.float32
+    s = make_synthetic_bal(
+        num_cameras=nc, num_points=npts, obs_per_point=cfg.obs_per_point,
+        seed=0, param_noise=1e-2, pixel_noise=0.5, dtype=dtype)
+    nE = s.obs.shape[0]
+    print(f"backend={jax.default_backend()} config={CONFIG} "
+          f"{nc} cams / {npts} pts / {nE} edges", flush=True)
+
+    t_plan0 = time.perf_counter()
+    plan_c, plans = make_dual_plans(s.cam_idx, s.pt_idx, nc, npts)
+    t_plan = time.perf_counter() - t_plan0
+    perm, pmask = plan_c.perm, plan_c.mask
+    obs_p = jnp.asarray((s.obs[perm] * pmask[:, None]).T.astype(dtype))
+    ci = jnp.asarray(plan_c.seg)
+    pi = jnp.asarray(np.where(pmask > 0, s.pt_idx[perm], 0))
+    mask = jnp.asarray(pmask.astype(dtype))
+    cams = jnp.asarray(s.cameras0.T.astype(dtype))
+    pts = jnp.asarray(s.points0.T.astype(dtype))
+    nslots = plan_c.n_slots
+    nslots_pt = int(plans.pt.mask.shape[0])
+
+    f = make_residual_jacobian_fn(mode=JacobianMode[cfg.jacobian])
+
+    @jax.jit
+    def linearize(cams, pts):
+        r, Jc, Jp = f(jnp.take(cams, ci, axis=1),
+                      jnp.take(pts, pi, axis=1), obs_p)
+        r, Jc, Jp = weight_system_inputs(r, Jc, Jp, ci, pi, mask)
+        return r, Jc, plans.to_pt(Jp)
+
+    r, Jc, Jp = linearize(cams, pts)
+
+    @jax.jit
+    def build(r, Jc, Jp):
+        return build_schur_system(
+            r, Jc, Jp, ci, pi, nc, npts,
+            compute_kind=ComputeKind.IMPLICIT, plans=plans)
+
+    system = build(r, Jc, Jp)
+
+    hpl, hlp = make_coupling_matvecs(
+        None, Jc, Jp, ci, pi, nc, npts, ComputeKind.IMPLICIT, plans=plans)
+    hlp_j = jax.jit(hlp)
+    hpl_j = jax.jit(hpl)
+    p = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (9, nc)), jnp.float32)
+    q = hlp_j(p)
+
+    from megba_tpu.ops.accum import comp_dot
+    dots = jax.jit(lambda a: comp_dot(a, a))
+
+    phases = {}
+    phases["linearize"] = timeit(linearize, cams, pts)
+    phases["build"] = timeit(build, r, Jc, Jp)
+    phases["hlp (Hlp.p)"] = timeit(hlp_j, p)
+    phases["hpl (Hpl.q)"] = timeit(hpl_j, q)
+    phases["pcg dot [9,Nc]"] = timeit(dots, p)
+
+    B4 = 4
+    od, cd, pd = 2, 9, 3
+    byte_counts = {
+        # read obs+params gathered (via take) + write r, Jc, Jp (+ Jp perm)
+        "linearize": (2 + cd + pd) * B4 * nslots
+        + (2 + od * cd) * B4 * nslots + (od * pd) * B4 * (nslots + 2 * nslots_pt),
+        # read Jc+r (cam) and Jp+r_pt; write block diagonals (small)
+        "build": (od * cd + od) * B4 * nslots
+        + (od * pd + 2 * od) * B4 * nslots_pt,
+        # read Jc (expand side) + write u + perm u + read Jp (reduce side)
+        "hlp (Hlp.p)": (od * cd + od) * B4 * nslots
+        + 3 * od * B4 * nslots_pt + od * pd * B4 * nslots_pt,
+        "hpl (Hpl.q)": (od * pd + od) * B4 * nslots_pt
+        + 3 * od * B4 * nslots + od * cd * B4 * nslots,
+        "pcg dot [9,Nc]": 2 * 9 * nc * B4,
+    }
+    flop_counts = {
+        "linearize": 2 * 700 * nslots,  # ~700 flops/edge analytical J
+        "build": 2 * (od * (cd * cd + cd)) * nslots
+        + 2 * (od * (pd * pd + pd)) * nslots_pt
+        + 2 * (plan_c.block * (cd * cd + cd)) * nslots  # one-hot matmul
+        + 2 * (plans.pt.block * (pd * pd + pd)) * nslots_pt,
+        "hlp (Hlp.p)": 2 * plans.cam.block * cd * nslots // plan_c.tile * plan_c.tile
+        + 2 * od * cd * nslots + 2 * od * pd * nslots_pt
+        + 2 * plans.pt.block * pd * nslots_pt,
+        "hpl (Hpl.q)": 2 * plans.pt.block * pd * nslots_pt
+        + 2 * od * pd * nslots_pt + 2 * od * cd * nslots
+        + 2 * plans.cam.block * cd * nslots,
+        "pcg dot [9,Nc]": 8 * 9 * nc,
+    }
+
+    rows = []
+    print(f"\nplan build (host): {t_plan*1e3:.0f} ms")
+    print(f"{'phase':20s} {'ms':>9s} {'GB/s':>8s} {'BW%':>6s} "
+          f"{'TFLOP/s':>9s} {'MFU%':>6s}")
+    for k, dt in phases.items():
+        gbs = byte_counts[k] / dt / 1e9
+        tf = flop_counts[k] / dt / 1e12
+        rows.append(dict(phase=k, ms=dt * 1e3, gbps=gbs,
+                         bw_pct=100 * gbs * 1e9 / PEAK_BW,
+                         tflops=tf, mfu_pct=100 * tf * 1e12 / PEAK_FLOPS))
+        print(f"{k:20s} {dt*1e3:9.3f} {gbs:8.1f} "
+              f"{100*gbs*1e9/PEAK_BW:6.1f} {tf:9.2f} "
+              f"{100*tf*1e12/PEAK_FLOPS:6.1f}", flush=True)
+
+    per_pcg = phases["hlp (Hlp.p)"] + phases["hpl (Hpl.q)"] + \
+        3 * phases["pcg dot [9,Nc]"]
+    print(f"\n~per-PCG-iteration (2 products + 3 dots): {per_pcg*1e3:.2f} ms")
+    out = dict(config=CONFIG, scale=SCALE, backend=jax.default_backend(),
+               n_edges=nE, n_slots=nslots, n_slots_pt=nslots_pt,
+               cameras=nc, points=npts, plan_build_s=t_plan, phases=rows,
+               per_pcg_ms=per_pcg * 1e3)
+    with open("PROFILE_RAW.json", "w") as fh:
+        json.dump(out, fh, indent=1)
+    print("wrote PROFILE_RAW.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()
