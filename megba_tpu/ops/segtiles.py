@@ -762,3 +762,119 @@ def make_dual_plans(
     dp_p = device_plan(plan_p)
     dp_p = dataclasses.replace(dp_p, inv=jnp.asarray(inv_pt))
     return plan_c, DualPlans(cam=dp_c, pt=dp_p, use_kernels=use_kernels)
+
+
+def _pad_device_plan(dp: DevicePlan, n_tiles_to: int, junk_block: bool):
+    """Append inert tiles so stacked shards share one tile count.
+
+    Padding tiles target a dedicated JUNK block appended after the real
+    ones (first=1 on the first padding tile) — pointing them at a real
+    block would revisit it non-consecutively, which the sequential-
+    accumulation kernels do not support.
+    """
+    n_tiles = dp.tile_block.shape[0]
+    add = n_tiles_to - n_tiles
+    nb = dp.num_blocks + (1 if junk_block else 0)
+    if add == 0 and not junk_block:
+        return dp
+    if add:
+        lb = jnp.full((1, add * dp.tile), 0, jnp.int32)
+        local = jnp.concatenate([dp.local, lb], axis=1)
+        tb = jnp.concatenate([
+            dp.tile_block,
+            jnp.full((add,), nb - 1, jnp.int32)])
+        tf = jnp.concatenate([
+            dp.tile_first,
+            jnp.asarray([1] + [0] * (add - 1), jnp.int32)])
+        mask = jnp.concatenate([dp.mask, jnp.zeros((add * dp.tile,),
+                                                   dp.mask.dtype)])
+        perm = jnp.concatenate([dp.perm, jnp.zeros((add * dp.tile,),
+                                                   jnp.int32)])
+        inv = dp.inv
+        if inv is not None:
+            inv = jnp.concatenate(
+                [inv, jnp.zeros((add * dp.tile,), jnp.int32)])
+    else:
+        local, tb, tf, mask, perm, inv = (
+            dp.local, dp.tile_block, dp.tile_first, dp.mask, dp.perm,
+            dp.inv)
+    return dataclasses.replace(
+        dp, num_blocks=nb, local=local, tile_block=tb, tile_first=tf,
+        mask=mask, perm=perm, inv=inv)
+
+
+def make_sharded_dual_plans(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    world_size: int,
+    tile_cam: int = DEFAULT_TILE_CAM,
+    block_cam: int = DEFAULT_BLOCK_CAM,
+    tile_pt: int = DEFAULT_TILE_PT,
+    block_pt: int = DEFAULT_BLOCK_PT,
+    use_kernels: Optional[bool] = None,
+):
+    """Per-shard dual plans for the edge-sharded mesh path.
+
+    Edges are camera-sorted and split into `world_size` contiguous
+    chunks (the reference's contiguous partition, memory_pool.h:48-63);
+    each shard gets its own dual plans over its local edges — so every
+    reduction, expansion, and cross permute stays shard-local, and the
+    psums in builder/pcg combine the full-size per-shard outputs exactly
+    as in the fallback path.
+
+    Returns (perm [ws, slots_c], stacked DualPlans whose leaves carry a
+    leading shard axis, slots_c): shard k's edge arrays are
+    `arr[perm[k]] * mask[k]`.  Every per-shard plan covers ALL global
+    segments (so outputs align for the psum); both plan kinds are padded
+    to the max per-shard tile count with junk-block tiles.
+    """
+    cam_idx = np.asarray(cam_idx)
+    pt_idx = np.asarray(pt_idx)
+    n = cam_idx.shape[0]
+    order = np.argsort(cam_idx, kind="stable")
+    bounds = [(k * n) // world_size for k in range(world_size + 1)]
+
+    plans = []
+    for k in range(world_size):
+        sel = order[bounds[k]: bounds[k + 1]]
+        _, dp = make_dual_plans(
+            cam_idx[sel], pt_idx[sel], num_cameras, num_points,
+            tile_cam, block_cam, tile_pt, block_pt, use_kernels)
+        # Re-express perms in global edge ids.
+        sel32 = sel.astype(np.int64)
+        cam_perm = sel32[np.asarray(dp.cam.perm)]
+        plans.append((dp, cam_perm))
+
+    max_tc = max(int(dp.cam.tile_block.shape[0]) for dp, _ in plans)
+    max_tp = max(int(dp.pt.tile_block.shape[0]) for dp, _ in plans)
+    stacked_c, stacked_p, perms = [], [], []
+    for dp, cam_perm in plans:
+        slots_before = int(dp.cam.mask.shape[0])
+        c = _pad_device_plan(dp.cam, max_tc, junk_block=True)
+        p = _pad_device_plan(dp.pt, max_tp, junk_block=True)
+        pad_slots = int(c.mask.shape[0]) - slots_before
+        if pad_slots:
+            cam_perm = np.concatenate(
+                [cam_perm, np.zeros(pad_slots, np.int64)])
+        stacked_c.append(c)
+        stacked_p.append(p)
+        perms.append(cam_perm)
+
+    def stack(dps):
+        leaves = [jax.tree_util.tree_leaves(d) for d in dps]
+        stacked = [jnp.stack(vals) for vals in zip(*leaves)]
+        treedef = jax.tree_util.tree_structure(dps[0])
+        return jax.tree_util.tree_unflatten(treedef, stacked)
+
+    dual = DualPlans(
+        cam=stack(stacked_c), pt=stack(stacked_p),
+        use_kernels=plans[0][0].use_kernels)
+    masks = np.stack([np.asarray(c.mask) for c in stacked_c])
+    return np.stack(perms), masks, dual
+
+
+def squeeze_plans(plans: DualPlans) -> DualPlans:
+    """Drop the leading shard axis inside a shard_map body."""
+    return jax.tree_util.tree_map(lambda x: x[0], plans)

@@ -102,6 +102,7 @@ def distributed_lm_solve(
     pt_fixed: Optional[jax.Array] = None,
     verbose: bool = False,
     cam_sorted: bool = False,
+    plans=None,
     initial_region=None,
     initial_v=None,
     jit_cache: Optional[dict] = None,
@@ -143,6 +144,9 @@ def distributed_lm_solve(
         ("sqrt_info", sqrt_info, edge),
         ("cam_fixed", cam_fixed, rep),
         ("pt_fixed", pt_fixed, rep),
+        # Per-shard tiled plans: every leaf carries a leading shard axis
+        # split by the mesh (ops/segtiles.make_sharded_dual_plans).
+        ("plans", plans, P(EDGE_AXIS)),
     ]
     keys = tuple(k for k, v, _ in optional if v is not None)
     args += [v for _, v, _ in optional if v is not None]
@@ -184,12 +188,19 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
 
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
            verbose_token, *extras):
+        kwargs = dict(zip(keys, extras))
+        if "plans" in kwargs:
+            # Leaves arrive with a singleton shard axis; drop it so the
+            # body sees this shard's own plan.
+            from megba_tpu.ops.segtiles import squeeze_plans
+
+            kwargs["plans"] = squeeze_plans(kwargs["plans"])
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
             initial_region=init_region,
             initial_v=init_v, verbose_token=verbose_token,
-            **dict(zip(keys, extras)))
+            **kwargs)
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
     return jax.jit(sharded)

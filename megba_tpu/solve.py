@@ -121,11 +121,34 @@ def flat_solve(
     ws = option.world_size
     if use_tiled is None:
         use_tiled = (
-            dtype == np.float32 and ws == 1
+            dtype == np.float32
             and os.environ.get("MEGBA_TILED", "1") != "0")
 
     plans = None
-    if use_tiled:
+    if use_tiled and ws > 1:
+        # Sharded tiled lowering: contiguous per-shard edge chunks, each
+        # with its own dual plans; the concatenated per-shard slot
+        # streams form the edge axis (equal shard sizes by construction).
+        from megba_tpu.ops.segtiles import make_sharded_dual_plans
+
+        perms, masks, plans = make_sharded_dual_plans(
+            cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws)
+        obs = np.concatenate([
+            obs[perms[k]] * masks[k][:, None].astype(dtype)
+            for k in range(ws)])
+        cam_idx_sh = np.concatenate([
+            np.where(masks[k] > 0, cam_idx[perms[k]], 0)
+            for k in range(ws)]).astype(np.int32)
+        pt_idx_sh = np.concatenate([
+            np.where(masks[k] > 0, pt_idx[perms[k]], 0)
+            for k in range(ws)]).astype(np.int32)
+        if sqrt_info is not None:
+            sqrt_info = np.concatenate(
+                [np.asarray(sqrt_info)[perms[k]] for k in range(ws)])
+        cam_idx, pt_idx = cam_idx_sh, pt_idx_sh
+        mask = masks.reshape(-1).astype(dtype)
+        n_padded = obs.shape[0]
+    elif use_tiled:
         # Tiled lowering: the cam plan's slot order IS the edge axis from
         # here on (it subsumes the camera sort and quantum padding).
         from megba_tpu.ops.segtiles import make_dual_plans
@@ -181,7 +204,7 @@ def flat_solve(
             obs_fm, jnp.asarray(cam_idx), jnp.asarray(pt_idx),
             jnp.asarray(mask), option, mesh,
             sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
-            verbose=verbose, cam_sorted=True,
+            verbose=verbose, cam_sorted=True, plans=plans,
             initial_region=initial_region, initial_v=initial_v,
             jit_cache=jit_cache)
         return _result_to_edge_major(result)
