@@ -199,10 +199,11 @@ def main() -> None:
     tiled = dtype == np.float32 and os.environ.get("MEGBA_TILED", "1") != "0"
     plans = None
     if tiled:
-        from megba_tpu.ops.segtiles import make_dual_plans
+        from megba_tpu.ops.segtiles import make_dual_plans, probe_kernels
 
         plan_c, plans = make_dual_plans(
-            s.cam_idx, s.pt_idx, NUM_CAMERAS, NUM_POINTS)
+            s.cam_idx, s.pt_idx, NUM_CAMERAS, NUM_POINTS,
+            use_kernels=probe_kernels())
         perm, pmask = plan_c.perm, plan_c.mask
         obs_p = s.obs[perm] * pmask[:, None].astype(dtype)
         cam_idx_p = plan_c.seg

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import sys
 from typing import Optional, Tuple
 
 import jax
@@ -754,9 +755,7 @@ def make_dual_plans(
     ).astype(np.int32)
 
     if use_kernels is None:
-        import jax as _jax
-
-        use_kernels = _jax.default_backend() == "tpu"
+        use_kernels = probe_kernels()
     dp_c = device_plan(plan_c)
     dp_c = dataclasses.replace(dp_c, inv=jnp.asarray(inv_cam))
     dp_p = device_plan(plan_p)
@@ -878,3 +877,32 @@ def make_sharded_dual_plans(
 def squeeze_plans(plans: DualPlans) -> DualPlans:
     """Drop the leading shard axis inside a shard_map body."""
     return jax.tree_util.tree_map(lambda x: x[0], plans)
+
+
+@functools.lru_cache(maxsize=1)
+def probe_kernels() -> bool:
+    """True iff the Pallas kernels compile AND match on this backend.
+
+    Guards production entry points (bench, CLIs) against an unexpected
+    Mosaic lowering failure: degrade to the XLA fallback path instead of
+    dying.  Off-TPU returns False without compiling anything (interpret
+    mode is correct but far slower than the fallback).
+    """
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        idx = np.repeat(np.arange(4, dtype=np.int32), 64)
+        plan = build_tile_plan(idx, 4, tile=128, block=8)
+        dp = device_plan(plan)
+        data = jnp.ones((3, plan.n_slots), jnp.float32) * jnp.asarray(
+            plan.mask)
+        out = tile_reduce(data, dp)
+        ok = abs(float(out[0, 0]) - 64.0) < 1e-3
+        table = jnp.arange(4, dtype=jnp.float32)[None, :].repeat(3, 0)
+        ex = tile_expand(table, dp)
+        ok &= abs(float(ex[0, 70]) - float(plan.seg[70])) < 1e-3
+        return ok
+    except Exception as e:  # pragma: no cover - backend specific
+        print(f"segtiles kernel probe failed ({type(e).__name__}: {e}); "
+              "using XLA fallback path", file=sys.stderr, flush=True)
+        return False
