@@ -1,0 +1,35 @@
+#!/bin/bash
+# Detached TPU measurement pass: tests -> benches -> profile.
+# Launch with:  nohup bash scripts/run_tpu_round.sh > tpu_round.log 2>&1 &
+# NEVER kill any of these processes mid-run (single-client tunnel:
+# killing a claim holder wedges it for hours).  Everything is sized to
+# finish; progress is appended to tpu_round.log.
+set -u
+cd "$(dirname "$0")/.."
+echo "=== $(date -u) TPU round start ==="
+
+probe() {
+  python - <<'EOF'
+import jax
+print("devices:", jax.devices(), flush=True)
+EOF
+}
+
+echo "--- probe"
+if ! probe; then
+  echo "probe failed; aborting"; exit 1
+fi
+
+echo "--- tpu test lane"
+MEGBA_TPU_TESTS=1 python -m pytest tests/ -m tpu -p no:cacheprovider -q
+
+echo "--- benches"
+for cfg in trafalgar venice ladybug final final_mixed; do
+  echo "=== bench $cfg $(date -u) ==="
+  MEGBA_BENCH_CONFIG=$cfg python bench.py || echo "bench $cfg FAILED"
+done
+
+echo "--- profile venice"
+MEGBA_BENCH_CONFIG=venice python scripts/profile_phases.py || true
+
+echo "=== $(date -u) TPU round done ==="
