@@ -112,35 +112,28 @@ def build_tile_plan(
     n_tiles = int(tiles_per_block.sum())
     n_slots = n_tiles * tile
 
-    perm = np.zeros(n_slots, np.int32)
-    seg = np.zeros(n_slots, np.int32)
-    mask = np.zeros(n_slots, np.float32)
-    tile_block = np.zeros(n_tiles, np.int32)
-    tile_first = np.zeros(n_tiles, np.int32)
+    # Vectorised slot construction (no per-block Python loop): block b's
+    # slots start at tile * cumsum(tiles_per_block)[b-1]; sorted edge i
+    # lands at its block's slot base + its rank within the block.
+    slot_base = np.zeros(num_blocks, np.int64)
+    np.cumsum(tiles_per_block[:-1] * tile, out=slot_base[1:])
+    first_pos = np.cumsum(counts) - counts  # first sorted-edge per block
+    slot_of_edge = slot_base[blk_sorted] + (
+        np.arange(n_edges, dtype=np.int64) - first_pos[blk_sorted])
 
-    edge_pos = 0  # cursor into the sorted edge stream
-    slot = 0
-    t = 0
-    for b in range(num_blocks):
-        c = int(counts[b])
-        nt = int(tiles_per_block[b])
-        tile_block[t : t + nt] = b
-        tile_first[t] = 1
-        t += nt
-        if c:
-            sl = slice(slot, slot + c)
-            perm[sl] = order[edge_pos : edge_pos + c]
-            seg[sl] = seg_sorted[edge_pos : edge_pos + c]
-            mask[sl] = 1.0
-            edge_pos += c
-        pad = nt * tile - c
-        if pad:
-            sl = slice(slot + c, slot + nt * tile)
-            # Padding repeats a valid in-block segment (base of block)
-            # and, arbitrarily, source edge 0 — its data is masked out.
-            seg[sl] = b * block
-            perm[sl] = perm[slot] if c else 0
-        slot += nt * tile
+    tile_block = np.repeat(
+        np.arange(num_blocks, dtype=np.int32), tiles_per_block)
+    tile_first = np.ones(n_tiles, np.int32)
+    tile_first[1:] = tile_block[1:] != tile_block[:-1]
+
+    # Padding slots carry a valid in-block segment (block base) and,
+    # arbitrarily, source edge 0 — their data is masked out.
+    perm = np.zeros(n_slots, np.int32)
+    seg = np.repeat(tile_block.astype(np.int32) * block, tile)
+    mask = np.zeros(n_slots, np.float32)
+    perm[slot_of_edge] = order
+    seg[slot_of_edge] = seg_sorted
+    mask[slot_of_edge] = 1.0
     local = seg - np.repeat(tile_block, tile).astype(np.int64) * block
     return TilePlan(
         tile=tile,
