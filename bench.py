@@ -134,7 +134,12 @@ def main() -> None:
     import sys
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from megba_tpu.utils.backend import ensure_usable_backend
+    from megba_tpu.utils.backend import (
+        ensure_usable_backend,
+        install_graceful_term,
+    )
+
+    install_graceful_term()
 
     backend_note = ""
     if _C.force_cpu:

@@ -34,6 +34,27 @@ def accelerator_usable(timeout_s: float = 120.0) -> bool:
     return _probe_result
 
 
+def install_graceful_term() -> None:
+    """Convert SIGTERM into a clean SystemExit (atexit runs).
+
+    Python's default SIGTERM disposition kills the process without
+    cleanup; for a process holding the single-client accelerator tunnel
+    that orphans the claim server-side and wedges the tunnel for every
+    later process (observed twice in this sandbox — hours of outage).  A
+    clean exit lets the PJRT client teardown release the claim.  Install
+    in every chip-facing entry point BEFORE backend init.
+    """
+    import signal
+
+    def _term(signum, frame):
+        raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+
+
 def ensure_usable_backend(timeout_s: float = 120.0) -> bool:
     """Pin jax to CPU when accelerator init would hang.
 

@@ -43,6 +43,9 @@ def timeit(fn, *args, reps=10):
 
 
 def main():
+    from megba_tpu.utils.backend import install_graceful_term
+
+    install_graceful_term()
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
