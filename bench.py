@@ -196,12 +196,17 @@ def main() -> None:
     # Feature-major tiled lowering (ops/segtiles.py): the dual-plan slot
     # order replaces the camera sort + quantum padding, and every
     # segment reduction / expansion in the solver becomes a block-aligned
-    # MXU one-hot matmul (scatter-free).  f64 (ladybug) keeps the classic
-    # chunked scatter-add path.
+    # MXU one-hot matmul (scatter-free).  TPU + float32 only: on a CPU
+    # fallback the tiled plan's XLA lowering is slower AND fatter than
+    # the chunked scatter-add build, so benching it there measures the
+    # wrong engine (the r02 regression).  f64 (ladybug) always keeps the
+    # classic chunked path.
     from megba_tpu.core.fm import EDGE_QUANTUM
     from megba_tpu.core.types import is_cam_sorted, pad_edges
 
-    tiled = dtype == np.float32 and os.environ.get("MEGBA_TILED", "1") != "0"
+    from megba_tpu.solve import default_use_tiled
+
+    tiled = default_use_tiled(dtype)
     plans = None
     if tiled:
         from megba_tpu.ops.segtiles import make_dual_plans, probe_kernels
@@ -228,24 +233,34 @@ def main() -> None:
         jnp.asarray(mask),
     )
 
-    solve = jax.jit(
-        lambda cams, pts, obs, ci, pi, m, pl: lm_solve(
-            f, cams, pts, obs, ci, pi, m, option, cam_sorted=cam_sorted,
-            plans=pl)
-    )
-    args = args + (plans,)
+    def timed_solve(opt):
+        solve = jax.jit(
+            lambda cams, pts, obs, ci, pi, m, pl: lm_solve(
+                f, cams, pts, obs, ci, pi, m, opt, cam_sorted=cam_sorted,
+                plans=pl)
+        )
+        # Warmup (compile) — not timed.
+        res = solve(*args, plans)
+        jax.block_until_ready(res.cost)
+        t0 = time.perf_counter()
+        res = solve(*args, plans)
+        jax.block_until_ready(res.cost)
+        return res, time.perf_counter() - t0
 
-    # Warmup (compile) — not timed.
-    res = solve(*args)
-    jax.block_until_ready(res.cost)
+    res, elapsed = timed_solve(option)
     iters = int(res.iterations)
-
-    t0 = time.perf_counter()
-    res = solve(*args)
-    jax.block_until_ready(res.cost)
-    elapsed = time.perf_counter() - t0
-
     lm_iters_per_sec = iters / elapsed
+
+    # Convergence-mode pass: the reference's DEFAULT solver flags
+    # (common.h:27-33 — tol=1e-1, refuse_ratio=1.0), the regime
+    # BASELINE.md's cost-vs-time metric is defined in.  The throughput
+    # pass above (tol=1e-10) does near-fixed work per LM iteration; this
+    # one measures the time-to-quality observable.
+    import dataclasses as _dc
+
+    conv_option = _dc.replace(option, solver_option=SolverOption())
+    conv_res, conv_elapsed = timed_solve(conv_option)
+    conv_iters = int(conv_res.iterations)
     # Charge the reference model the PCG iterations this run actually
     # executed (the PCG can exit below the 30-iteration cap), so both
     # sides of vs_baseline do the same algorithmic work.
@@ -259,6 +274,16 @@ def main() -> None:
         implicit=_C.ref_implicit,
     )
     backend = jax.default_backend()
+    # A TPU-targeted config that ran on anything else is a FALLBACK: its
+    # number is not comparable to the accelerator baseline, so
+    # vs_baseline is withheld (null) and the fallback is flagged at top
+    # level — a driver reading this JSON cannot mistake a CPU number for
+    # a chip number.  ladybug is CPU by design (the reference's
+    # BAL_Double example is measured CPU-side too), so it keeps its
+    # ratio.
+    fallback = (not _C.force_cpu) and backend != "tpu"
+    vs_baseline = (
+        None if fallback else round(lm_iters_per_sec / baseline, 3))
     print(
         json.dumps(
             {
@@ -272,15 +297,32 @@ def main() -> None:
                 ),
                 "value": round(lm_iters_per_sec, 3),
                 "unit": "LM iters/s",
-                "vs_baseline": round(lm_iters_per_sec / baseline, 3),
+                "vs_baseline": vs_baseline,
+                "fallback": fallback,
                 "extra": {
                     "backend": backend,
+                    "tiled_engine": bool(tiled),
                     "lm_iter_ms": round(1000.0 * elapsed / iters, 3),
                     "pcg_iters_per_lm": round(measured_pcg_per_lm, 2),
                     "pcg_iters_per_sec": round(
                         lm_iters_per_sec * measured_pcg_per_lm, 1),
                     "derived_baseline_lm_iters_per_sec": round(baseline, 3),
                     "baseline_model": "A100-40GB roofline, BASELINE.md",
+                    # Reference-default flags (tol=1e-1, refuse_ratio=1):
+                    # the time-to-quality regime of BASELINE.md's metric.
+                    "convergence_mode": {
+                        "lm_iters_per_sec": round(
+                            conv_iters / conv_elapsed, 3),
+                        "lm_iters": conv_iters,
+                        "accepted": int(conv_res.accepted),
+                        "pcg_iters_per_lm": round(
+                            float(conv_res.pcg_iterations)
+                            / max(conv_iters, 1), 2),
+                        "cost_reduction": round(
+                            float(conv_res.initial_cost)
+                            / max(float(conv_res.cost), 1e-30), 3),
+                        "elapsed_s": round(conv_elapsed, 3),
+                    },
                 },
             }
         )

@@ -54,6 +54,13 @@ DEFAULT_TILE_PT = 1024
 DEFAULT_BLOCK_PT = 2048
 
 
+def _fit_tile(t: int, n: int) -> int:
+    """Shrink tile size t so it does not dwarf an n-edge problem."""
+    while t > 128 and t >= 4 * n:
+        t //= 2
+    return t
+
+
 @dataclasses.dataclass(frozen=True)
 class TilePlan:
     """Static reordering of one edge axis for block-aligned reduction.
@@ -126,10 +133,20 @@ def build_tile_plan(
     tile_first = np.ones(n_tiles, np.int32)
     tile_first[1:] = tile_block[1:] != tile_block[:-1]
 
-    # Padding slots carry a valid in-block segment (block base) and,
-    # arbitrarily, source edge 0 — their data is masked out.
+    # Padding slots carry their block's running-max real segment (block
+    # base for empty blocks) and, arbitrarily, source edge 0 — their data
+    # is masked out.  Running-max (not block base) keeps the whole slot
+    # seg stream non-decreasing: real ids sort ascending within a block,
+    # padding sits at their max, and the next block starts strictly
+    # higher — so every `indices_are_sorted=True` scatter over this
+    # stream (reduce_fallback, the Hessian build, the SCHUR_DIAG
+    # preconditioner) rests on a true promise.
+    blk_fill = np.arange(num_blocks, dtype=np.int64) * block
+    has = counts > 0
+    last = np.cumsum(counts) - 1
+    blk_fill[has] = seg_sorted[last[has]]
     perm = np.zeros(n_slots, np.int32)
-    seg = np.repeat(tile_block.astype(np.int32) * block, tile)
+    seg = np.repeat(blk_fill[tile_block], tile)
     mask = np.zeros(n_slots, np.float32)
     perm[slot_of_edge] = order
     seg[slot_of_edge] = seg_sorted
@@ -328,7 +345,7 @@ def tile_expand(
     """Gather segment rows to plan-ordered edges: [F, nS] -> [F, n_slots].
 
     Equivalent to `jnp.take(table, seg, axis=1)` (padding slots read
-    their block's base segment; mask before reducing).
+    their block's running-max real segment; mask before reducing).
     """
     return _tile_expand_call(
         table, plan.local, plan.tile_block,
@@ -345,6 +362,10 @@ def tile_expand(
 def reduce_fallback(data: jax.Array, plan: DevicePlan) -> jax.Array:
     out = jnp.zeros((data.shape[0], plan.num_segments), data.dtype)
     seg = plan.local + plan.tile_block.repeat(plan.tile) * plan.block
+    # The sorted promise is honest: build_tile_plan fills padding slots
+    # with each block's running-max real segment, so `seg` is globally
+    # non-decreasing (junk-block tiles appended by _pad_device_plan sit
+    # past num_segments and are dropped).
     return out.at[:, seg[0]].add(
         data, indices_are_sorted=True, mode="drop")
 
@@ -473,16 +494,61 @@ def jtj_grad_reduce(
             num_blocks=plan.num_blocks, interpret=interpret)
         out = out[:, : plan.num_segments].astype(J.dtype)
     else:
-        rows = jnp.concatenate([
+        out = _jtj_fallback_chunked(J, r, plan, d, od)
+    return out[: d * d], out[d * d:]
+
+
+def _jtj_fallback_chunked(J, r, plan: DevicePlan, d: int, od: int,
+                          chunk: int = 65_536) -> jax.Array:
+    """XLA fallback of the fused build, chunked over slots.
+
+    This is the degradation route when Mosaic rejects the kernels on a
+    real TPU (probe_kernels False) and the CPU test path — so its
+    transient memory must stay bounded: at Final scale the un-chunked
+    [d*d+d, n_slots] feature-row matrix is ~10 GB.  Slot chunks keep it
+    to [d*d+d, chunk] (~23 MB at the default), and slices of the
+    plan-sorted seg stream stay non-decreasing, so the scatter keeps its
+    sorted promise.
+    """
+    feat = d * d + d
+    seg = (plan.local
+           + plan.tile_block.repeat(plan.tile)[None, :] * plan.block)[0]
+    n = seg.shape[0]
+    out = jnp.zeros((feat, plan.num_segments), J.dtype)
+
+    def rows_of(Jc, rc):
+        return jnp.concatenate([
             jnp.stack([
-                sum(J[o * d + a] * J[o * d + b] for o in range(od))
+                sum(Jc[o * d + a] * Jc[o * d + b] for o in range(od))
                 for a in range(d) for b in range(d)]),
             jnp.stack([
-                -sum(J[o * d + a] * r[o] for o in range(od))
+                -sum(Jc[o * d + a] * rc[o] for o in range(od))
                 for a in range(d)]),
         ])
-        out = reduce_fallback(rows, plan)
-    return out[: d * d], out[d * d:]
+
+    if n <= chunk:
+        return out.at[:, seg].add(
+            rows_of(J, r), indices_are_sorted=True, mode="drop")
+
+    # Pad to a whole number of chunks with inert slots (zero data,
+    # out-of-range segment -> dropped by the scatter) so every fori_loop
+    # step slices a full static-size chunk — no clamped dynamic_slice
+    # overlap double-counting the tail.
+    pad = (-n) % chunk
+    if pad:
+        J = jnp.pad(J, ((0, 0), (0, pad)))
+        r = jnp.pad(r, ((0, 0), (0, pad)))
+        seg = jnp.pad(seg, (0, pad), constant_values=plan.num_segments)
+
+    def body(k, acc):
+        start = k * chunk
+        Jc = jax.lax.dynamic_slice_in_dim(J, start, chunk, axis=1)
+        rc = jax.lax.dynamic_slice_in_dim(r, start, chunk, axis=1)
+        sc = jax.lax.dynamic_slice_in_dim(seg, start, chunk)
+        return acc.at[:, sc].add(
+            rows_of(Jc, rc), indices_are_sorted=True, mode="drop")
+
+    return jax.lax.fori_loop(0, seg.shape[0] // chunk, body, out)
 
 
 # ---------------------------------------------------------------------------
@@ -705,6 +771,7 @@ def make_dual_plans(
     tile_pt: int = DEFAULT_TILE_PT,
     block_pt: int = DEFAULT_BLOCK_PT,
     use_kernels: Optional[bool] = None,
+    fit: bool = True,
 ) -> Tuple[TilePlan, DualPlans]:
     """Plan both orderings.  Returns (cam_host_plan, device DualPlans).
 
@@ -712,18 +779,20 @@ def make_dual_plans(
     order (`arr[:, cam_plan.perm] * cam_plan.mask`) — that order is the
     canonical edge axis from here on.  The pt plan is expressed in
     cam-slot space, so `pt.inv` indexes cam slots directly.
+
+    `fit=False` uses `tile_cam`/`tile_pt` verbatim — the sharded planner
+    fits them ONCE from the largest shard so every shard's plan leaves
+    share one tile shape and stack cleanly.
     """
     cam_idx = np.asarray(cam_idx)
     pt_idx = np.asarray(pt_idx)
-    # Keep tiles from dwarfing tiny problems (tests, toy datasets).
-    n = cam_idx.shape[0]
+    if fit:
+        # Keep tiles from dwarfing tiny problems (tests, toy datasets).
+        n = cam_idx.shape[0]
+        tile_cam = _fit_tile(tile_cam, n)
+        tile_pt = _fit_tile(tile_pt, n)
 
-    def _fit(t):
-        while t > 128 and t >= 4 * n:
-            t //= 2
-        return t
-
-    plan_c = build_tile_plan(cam_idx, num_cameras, _fit(tile_cam), block_cam)
+    plan_c = build_tile_plan(cam_idx, num_cameras, tile_cam, block_cam)
     # The pt plan is built over the CAM-SLOT edge stream: segment id of a
     # cam slot is its edge's point (padding slots get an out-of-range
     # marker sorted to the end and masked).
@@ -731,7 +800,7 @@ def make_dual_plans(
         plan_c.mask > 0, pt_idx[plan_c.perm], num_points)
     plan_p_raw = build_tile_plan(
         np.minimum(pt_of_slot, num_points - 1).astype(np.int64),
-        num_points, _fit(tile_pt), block_pt)
+        num_points, tile_pt, block_pt)
     # Mask out slots whose source cam slot was itself padding.
     src_mask = (plan_c.mask > 0)[plan_p_raw.perm]
     mask_p = plan_p_raw.mask * src_mask
@@ -816,24 +885,34 @@ def make_sharded_dual_plans(
     psums in builder/pcg combine the full-size per-shard outputs exactly
     as in the fallback path.
 
-    Returns (perm [ws, slots_c], stacked DualPlans whose leaves carry a
-    leading shard axis, slots_c): shard k's edge arrays are
-    `arr[perm[k]] * mask[k]`.  Every per-shard plan covers ALL global
+    Returns (perm [ws, slots_c], mask [ws, slots_c], cam_seg
+    [ws, slots_c], stacked DualPlans whose leaves carry a leading shard
+    axis): shard k's edge arrays are `arr[perm[k]] * mask[k]`, and
+    `cam_seg[k]` is the camera id per slot — non-decreasing within the
+    shard (padding carries each block's running-max camera; junk-block
+    slots are clipped to num_cameras-1), so it can be used directly as a
+    sorted `cam_idx` stream.  Every per-shard plan covers ALL global
     segments (so outputs align for the psum); both plan kinds are padded
-    to the max per-shard tile count with junk-block tiles.
+    to the max per-shard tile count with junk-block tiles, and tile
+    sizes are fitted ONCE from the largest shard so every shard's plan
+    leaves share one tile shape (stacking would fail otherwise).
     """
     cam_idx = np.asarray(cam_idx)
     pt_idx = np.asarray(pt_idx)
     n = cam_idx.shape[0]
     order = np.argsort(cam_idx, kind="stable")
     bounds = [(k * n) // world_size for k in range(world_size + 1)]
+    n_max = max(bounds[k + 1] - bounds[k] for k in range(world_size))
+    tile_cam = _fit_tile(tile_cam, n_max)
+    tile_pt = _fit_tile(tile_pt, n_max)
 
     plans = []
     for k in range(world_size):
         sel = order[bounds[k]: bounds[k + 1]]
         _, dp = make_dual_plans(
             cam_idx[sel], pt_idx[sel], num_cameras, num_points,
-            tile_cam, block_cam, tile_pt, block_pt, use_kernels)
+            tile_cam, block_cam, tile_pt, block_pt, use_kernels,
+            fit=False)
         # Re-express perms in global edge ids.
         sel32 = sel.astype(np.int64)
         cam_perm = sel32[np.asarray(dp.cam.perm)]
@@ -864,7 +943,14 @@ def make_sharded_dual_plans(
         cam=stack(stacked_c), pt=stack(stacked_p),
         use_kernels=plans[0][0].use_kernels)
     masks = np.stack([np.asarray(c.mask) for c in stacked_c])
-    return np.stack(perms), masks, dual
+    cam_segs = np.stack([
+        np.minimum(
+            np.asarray(c.local)[0]
+            + np.repeat(np.asarray(c.tile_block), c.tile) * c.block,
+            num_cameras - 1,
+        ).astype(np.int32)
+        for c in stacked_c])
+    return np.stack(perms), masks, cam_segs, dual
 
 
 def squeeze_plans(plans: DualPlans) -> DualPlans:
@@ -874,26 +960,73 @@ def squeeze_plans(plans: DualPlans) -> DualPlans:
 
 @functools.lru_cache(maxsize=1)
 def probe_kernels() -> bool:
-    """True iff the Pallas kernels compile AND match on this backend.
+    """True iff ALL five Pallas kernels compile AND match on this backend.
 
     Guards production entry points (bench, CLIs) against an unexpected
     Mosaic lowering failure: degrade to the XLA fallback path instead of
     dying.  Off-TPU returns False without compiling anything (interpret
     mode is correct but far slower than the fallback).
+
+    Probes every kernel the tiled solve ships — tile_reduce, tile_expand,
+    jtj_grad_reduce, coupling_expand, coupling_reduce — at BOTH
+    production plan geometries: the camera side (DEFAULT_TILE_CAM /
+    DEFAULT_BLOCK_CAM, d=9, od=2 — 18- and 90-row blocks) and the point
+    side (DEFAULT_TILE_PT / DEFAULT_BLOCK_PT, d=3 — 6- and 12-row
+    blocks).  None of these row counts are sublane multiples, and Mosaic
+    rejections are shape-dependent, so toy shapes would not certify the
+    shapes the solve actually compiles.  Each result is checked against
+    the XLA fallback so a kernel that compiles but miscomputes also
+    fails the probe.
     """
     if jax.default_backend() != "tpu":
         return False
     try:
-        idx = np.repeat(np.arange(4, dtype=np.int32), 64)
-        plan = build_tile_plan(idx, 4, tile=128, block=8)
-        dp = device_plan(plan)
-        data = jnp.ones((3, plan.n_slots), jnp.float32) * jnp.asarray(
-            plan.mask)
-        out = tile_reduce(data, dp)
-        ok = abs(float(out[0, 0]) - 64.0) < 1e-3
-        table = jnp.arange(4, dtype=jnp.float32)[None, :].repeat(3, 0)
-        ex = tile_expand(table, dp)
-        ok &= abs(float(ex[0, 70]) - float(plan.seg[70])) < 1e-3
+        rng = np.random.default_rng(0)
+
+        def close(a, b, tol=1e-3):
+            return bool(jnp.max(jnp.abs(a - b)) < tol)
+
+        ok = True
+        for tile, block, ns, d, od in (
+            (DEFAULT_TILE_CAM, DEFAULT_BLOCK_CAM, 200, 9, 2),
+            (DEFAULT_TILE_PT, DEFAULT_BLOCK_PT, 3000, 3, 2),
+        ):
+            n = 4 * tile  # several tiles; some blocks get >1 (accumulate)
+            idx = rng.integers(0, ns, n).astype(np.int32)
+            plan = build_tile_plan(idx, ns, tile=tile, block=block)
+            dp = device_plan(plan)
+            m = jnp.asarray(plan.mask)
+
+            data = jnp.asarray(rng.standard_normal(
+                (3, plan.n_slots)).astype(np.float32)) * m
+            ok &= close(tile_reduce(data, dp), reduce_fallback(data, dp))
+            table = jnp.asarray(
+                rng.standard_normal((3, ns)).astype(np.float32))
+            ok &= close(tile_expand(table, dp) * m,
+                        expand_fallback(table, dp) * m)
+
+            J = jnp.asarray(rng.standard_normal(
+                (od * d, plan.n_slots)).astype(np.float32)) * m
+            r = jnp.asarray(rng.standard_normal(
+                (od, plan.n_slots)).astype(np.float32)) * m
+            h_k, g_k = jtj_grad_reduce(J, r, dp, use_kernels=True)
+            h_f, g_f = jtj_grad_reduce(J, r, dp, use_kernels=False)
+            ok &= close(h_k, h_f) and close(g_k, g_f)
+
+            vt = jnp.asarray(
+                rng.standard_normal((d, ns)).astype(np.float32))
+            ok &= close(
+                coupling_expand(vt, J, dp, d, use_kernels=True) * m,
+                coupling_expand(vt, J, dp, d, use_kernels=False) * m)
+            u = jnp.asarray(rng.standard_normal(
+                (od, plan.n_slots)).astype(np.float32)) * m
+            ok &= close(
+                coupling_reduce(J, u, dp, d, use_kernels=True),
+                coupling_reduce(J, u, dp, d, use_kernels=False))
+        if not ok:  # pragma: no cover - backend specific
+            print("segtiles kernel probe: kernels compiled but mismatched "
+                  "the fallback; using XLA fallback path",
+                  file=sys.stderr, flush=True)
         return ok
     except Exception as e:  # pragma: no cover - backend specific
         print(f"segtiles kernel probe failed ({type(e).__name__}: {e}); "

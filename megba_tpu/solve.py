@@ -32,6 +32,23 @@ from megba_tpu.parallel.mesh import (
 )
 
 
+def default_use_tiled(dtype) -> bool:
+    """Whether the scatter-free tiled engine is the default lowering.
+
+    Float32 on TPU backends only: the tiled XLA fallback on CPU is
+    slower and fatter than the chunked scatter-add build, and float64
+    never rides the kernels.  MEGBA_TILED=1/0 force-enables/disables.
+    One definition shared by flat_solve and bench.py so the bench can
+    never measure a different engine than production selects.
+    """
+    if np.dtype(dtype) != np.float32:
+        return False
+    env = os.environ.get("MEGBA_TILED")
+    if env is not None:
+        return env != "0"
+    return jax.default_backend() == "tpu"
+
+
 def _build_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted):
     """Jitted single-device solve.  The trust-region resume state rides as
     dynamic operands so chunked/checkpointed solves reuse one compilation;
@@ -94,10 +111,11 @@ def flat_solve(
     passes its own dict).
 
     `use_tiled` selects the scatter-free tiled path (ops/segtiles):
-    default ON for float32 single-device solves (where it replaces every
-    per-edge scatter/gather with block-aligned MXU reductions), OFF
-    otherwise (float64 verification and the sharded mesh path keep the
-    chunked scatter-add build).  MEGBA_TILED=0 force-disables.
+    default ON for float32 solves on TPU backends (where it replaces
+    every per-edge scatter/gather with block-aligned MXU reductions),
+    OFF otherwise (float64 verification and CPU runs keep the chunked
+    scatter-add build, whose transient memory is bounded).
+    MEGBA_TILED=1/0 force-enables/disables.
     """
     dtype = np.dtype(option.dtype)
     if dtype == np.float64 and not jax.config.jax_enable_x64:
@@ -120,9 +138,7 @@ def flat_solve(
 
     ws = option.world_size
     if use_tiled is None:
-        use_tiled = (
-            dtype == np.float32
-            and os.environ.get("MEGBA_TILED", "1") != "0")
+        use_tiled = default_use_tiled(dtype)
 
     plans = None
     if use_tiled and ws > 1:
@@ -131,14 +147,15 @@ def flat_solve(
         # streams form the edge axis (equal shard sizes by construction).
         from megba_tpu.ops.segtiles import make_sharded_dual_plans
 
-        perms, masks, plans = make_sharded_dual_plans(
+        perms, masks, cam_segs, plans = make_sharded_dual_plans(
             cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws)
         obs = np.concatenate([
             obs[perms[k]] * masks[k][:, None].astype(dtype)
             for k in range(ws)])
-        cam_idx_sh = np.concatenate([
-            np.where(masks[k] > 0, cam_idx[perms[k]], 0)
-            for k in range(ws)]).astype(np.int32)
+        # cam_segs keeps each shard's cam stream non-decreasing (padding
+        # carries the block's running-max camera) so the sorted-scatter
+        # promise downstream stays honest; masked slots contribute zeros.
+        cam_idx_sh = cam_segs.reshape(-1).astype(np.int32)
         pt_idx_sh = np.concatenate([
             np.where(masks[k] > 0, pt_idx[perms[k]], 0)
             for k in range(ws)]).astype(np.int32)

@@ -16,21 +16,43 @@ from typing import Optional
 _probe_result: Optional[bool] = None
 
 
+# The probe child installs a SIGTERM -> SystemExit handler BEFORE
+# touching jax so that a timed-out probe exits through PJRT client
+# teardown and releases its tunnel claim (a SIGKILLed child mid-claim
+# orphans the claim server-side and wedges the tunnel — the exact
+# failure subprocess.run(timeout=...)'s kill() would cause here).
+_PROBE_SRC = (
+    "import signal\n"
+    "signal.signal(signal.SIGTERM, lambda s, f: (_ for _ in ()).throw("
+    "SystemExit(143)))\n"
+    "import jax\n"
+    "jax.devices()\n"
+)
+
+
 def accelerator_usable(timeout_s: float = 120.0) -> bool:
     """True when `import jax; jax.devices()` completes in a subprocess.
 
-    Cached per process (one probe covers every entry point).
+    Cached per process (one probe covers every entry point).  A probe
+    that exceeds the timeout is SIGTERMed (clean teardown in the child),
+    with SIGKILL only as a 30 s last resort.
     """
     global _probe_result
     if _probe_result is not None:
         return _probe_result
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        _probe_result = proc.returncode == 0
+        _probe_result = proc.wait(timeout=timeout_s) == 0
     except subprocess.TimeoutExpired:
         _probe_result = False
+        proc.terminate()
+        try:
+            proc.wait(timeout=30.0)
+        except subprocess.TimeoutExpired:  # stuck in C code; no choice
+            proc.kill()
+            proc.wait()
     return _probe_result
 
 

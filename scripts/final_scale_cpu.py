@@ -1,0 +1,99 @@
+"""Final-13682 scale END-TO-END capability run on the CPU backend.
+
+The reference's implicit mode exists for BAL Final problem-13682-4456117
+(~29M observations, README.md:19); SCALING.md's Final row was
+extrapolated from a half-scale dry run.  This script executes the full
+pipeline — synthesis, lowering, implicit tiled-or-chunked build, damped
+Schur-PCG, LM accept/reject — at the REAL edge count and records
+measured wall times + peak RSS to FINAL_CPU.json.  It is a capability
+proof (clearly labelled cpu), not a perf number; the perf half runs on
+the chip via run_tpu_round.sh (bench config `final`).
+
+Usage: python scripts/final_scale_cpu.py   (CPU only; ~15-30 min on one core)
+"""
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp  # noqa: F401
+
+    from megba_tpu.common import (
+        AlgoOption,
+        ComputeKind,
+        JacobianMode,
+        ProblemOption,
+        SolverOption,
+    )
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    nc, npts, opp = 13_682, 4_456_117, 28_987_644 / 4_456_117
+    t0 = time.perf_counter()
+    s = make_synthetic_bal(
+        num_cameras=nc, num_points=npts, obs_per_point=opp, seed=0,
+        param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+    t_synth = time.perf_counter() - t0
+    nE = int(s.obs.shape[0])
+    print(f"synth: {nc} cams / {npts} pts / {nE} edges in {t_synth:.1f}s "
+          f"(rss {rss_gb():.1f} GB)", flush=True)
+
+    option = ProblemOption(
+        dtype=np.float32,
+        compute_kind=ComputeKind.IMPLICIT,
+        jacobian_mode=JacobianMode.ANALYTICAL,
+        algo_option=AlgoOption(max_iter=2, epsilon1=1e-12, epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=8, tol=1e-10, refuse_ratio=1e30),
+    )
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    t0 = time.perf_counter()
+    res = flat_solve(
+        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option)
+    jax.block_until_ready(res.cost)
+    t_solve = time.perf_counter() - t0
+    iters = int(res.iterations)
+    out = dict(
+        backend=jax.default_backend(),
+        capability_proof=True,
+        cameras=nc, points=npts, edges=nE,
+        synth_s=round(t_synth, 1),
+        solve_s=round(t_solve, 1),
+        lm_iters=iters,
+        pcg_iters=int(res.pcg_iterations),
+        s_per_lm_iter=round(t_solve / max(iters, 1), 2),
+        initial_cost=float(res.initial_cost),
+        cost=float(res.cost),
+        accepted=int(res.accepted),
+        peak_rss_gb=round(rss_gb(), 2),
+        note=("end-to-end Final-13682 scale on the CPU backend "
+              "(includes compile in solve_s; 1 host core). Capability "
+              "evidence only — chip perf comes from bench config "
+              "'final' via run_tpu_round.sh."),
+    )
+    print(json.dumps(out), flush=True)
+    assert np.isfinite(out["cost"]) and out["cost"] < out["initial_cost"]
+    with open("FINAL_CPU.json", "w") as fh:
+        json.dump(out, fh, indent=1)
+    print("wrote FINAL_CPU.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

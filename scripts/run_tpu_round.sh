@@ -1,12 +1,29 @@
 #!/bin/bash
-# Detached TPU measurement pass: tests -> benches -> profile.
+# Detached TPU measurement pass: tests -> benches -> profile -> sweep.
 # Launch with:  nohup bash scripts/run_tpu_round.sh > tpu_round.log 2>&1 &
 # NEVER kill any of these processes mid-run (single-client tunnel:
 # killing a claim holder wedges it for hours).  Everything is sized to
 # finish; progress is appended to tpu_round.log.
-set -u
+#
+# Every artifact is git-committed THE MOMENT it lands (the tunnel wedge
+# has twice eaten end-of-round results): per-config bench JSON, the tpu
+# test-lane log, PROFILE_RAW.json, SWEEP_RAW.json, and tpu_round.log
+# itself.
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 echo "=== $(date -u) TPU round start ==="
+
+commit_now() {
+  # Best-effort immediate evidence commit; never let a git hiccup (e.g.
+  # a concurrent commit holding the index lock) or a missing artifact
+  # (a failed producer) stop the measurements or drop the log commit.
+  local present=(tpu_round.log)
+  local f
+  for f in "$@"; do [ -e "$f" ] && present+=("$f"); done
+  git add -A -- "${present[@]}" 2>/dev/null || true
+  git commit -m "$COMMIT_MSG" --only -- "${present[@]}" \
+    >/dev/null 2>&1 || true
+}
 
 probe() {
   python - <<'EOF'
@@ -21,15 +38,29 @@ if ! probe; then
 fi
 
 echo "--- tpu test lane"
-MEGBA_TPU_TESTS=1 python -m pytest tests/ -m tpu -p no:cacheprovider -q
+MEGBA_TPU_TESTS=1 python -m pytest tests/ -m tpu -p no:cacheprovider -q \
+  2>&1 | tee tpu_test_lane.log
+COMMIT_MSG="Record TPU test-lane run" commit_now tpu_test_lane.log
 
 echo "--- benches"
 for cfg in trafalgar venice ladybug final final_mixed; do
   echo "=== bench $cfg $(date -u) ==="
-  MEGBA_BENCH_CONFIG=$cfg python bench.py || echo "bench $cfg FAILED"
+  if MEGBA_BENCH_CONFIG=$cfg python bench.py | tee "BENCH_tpu_${cfg}.json"
+  then
+    COMMIT_MSG="Record hardware bench result: ${cfg}" \
+      commit_now "BENCH_tpu_${cfg}.json"
+  else
+    echo "bench $cfg FAILED"
+  fi
 done
 
 echo "--- profile venice"
 MEGBA_BENCH_CONFIG=venice python scripts/profile_phases.py || true
+COMMIT_MSG="Record hardware phase profile (venice)" commit_now PROFILE_RAW.json
+
+echo "--- tile/block sweep venice (measured)"
+MEGBA_BENCH_CONFIG=venice python scripts/sweep_tiles.py || true
+COMMIT_MSG="Record hardware tile/block sweep (venice)" commit_now SWEEP_RAW.json
 
 echo "=== $(date -u) TPU round done ==="
+COMMIT_MSG="Record TPU round log" commit_now tpu_round.log

@@ -1,0 +1,176 @@
+"""Tile/block constant sweep for the segtiles engine (weak-spot: the
+DEFAULT_TILE_*/BLOCK_* constants were VMEM back-of-envelope guesses).
+
+For each candidate (tile_cam, block_cam, tile_pt, block_pt) this builds
+the dual plans host-side and reports the analytic cost model everywhere:
+
+  - padding overhead (slots / real edges) per plan,
+  - one-hot matmul FLOPs per Hessian build and per PCG coupling product
+    (the [B, T] one-hot contraction is pure overhead the MXU eats — the
+    question the sweep answers is when it stops being free),
+  - per-kernel VMEM footprint (all operand + output blocks must fit).
+
+On a TPU backend it ALSO times the three hot kernels per candidate
+(jtj_grad_reduce, coupling_expand, coupling_reduce) and ranks by
+measured per-LM-iteration kernel time; off-TPU the ranking is by the
+analytic model only (clearly labelled).  Writes SWEEP_RAW.json.
+
+Usage: MEGBA_BENCH_CONFIG=venice [MEGBA_BENCH_SCALE=x] python scripts/sweep_tiles.py
+Never kill this mid-run on the TPU (single-client tunnel).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONFIG = os.environ.get("MEGBA_BENCH_CONFIG", "venice")
+SCALE = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
+
+# Candidate grids.  block_cam stays modest (camera axis is short); the
+# point axis trades padding (small block -> more all-padding tiles when
+# points/block are sparse) against one-hot width (big block -> wider
+# [B, T] contraction per tile).
+TILES_CAM = [1024, 2048, 4096]
+BLOCKS_CAM = [128, 256]
+TILES_PT = [512, 1024, 2048]
+BLOCKS_PT = [1024, 2048, 4096]
+
+CD, PD, OD = 9, 3, 2
+
+
+def analytic(plan_c, plan_p):
+    """Per-LM-iteration one-hot FLOPs + padding + VMEM for one candidate."""
+    sc, sp = plan_c.n_slots, plan_p.n_slots
+    bc, bp = plan_c.block, plan_p.block
+    # One-hot contraction FLOPs: every slot row is matmul'd against its
+    # tile's [B, T] one-hot.  Build touches (cd*cd+cd) cam rows and
+    # (pd*pd+pd) pt rows; each PCG iteration runs one expand (d rows) +
+    # one reduce (d rows) on each side.
+    build = 2 * (CD * CD + CD) * bc * sc + 2 * (PD * PD + PD) * bp * sp
+    per_pcg = 2 * (CD * bc * sc + PD * bp * sp) * 2  # expand+reduce, 2 sides
+    pad_c = sc / max(plan_c.n_edges, 1)
+    pad_p = sp / max(plan_p.n_edges, 1)
+    # VMEM per grid step (f32 words): the biggest kernel is the jtj
+    # build — J block [od*cd, T], onehot [B, T], feature rows
+    # [cd*cd+cd, T], output [cd*cd+cd, B].
+    feat = CD * CD + CD
+    vmem_words = (OD * CD + bc + feat) * plan_c.tile + feat * bc
+    return dict(
+        onehot_build_flops=build,
+        onehot_per_pcg_flops=per_pcg,
+        padding_cam=round(pad_c, 4),
+        padding_pt=round(pad_p, 4),
+        vmem_mb=round(vmem_words * 4 / 2**20, 2),
+    )
+
+
+def main():
+    from megba_tpu.utils.backend import install_graceful_term
+
+    install_graceful_term()
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench as B  # noqa: E402
+
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.segtiles import (
+        build_tile_plan,
+        coupling_expand,
+        coupling_reduce,
+        device_plan,
+        jtj_grad_reduce,
+        probe_kernels,
+    )
+
+    cfg = B.CONFIGS[CONFIG]
+    nc = max(8, int(cfg.cameras * SCALE))
+    npts = max(64, int(cfg.points * SCALE))
+    s = make_synthetic_bal(
+        num_cameras=nc, num_points=npts, obs_per_point=cfg.obs_per_point,
+        seed=0, param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+    nE = s.obs.shape[0]
+    on_tpu = jax.default_backend() == "tpu" and probe_kernels()
+    print(f"backend={jax.default_backend()} kernels={'ON' if on_tpu else 'off'} "
+          f"config={CONFIG} {nc} cams / {npts} pts / {nE} edges", flush=True)
+
+    rng = np.random.default_rng(0)
+
+    def timed(fn, *args, reps=5):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    rows = []
+    for tc, bc, tp, bp in itertools.product(
+            TILES_CAM, BLOCKS_CAM, TILES_PT, BLOCKS_PT):
+        t0 = time.perf_counter()
+        plan_c = build_tile_plan(s.cam_idx, nc, tc, bc)
+        pt_of_slot = np.where(
+            plan_c.mask > 0, s.pt_idx[plan_c.perm], npts - 1)
+        plan_p = build_tile_plan(pt_of_slot.astype(np.int64), npts, tp, bp)
+        plan_s = time.perf_counter() - t0
+        row = dict(tile_cam=tc, block_cam=bc, tile_pt=tp, block_pt=bp,
+                   n_slots_cam=plan_c.n_slots, n_slots_pt=plan_p.n_slots,
+                   plan_build_s=round(plan_s, 3), **analytic(plan_c, plan_p))
+        if on_tpu:
+            dpc, dpp = device_plan(plan_c), device_plan(plan_p)
+            mc = jnp.asarray(plan_c.mask)
+            Jc = jnp.asarray(rng.standard_normal(
+                (OD * CD, plan_c.n_slots)).astype(np.float32)) * mc
+            rr = jnp.asarray(rng.standard_normal(
+                (OD, plan_c.n_slots)).astype(np.float32)) * mc
+            mp = jnp.asarray(plan_p.mask)
+            Jp = jnp.asarray(rng.standard_normal(
+                (OD * PD, plan_p.n_slots)).astype(np.float32)) * mp
+            vt = jnp.asarray(rng.standard_normal(
+                (CD, nc)).astype(np.float32))
+            vtp = jnp.asarray(rng.standard_normal(
+                (PD, npts)).astype(np.float32))
+            u = jnp.asarray(rng.standard_normal(
+                (OD, plan_p.n_slots)).astype(np.float32)) * mp
+            t_build = timed(lambda: jtj_grad_reduce(
+                Jc, rr, dpc, use_kernels=True))
+            t_exp = timed(lambda: coupling_expand(
+                vtp, Jp, dpp, PD, use_kernels=True))
+            t_red = timed(lambda: coupling_reduce(
+                Jp, u, dpp, PD, use_kernels=True))
+            row.update(
+                jtj_ms=round(t_build * 1e3, 3),
+                coupling_expand_ms=round(t_exp * 1e3, 3),
+                coupling_reduce_ms=round(t_red * 1e3, 3),
+                per_pcg_ms=round((t_exp + t_red) * 1e3, 3),
+            )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    key = (lambda r: r["jtj_ms"] + 30 * r["per_pcg_ms"]) if on_tpu else (
+        lambda r: r["onehot_build_flops"] + 30 * r["onehot_per_pcg_flops"])
+    best = min(rows, key=key)
+    ranking = "measured (jtj + 30 PCG iters)" if on_tpu else (
+        "ANALYTIC ONLY (no TPU): one-hot FLOPs, jtj + 30 PCG iters")
+    print(f"\nbest by {ranking}:\n{json.dumps(best)}", flush=True)
+    with open("SWEEP_RAW.json", "w") as fh:
+        json.dump(dict(config=CONFIG, scale=SCALE,
+                       backend=jax.default_backend(), measured=bool(on_tpu),
+                       ranking=ranking, rows=rows, best=best), fh, indent=1)
+    print("wrote SWEEP_RAW.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+#!/bin/bash
+# TPU tunnel watchdog: probe periodically; the moment the backend comes
+# up, hand off to the full measurement pass (scripts/run_tpu_round.sh).
+# Launch detached:  nohup bash scripts/tpu_watchdog.sh >> tpu_probe.log 2>&1 &
+#
+# Every probe attempt (success or timeout) is appended to tpu_probe.log
+# with a UTC timestamp so a wedged-all-round tunnel leaves committed
+# evidence (VERDICT r02 item 7).  The probe runs in a subprocess with a
+# generous timeout: backend acquisition through the single-client tunnel
+# can take minutes when healthy, and a hung probe must not block the
+# loop forever.
+set -u
+cd "$(dirname "$0")/.."
+
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-300}"
+SLEEP_BETWEEN="${SLEEP_BETWEEN:-900}"
+MAX_HOURS="${MAX_HOURS:-11}"
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+attempt=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  attempt=$((attempt + 1))
+  echo "=== probe attempt $attempt $(date -u +%Y-%m-%dT%H:%M:%SZ) (timeout ${PROBE_TIMEOUT}s) ==="
+  # The probe installs a SIGTERM handler BEFORE touching jax so the
+  # `timeout` TERM produces a clean PJRT teardown (releases any partial
+  # tunnel claim); -k 30 SIGKILLs only if the child is stuck in C code.
+  if timeout -k 30 "$PROBE_TIMEOUT" python -c "
+import signal
+signal.signal(signal.SIGTERM, lambda s, f: (_ for _ in ()).throw(SystemExit(143)))
+import jax
+print('devices:', jax.devices(), flush=True)
+"; then
+    echo "=== tunnel ALIVE at $(date -u +%Y-%m-%dT%H:%M:%SZ); launching TPU round ==="
+    bash scripts/run_tpu_round.sh >> tpu_round.log 2>&1
+    echo "=== TPU round finished at $(date -u +%Y-%m-%dT%H:%M:%SZ) (see tpu_round.log) ==="
+    exit 0
+  else
+    echo "--- probe failed/timed out (rc=$?) at $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  fi
+  sleep "$SLEEP_BETWEEN"
+done
+echo "=== watchdog deadline reached $(date -u +%Y-%m-%dT%H:%M:%SZ); tunnel never came up ==="

@@ -1,0 +1,65 @@
+"""Worker for the two-process multi-host smoke test (test_multihost.py).
+
+Run as `python tests/_multihost_worker.py <process_id> <port>`.  Each of
+the two processes wires jax.distributed over localhost CPU, checks the
+idempotency/error contract of `initialize_multihost`, and runs one
+cross-process psum over the global 2-device mesh — the same collective
+the sharded solve rides (SURVEY.md §2.3's communication backend, here
+spanning processes instead of one process's devices).
+"""
+
+import sys
+
+import numpy as np
+
+import jax
+
+# The axon TPU plugin's sitecustomize forces jax_platforms to
+# "axon,cpu"; pin CPU before any backend init (same move as
+# tests/conftest.py) so this worker never touches the tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+from megba_tpu.parallel.multihost import initialize_multihost  # noqa: E402
+
+
+def main() -> None:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    addr = f"localhost:{port}"
+    info = initialize_multihost(addr, 2, pid)
+    assert info["process_count"] == 2, info
+    assert info["process_index"] == pid, info
+    assert info["global_devices"] >= 2, info
+
+    # Exact-repeat call is idempotent...
+    info2 = initialize_multihost(addr, 2, pid)
+    assert info2 == info, (info, info2)
+    # ...but different explicit parameters must fail loudly (silently
+    # ignoring them would leave hosts solo-solving).
+    try:
+        initialize_multihost(addr, 3, pid)
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("expected RuntimeError on mismatched params")
+
+    # One cross-process collective over the global mesh: each process
+    # contributes its rank+1; the psum must see both.
+    import jax.numpy as jnp  # noqa: F401
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = np.asarray(jax.devices()[:2])
+    mesh = Mesh(devs, ("edge",))
+    sharding = NamedSharding(mesh, P("edge"))
+    local = np.full((1,), pid + 1, np.float32)
+    x = jax.make_array_from_process_local_data(sharding, local, (2,))
+    f = jax.jit(shard_map(
+        lambda v: jax.lax.psum(v, "edge"), mesh=mesh,
+        in_specs=P("edge"), out_specs=P()))
+    out = f(x)
+    assert float(np.asarray(out)[0]) == 3.0, np.asarray(out)
+    print(f"worker {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

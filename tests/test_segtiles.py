@@ -46,6 +46,9 @@ def _check_plan_invariants(plan: TilePlan, idx: np.ndarray, num_segments):
     assert np.array_equal(plan.tile_first, first)
     # local in range
     assert plan.local.min() >= 0 and plan.local.max() < plan.block
+    # Padding fill (running-max per block) keeps the whole slot stream
+    # non-decreasing: the `indices_are_sorted=True` scatter promise.
+    assert np.all(np.diff(plan.seg.astype(np.int64)) >= 0)
 
 
 @pytest.mark.parametrize("seed,n,ns,tile,block", [
