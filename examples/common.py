@@ -35,17 +35,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def run_example(dtype, jacobian_mode, compute_kind, argv=None) -> float:
-    import os
+    import jax  # noqa: F401  (platform must be set before device queries)
 
-    import jax
+    from megba_tpu.utils.backend import respect_jax_platforms
 
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms and "axon" not in env_platforms:
-        # Plugin sitecustomize modules may override jax_platforms at
-        # interpreter startup; the user's explicit env choice (e.g.
-        # JAX_PLATFORMS=cpu) must win or a CPU run can hang on a busy
-        # single-client accelerator tunnel.
-        jax.config.update("jax_platforms", env_platforms)
+    respect_jax_platforms()
 
     if np.dtype(dtype) == np.float64:
         jax.config.update("jax_enable_x64", True)

@@ -77,13 +77,36 @@ def install_graceful_term() -> None:
         pass
 
 
+def respect_jax_platforms() -> Optional[str]:
+    """Re-assert the caller's JAX_PLATFORMS choice over the axon plugin.
+
+    The axon plugin's register() (sitecustomize) overrides jax_platforms
+    to "axon,cpu" at interpreter startup, so the env var alone does not
+    keep a process off the TPU tunnel.  Call before any device query in
+    every entry point that honours JAX_PLATFORMS (bench, profilers,
+    CLIs).  Returns the env value when one was applied, else None.
+    """
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        import jax
+
+        jax.config.update("jax_platforms", plats)
+    return plats or None
+
+
 def ensure_usable_backend(timeout_s: float = 120.0) -> bool:
     """Pin jax to CPU when accelerator init would hang.
 
     Returns True when the fallback was applied.  Honours
-    MEGBA_BENCH_SKIP_PROBE=1 (no probe, trust the environment).  Must be
+    MEGBA_BENCH_SKIP_PROBE=1 (no probe, trust the environment), and
+    skips the probe entirely when the caller pinned a non-axon platform
+    via JAX_PLATFORMS — probing would claim the single-client TPU
+    tunnel from a process that has no intention of using it.  Must be
     called before the first jax device query of the process.
     """
+    plats = respect_jax_platforms()
+    if plats and "axon" not in plats:
+        return False
     if os.environ.get("MEGBA_BENCH_SKIP_PROBE") == "1":
         return False
     if accelerator_usable(timeout_s):

@@ -77,8 +77,9 @@ def main():
     install_graceful_term()
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from megba_tpu.utils.backend import respect_jax_platforms
+
+    respect_jax_platforms()
     import jax.numpy as jnp
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

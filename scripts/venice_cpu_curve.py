@@ -1,0 +1,128 @@
+"""Venice-scale cost-vs-time curve on the CPU backend (ours only).
+
+scipy cannot run Venice scale (5M observations, 3M parameters — see
+ANCHOR.json's ladybug-shape anchor for the external comparison); this
+records OUR solver's time-to-quality curve at the headline problem
+shape so the judged metric (BASELINE.md: cost-vs-time at identical
+flags) has a committed raw artifact even while the TPU tunnel is down.
+1-iteration chunks through the shared flat_solve pipeline (one compiled
+program; trust-region state rides as dynamic operands); compile is
+excluded via a warmup chunk.
+
+Usage: python scripts/venice_cpu_curve.py   (CPU; ~5-10 min on one core)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LM_ITERS = 15
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from megba_tpu.common import (
+        AlgoOption,
+        ComputeKind,
+        JacobianMode,
+        ProblemOption,
+        SolverOption,
+    )
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    nc, npts, opp = 1778, 993_923, 5_001_946 / 993_923  # venice shape
+    s = make_synthetic_bal(
+        num_cameras=nc, num_points=npts, obs_per_point=opp, seed=0,
+        param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+    nE = int(s.obs.shape[0])
+    print(f"venice curve: {nc} cams / {npts} pts / {nE} edges (f32, cpu)",
+          flush=True)
+
+    option = ProblemOption(
+        dtype=np.float32,
+        compute_kind=ComputeKind.IMPLICIT,
+        jacobian_mode=JacobianMode.ANALYTICAL,
+        algo_option=AlgoOption(max_iter=1, epsilon1=1e-12, epsilon2=1e-15),
+        solver_option=SolverOption(),  # reference defaults: tol=1e-1
+    )
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    # Lower ONCE (sort/pad/transpose/upload), then drive the compiled
+    # program directly — per-iteration timings must not include host
+    # lowering of the ~0.5 GB edge arrays the curve would otherwise
+    # redo every chunk.
+    import jax.numpy as jnp
+
+    from megba_tpu.algo.lm import lm_solve
+    from megba_tpu.core.fm import EDGE_QUANTUM
+    from megba_tpu.core.types import is_cam_sorted, pad_edges
+
+    obs, cam_idx, pt_idx = s.obs, s.cam_idx, s.pt_idx
+    if not is_cam_sorted(cam_idx):
+        from megba_tpu.native import sort_edges_by_camera
+
+        perm = sort_edges_by_camera(cam_idx, nc)
+        cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+    obs, cam_idx, pt_idx, mask = pad_edges(
+        obs, cam_idx, pt_idx, EDGE_QUANTUM, dtype=np.float32)
+    args = (
+        jnp.asarray(np.ascontiguousarray(obs.T)),
+        jnp.asarray(cam_idx), jnp.asarray(pt_idx),
+        jnp.asarray(mask.astype(np.float32)),
+    )
+    solve = jax.jit(
+        lambda cams, pts, region, v: lm_solve(
+            f, cams, pts, *args, option, cam_sorted=True,
+            initial_region=region, initial_v=v))
+
+    cams = jnp.asarray(np.ascontiguousarray(s.cameras0.T))
+    pts = jnp.asarray(np.ascontiguousarray(s.points0.T))
+    region = jnp.asarray(option.algo_option.initial_region, jnp.float32)
+    v = jnp.asarray(2.0, jnp.float32)
+    jax.block_until_ready(solve(cams, pts, region, v).cost)  # compile
+
+    curve = []
+    t_total = 0.0
+    initial_cost = None
+    for it in range(1, LM_ITERS + 1):
+        t0 = time.perf_counter()
+        res = solve(cams, pts, region, v)
+        jax.block_until_ready(res.cost)
+        t_total += time.perf_counter() - t0
+        cams, pts = res.cameras, res.points
+        region, v = res.region, res.v
+        if initial_cost is None:
+            initial_cost = float(res.initial_cost)
+        curve.append(dict(iter=it, t_s=round(t_total, 3),
+                          cost=float(res.cost),
+                          pcg_iters=int(res.pcg_iterations)))
+        print(json.dumps(curve[-1]), flush=True)
+        if bool(res.stopped):
+            break
+
+    out = dict(
+        problem=dict(cameras=nc, points=npts, edges=nE, dtype="float32",
+                     backend="cpu", shape="venice problem-1778-993923"),
+        flags="reference defaults (tol=1e-1, refuse_ratio=1.0)",
+        initial_cost=initial_cost,
+        curve=curve,
+        note="CPU backend, 1 host core — time-to-quality shape, not a "
+             "hardware perf claim.",
+    )
+    with open("VENICE_CPU_CURVE.json", "w") as fh:
+        json.dump(out, fh, indent=1)
+    print("wrote VENICE_CPU_CURVE.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()
