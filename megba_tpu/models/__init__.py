@@ -10,10 +10,17 @@ the rotation2D geometry op (reference src/geo/rotation2D.cu; its SE2
 vertex, include/vertex/SE2_vertex.h, is dead code — this family is the
 live equivalent).
 
+`pgo` — SE(3) pose-graph optimization (between-factors connecting two
+vertices of the SAME kind): a family the reference cannot express at
+all (its BaseEdge hard-wires one camera + one landmark per edge), built
+from the same feature-major / segment-reduction / PCG primitives with a
+matrix-free Gauss-Newton operator.
+
 Every model is just a residual function (+ optional closed-form
-Jacobian); the whole solver stack is dimension-generic.
+Jacobian); the BA solver stack is dimension-generic, and the PGO family
+shows the primitives compose into a different normal-equation topology.
 """
 
-from megba_tpu.models import bal, planar
+from megba_tpu.models import bal, pgo, planar
 
-__all__ = ["bal", "planar"]
+__all__ = ["bal", "pgo", "planar"]

@@ -167,6 +167,42 @@ def normalize(v: jnp.ndarray) -> jnp.ndarray:
     return v / jnp.sqrt(jnp.maximum(jnp.dot(v, v), 1e-30))
 
 
+def quaternion_to_angle_axis(q: jnp.ndarray) -> jnp.ndarray:
+    """(4,) unit quaternion (w, x, y, z) -> (3,) angle-axis (SO(3) log).
+
+    Small-angle-safe AND autodiff-safe: the scale 2*atan2(n, |w|)/n is
+    evaluated through the double-where trick so its gradient stays
+    finite at n -> 0 (where the true limit is 2/w), and the sign of w
+    is folded in so the returned angle is always in [0, pi].
+    """
+    w, vec = q[0], q[1:]
+    vec = jnp.where(w < 0, -vec, vec)
+    w = jnp.abs(w)
+    n2 = jnp.dot(vec, vec)
+    small = n2 < 1e-14
+    n2_safe = jnp.where(small, 1.0, n2)  # keeps sqrt/atan2 grads finite
+    n = jnp.sqrt(n2_safe)
+    # Taylor of 2*atan2(n, w)/n around n=0: 2/w - 2 n^2 / (3 w^3).
+    scale = jnp.where(
+        small,
+        2.0 / jnp.maximum(w, 1e-30) - 2.0 * n2 / (3.0 * jnp.maximum(w, 1e-30) ** 3),
+        2.0 * jnp.arctan2(n, w) / n,
+    )
+    return scale * vec
+
+
+def rotation_matrix_to_angle_axis(R: jnp.ndarray) -> jnp.ndarray:
+    """(3,3) rotation matrix -> (3,) angle-axis: the SO(3) log map.
+
+    Composed via the branch-free quaternion extraction, so it is safe
+    under vmap/jit and differentiable away from the pi-rotation cut
+    locus.  Inverse of `angle_axis_to_rotation_matrix` (round-trip
+    tested).  The reference has no log map at all — its geo library
+    (geo.cuh) only exposes the exponential direction.
+    """
+    return quaternion_to_angle_axis(rotation_matrix_to_quaternion(R))
+
+
 def drotated_dangle_axis(angle_axis: jnp.ndarray, pt: jnp.ndarray) -> jnp.ndarray:
     """Closed-form d(R(w) pt)/dw, (3,3).
 
