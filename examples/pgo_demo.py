@@ -11,13 +11,14 @@ feature-major / segment-reduction / PCG machinery as the BA families.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import numpy as np
 
 
 def main(argv=None) -> float:
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from megba_tpu.utils.backend import respect_jax_platforms
 
     respect_jax_platforms()
@@ -45,10 +46,27 @@ def main(argv=None) -> float:
     )
     res = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option,
                     verbose=True)
-    drift0 = float(np.max(np.linalg.norm(g.poses0 - g.poses_gt, axis=1)))
-    drift1 = float(np.max(np.linalg.norm(
-        np.asarray(res.poses) - g.poses_gt, axis=1)))
-    print(f"max pose drift: {drift0:.4f} -> {drift1:.6f}")
+
+    def se3_drift(poses):
+        # Chart-independent SE(3) distance to ground truth: rotation
+        # geodesic angle + translation norm (raw angle-axis differences
+        # can read 2*pi for identical rotations on opposite branches).
+        import jax
+        import jax.numpy as jnp
+
+        from megba_tpu.ops import geo
+
+        p = jnp.asarray(np.asarray(poses))
+        gt = jnp.asarray(g.poses_gt)
+        R_p = jax.vmap(geo.angle_axis_to_rotation_matrix)(p[:, :3])
+        R_g = jax.vmap(geo.angle_axis_to_rotation_matrix)(gt[:, :3])
+        ang = jax.vmap(lambda a, b: jnp.linalg.norm(
+            geo.rotation_matrix_to_angle_axis(a.T @ b)))(R_p, R_g)
+        trans = jnp.linalg.norm(p[:, 3:] - gt[:, 3:], axis=1)
+        return float(jnp.max(ang + trans))
+
+    print(f"max pose drift (SE3): {se3_drift(g.poses0):.4f} -> "
+          f"{se3_drift(res.poses):.6f}")
     return float(res.cost)
 
 

@@ -1,3 +1,7 @@
 from megba_tpu.io.synthetic import make_synthetic_bal
 
 __all__ = ["make_synthetic_bal"]
+
+# megba_tpu.io.bal (BAL text format) and megba_tpu.io.g2o (g2o pose
+# graphs) are import-on-demand submodules: both pull in jax at import
+# time, which io/__init__ keeps off the fast path for host-side tools.

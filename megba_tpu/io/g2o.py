@@ -1,0 +1,310 @@
+"""g2o text-format pose graphs: read, write, solve.
+
+The reference advertises a g2o-compatible object API but has no file
+ingestion beyond BAL (examples/BAL_Double.cpp:74-139 is its only
+loader).  Real pose-graph datasets (sphere2500, garage, manhattan,
+intel, ...) ship as `.g2o` text, so this module closes the loop for the
+PGO family (models/pgo.py): parse -> solve on the TPU pipeline -> write
+back.
+
+Supported records
+-----------------
+- ``VERTEX_SE3:QUAT id x y z qx qy qz qw``
+- ``EDGE_SE3:QUAT i j x y z qx qy qz qw  <21 upper-tri info entries>``
+- ``VERTEX_SE2 id x y theta``
+- ``EDGE_SE2 i j dx dy dtheta  <6 upper-tri info entries>``
+- ``FIX id``  (gauge anchors; default: lowest vertex id)
+
+SE(2) records are lifted into the SE(3) solver: theta becomes a z-axis
+rotation, (x, y) an in-plane translation, and the lifted information
+matrix gets unit weight on the three out-of-plane error rows — every
+edge then constrains relative out-of-plane motion to zero, which is
+exactly the planar-rigidity the SE(2) graph encodes.
+
+Information-matrix convention
+-----------------------------
+g2o orders the SE(3) error as [translation, rotation-(qx,qy,qz)]; our
+residual (models/pgo.py:between_residual) is [log_SO3, translation].
+The reader permutes rows/columns accordingly and applies the
+quaternion-vector -> log-map chart factor (dq ~= d(aa)/2 to first
+order): rotation rows AND columns are scaled by 1/2, so
+``r_ours^T Omega_ours r_ours == r_g2o^T Omega_g2o r_g2o`` for small
+errors.  ``solve_g2o`` hands the solver a matrix square root W of each
+Omega (symmetric-eigendecomposition based, so positive-semidefinite
+info factors cleanly; ||W r||^2 = r^T Omega r).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, TextIO, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.ops import geo
+
+# Our residual row order is [rotation (log map), translation]; g2o's is
+# [translation, quaternion vector].  _PERM maps our row a to g2o row
+# _PERM[a].
+_PERM = np.array([3, 4, 5, 0, 1, 2])
+
+
+@dataclasses.dataclass
+class G2OGraph:
+    """A pose graph in the solver's native coordinates.
+
+    poses [N, 6] = [angle_axis, translation] (SE(2) inputs lifted);
+    info [nE, 6, 6] is in OUR row order (rotation first, chart-corrected
+    — see module docstring); ids holds the original g2o vertex ids in
+    index order so writers can round-trip non-contiguous numbering.
+    """
+
+    poses: np.ndarray
+    edge_i: np.ndarray
+    edge_j: np.ndarray
+    meas: np.ndarray
+    info: np.ndarray
+    fixed: np.ndarray
+    ids: np.ndarray
+    se2: bool = False
+
+
+def _upper_tri_to_full(vals: Sequence[float], n: int) -> np.ndarray:
+    m = np.zeros((n, n))
+    k = 0
+    for a in range(n):
+        for b in range(a, n):
+            m[a, b] = m[b, a] = vals[k]
+            k += 1
+    return m
+
+
+def _quat_xyzw_to_aa(q_xyzw: np.ndarray) -> np.ndarray:
+    """[..., 4] (qx,qy,qz,qw) -> [..., 3] angle-axis (host-side)."""
+    q_wxyz = np.concatenate([q_xyzw[..., 3:4], q_xyzw[..., :3]], axis=-1)
+    return np.asarray(
+        jax.vmap(geo.quaternion_to_angle_axis)(
+            jnp.asarray(q_wxyz.reshape(-1, 4))),
+        dtype=np.float64).reshape(*q_xyzw.shape[:-1], 3)
+
+
+def _aa_to_quat_xyzw(aa: np.ndarray) -> np.ndarray:
+    """[..., 3] angle-axis -> [..., 4] (qx,qy,qz,qw) via R (host-side)."""
+    q_wxyz = np.asarray(
+        jax.vmap(lambda a: geo.rotation_matrix_to_quaternion(
+            geo.angle_axis_to_rotation_matrix(a)))(
+                jnp.asarray(aa.reshape(-1, 3))),
+        dtype=np.float64)
+    return np.concatenate(
+        [q_wxyz[:, 1:4], q_wxyz[:, 0:1]],
+        axis=-1).reshape(*aa.shape[:-1], 4)
+
+
+def _info_g2o_to_ours(info_g2o: np.ndarray) -> np.ndarray:
+    """Permute [t, q] -> [rot, t] and apply the dq = d(aa)/2 chart."""
+    m = info_g2o[np.ix_(_PERM, _PERM)]
+    scale = np.array([0.5, 0.5, 0.5, 1.0, 1.0, 1.0])
+    return m * scale[:, None] * scale[None, :]
+
+
+def _info_ours_to_g2o(info_ours: np.ndarray) -> np.ndarray:
+    inv = np.argsort(_PERM)
+    scale = np.array([0.5, 0.5, 0.5, 1.0, 1.0, 1.0])
+    m = info_ours / (scale[:, None] * scale[None, :])
+    return m[np.ix_(inv, inv)]
+
+
+def _lift_se2_info(info3: np.ndarray) -> np.ndarray:
+    """SE(2) info over (x, y, theta) -> our 6x6 [rot, t] order.
+
+    In-plane entries land on rows [rz(=2), tx(=3), ty(=4)]; the three
+    out-of-plane rows (rx, ry, tz) get unit weight so lifted edges pin
+    relative out-of-plane motion to zero.
+    """
+    out = np.eye(6)
+    # our row indices: theta -> 2 (z rotation), x -> 3, y -> 4
+    idx = np.array([3, 4, 2])  # g2o (x, y, theta) -> our rows
+    out[np.ix_(idx, idx)] = info3
+    return out
+
+
+def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
+    """Parse a .g2o file (SE3:QUAT or SE2 records; FIX supported)."""
+    if isinstance(source, str):
+        with open(source) as f:
+            return read_g2o(f)
+
+    # Parse into flat host lists first; the quaternion -> angle-axis
+    # conversions happen ONCE on the batched arrays afterwards (a vmap
+    # dispatch per line would cost a blocking JAX round-trip each on
+    # files with thousands of records).
+    verts: dict[int, np.ndarray] = {}  # vid -> [t(3), quat_xyzw(4)]
+    fixed_ids: set[int] = set()
+    edges: list[tuple[int, int, np.ndarray, np.ndarray]] = []  # raw 7 + info
+    se2_seen = False
+    se3_seen = False
+
+    for ln, line in enumerate(source, 1):
+        tok = line.split()
+        if not tok or tok[0].startswith("#"):
+            continue
+        tag = tok[0]
+        if tag == "VERTEX_SE3:QUAT":
+            vals = np.array([float(x) for x in tok[2:]])
+            if vals.shape[0] != 7:
+                raise ValueError(
+                    f"line {ln}: VERTEX_SE3:QUAT needs 7 values "
+                    f"(x y z qx qy qz qw), got {vals.shape[0]}")
+            verts[int(tok[1])] = vals
+            se3_seen = True
+        elif tag == "VERTEX_SE2":
+            if len(tok) != 5:
+                raise ValueError(
+                    f"line {ln}: VERTEX_SE2 needs 3 values (x y theta), "
+                    f"got {len(tok) - 2}")
+            x, y, th = (float(v) for v in tok[2:5])
+            # theta as a z-axis quaternion, converted with the batch.
+            verts[int(tok[1])] = np.array([x, y, 0.0, 0.0, 0.0,
+                                           np.sin(th / 2), np.cos(th / 2)])
+            se2_seen = True
+        elif tag == "EDGE_SE3:QUAT":
+            i, j = int(tok[1]), int(tok[2])
+            vals = np.array([float(x) for x in tok[3:]])
+            if vals.shape[0] != 7 + 21:
+                raise ValueError(
+                    f"line {ln}: EDGE_SE3:QUAT needs 7 measurement + 21 "
+                    f"info values, got {vals.shape[0]}")
+            info = _info_g2o_to_ours(_upper_tri_to_full(vals[7:], 6))
+            edges.append((i, j, vals[:7], info))
+            se3_seen = True
+        elif tag == "EDGE_SE2":
+            i, j = int(tok[1]), int(tok[2])
+            vals = np.array([float(x) for x in tok[3:]])
+            if vals.shape[0] != 3 + 6:
+                raise ValueError(
+                    f"line {ln}: EDGE_SE2 needs 3 measurement + 6 info "
+                    f"values, got {vals.shape[0]}")
+            dx, dy, dth = vals[:3]
+            raw = np.array([dx, dy, 0.0, 0.0, 0.0,
+                            np.sin(dth / 2), np.cos(dth / 2)])
+            info = _lift_se2_info(_upper_tri_to_full(vals[3:], 3))
+            edges.append((i, j, raw, info))
+            se2_seen = True
+        elif tag == "FIX":
+            fixed_ids.update(int(t) for t in tok[1:])
+        # Unknown tags (VERTEX_TRACKXYZ, landmark edges, ...) are
+        # skipped: partial ingestion of mixed graphs is standard g2o
+        # tool behaviour.
+
+    if not verts:
+        raise ValueError("no supported VERTEX records found")
+    ids = np.array(sorted(verts), dtype=np.int64)
+    index = {vid: k for k, vid in enumerate(ids)}
+    raw_v = np.stack([verts[vid] for vid in ids])  # [N, 7]
+    poses = np.concatenate(
+        [_quat_xyzw_to_aa(raw_v[:, 3:7]), raw_v[:, :3]], axis=1)
+
+    n_e = len(edges)
+    edge_i = np.zeros(n_e, np.int32)
+    edge_j = np.zeros(n_e, np.int32)
+    raw_e = np.zeros((n_e, 7))
+    info = np.zeros((n_e, 6, 6))
+    for k, (i, j, raw, om) in enumerate(edges):
+        if i not in index or j not in index:
+            raise ValueError(f"edge ({i}, {j}) references unknown vertex")
+        edge_i[k] = index[i]
+        edge_j[k] = index[j]
+        raw_e[k] = raw
+        info[k] = om
+    meas = (np.concatenate(
+        [_quat_xyzw_to_aa(raw_e[:, 3:7]), raw_e[:, :3]], axis=1)
+        if n_e else np.zeros((0, 6)))
+
+    fixed = np.zeros(len(ids), bool)
+    for vid in fixed_ids:
+        if vid in index:
+            fixed[index[vid]] = True
+    if not fixed.any():
+        fixed[0] = True  # gauge anchor, same default as solve_pgo
+
+    return G2OGraph(poses=poses, edge_i=edge_i, edge_j=edge_j, meas=meas,
+                    info=info, fixed=fixed, ids=ids,
+                    se2=se2_seen and not se3_seen)
+
+
+def write_g2o(dest: Union[str, TextIO], graph: G2OGraph,
+              poses: Optional[np.ndarray] = None) -> None:
+    """Write SE3:QUAT records (optionally with updated poses).
+
+    Always writes the SE(3) form — lifted SE(2) graphs round-trip
+    through it losslessly (z/roll/pitch stay zero at the optimum).
+    """
+    if isinstance(dest, str):
+        with open(dest, "w") as f:
+            write_g2o(f, graph, poses)
+        return
+
+    p = np.asarray(graph.poses if poses is None else poses)
+    quat_v = _aa_to_quat_xyzw(p[:, :3])
+    for k, vid in enumerate(graph.ids):
+        t = p[k, 3:]
+        q = quat_v[k]
+        dest.write(
+            f"VERTEX_SE3:QUAT {int(vid)} "
+            f"{t[0]:.9g} {t[1]:.9g} {t[2]:.9g} "
+            f"{q[0]:.9g} {q[1]:.9g} {q[2]:.9g} {q[3]:.9g}\n")
+    for k in range(len(graph.ids)):
+        if graph.fixed[k]:
+            dest.write(f"FIX {int(graph.ids[k])}\n")
+    meas_q = _aa_to_quat_xyzw(graph.meas[:, :3])
+    for e in range(graph.edge_i.shape[0]):
+        m_t = graph.meas[e, 3:]
+        q = meas_q[e]
+        om = _info_ours_to_g2o(graph.info[e])
+        tri = " ".join(
+            f"{om[a, b]:.9g}" for a in range(6) for b in range(a, 6))
+        dest.write(
+            f"EDGE_SE3:QUAT {int(graph.ids[graph.edge_i[e]])} "
+            f"{int(graph.ids[graph.edge_j[e]])} "
+            f"{m_t[0]:.9g} {m_t[1]:.9g} {m_t[2]:.9g} "
+            f"{q[0]:.9g} {q[1]:.9g} {q[2]:.9g} {q[3]:.9g} {tri}\n")
+
+
+def sqrt_info_of(graph: G2OGraph) -> Optional[np.ndarray]:
+    """Matrix square-root weights W of the edge info matrices.
+
+    ||W r||^2 = r^T Omega r, i.e. W^T W = Omega.  Uses a symmetric
+    eigendecomposition rather than Cholesky so positive-SEMIdefinite
+    matrices (a zero row = deliberately unconstrained DOF, common in
+    partial-sensor exports) factor cleanly instead of crashing; small
+    negative eigenvalues from text round-off are clamped to zero.
+    Returns None when every info matrix is the identity (the unweighted
+    fast path).
+    """
+    if np.allclose(graph.info, np.eye(6)[None]):
+        return None
+    w, v = np.linalg.eigh(graph.info)  # Omega = V diag(w) V^T
+    floor = -1e-9 * np.maximum(w.max(axis=-1, keepdims=True), 1.0)
+    bad = np.nonzero((w < floor).any(axis=-1))[0]
+    if bad.size:
+        raise ValueError(
+            f"edge {int(bad[0])} (of {len(w)}) has an indefinite "
+            f"information matrix (eigenvalues {w[bad[0]]})")
+    # W = diag(sqrt(w)) V^T satisfies W^T W = Omega.
+    return np.sqrt(np.maximum(w, 0.0))[:, :, None] * np.transpose(
+        v, (0, 2, 1))
+
+
+def solve_g2o(source, option=None, verbose: bool = False):
+    """Read (path / file / G2OGraph), solve, return (graph, PGOResult)."""
+    from megba_tpu.models.pgo import solve_pgo
+
+    graph = source if isinstance(source, G2OGraph) else read_g2o(source)
+    result = solve_pgo(
+        graph.poses, graph.edge_i, graph.edge_j, graph.meas,
+        option, sqrt_info=sqrt_info_of(graph), fixed=graph.fixed,
+        verbose=verbose)
+    return graph, result

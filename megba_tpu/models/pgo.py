@@ -34,11 +34,15 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from megba_tpu.common import ProblemOption
 from megba_tpu.core.fm import segsum_fm
+from megba_tpu.core.types import pad_edges
+from megba_tpu.parallel.mesh import EDGE_AXIS, make_mesh
 from megba_tpu.ops import geo
 from megba_tpu.ops.accum import comp_sum_sq
+from megba_tpu.utils.backend import warn_if_x64_unavailable
 
 POSE_DIM = 6
 _TINY = 1e-30
@@ -70,8 +74,15 @@ class PGOResult(NamedTuple):
     stopped: jax.Array
 
 
-def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j):
-    """r [6, nE], Ji/Jj [6, 6, nE] (weighted, fixed-masked), cost."""
+def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
+               emask=None, axis_name=None):
+    """r [6, nE], Ji/Jj [6, 6, nE] (weighted, fixed-masked), cost.
+
+    `emask` [nE] zeroes padding edges (sharded solves pad the edge axis
+    to a multiple of world_size, same scheme as core/types.pad_edges);
+    with `axis_name` set the cost is psum-reduced so every shard carries
+    the replicated global cost.
+    """
 
     def g(x12, m):
         return between_residual(x12[:POSE_DIM], x12[POSE_DIM:], m)
@@ -82,27 +93,48 @@ def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j):
     r = jax.vmap(g, in_axes=(1, 1), out_axes=1)(x12, meas_fm)
     J = jax.vmap(jax.jacfwd(g), in_axes=(1, 1), out_axes=2)(x12, meas_fm)
     Ji, Jj = J[:, :POSE_DIM], J[:, POSE_DIM:]  # [6, 6, nE]
-    if sqrt_info is not None:  # [6, 6, nE] row-form L per edge
+    if sqrt_info is not None:  # [6, 6, nE] row-form W per edge
         r = jnp.einsum("abe,be->ae", sqrt_info, r)
         Ji = jnp.einsum("abe,bce->ace", sqrt_info, Ji)
         Jj = jnp.einsum("abe,bce->ace", sqrt_info, Jj)
     # Gauge/fixed poses contribute no Jacobian columns.
     Ji = Ji * free_i
     Jj = Jj * free_j
+    if emask is not None:
+        r = r * emask[None, :]
+        Ji = Ji * emask[None, None, :]
+        Jj = Jj * emask[None, None, :]
     cost = comp_sum_sq(r.reshape(-1))
+    if axis_name is not None:
+        cost = jax.lax.psum(cost, axis_name)
     return r, Ji, Jj, cost
 
 
-def _grad_and_diag(r, Ji, Jj, edge_i, edge_j, n_poses, fixed):
-    """g [6, N] and block-diagonal H rows [36, N] (identity at fixed)."""
+def _grad_fm(r, Ji, Jj, edge_i, edge_j, n_poses):
+    """Gradient J^T r as [6, N] feature-major (fixed poses come out zero
+    because _linearize already masks their Jacobian columns)."""
     gi = jnp.einsum("oae,oe->ae", Ji, r)
     gj = jnp.einsum("oae,oe->ae", Jj, r)
-    g = (segsum_fm(gi, edge_i, n_poses)
-         + segsum_fm(gj, edge_j, n_poses))
+    return (segsum_fm(gi, edge_i, n_poses)
+            + segsum_fm(gj, edge_j, n_poses))
+
+
+def _grad_and_diag(r, Ji, Jj, edge_i, edge_j, n_poses, fixed,
+                   axis_name=None):
+    """g [6, N] and block-diagonal H rows [36, N] (identity at fixed).
+
+    Sharded solves psum g and h BEFORE the identity guard below: a pose
+    whose edges all live on other shards must see the global sum, not a
+    per-shard identity block.
+    """
+    g = _grad_fm(r, Ji, Jj, edge_i, edge_j, n_poses)
     hi = jnp.einsum("oae,obe->abe", Ji, Ji).reshape(36, -1)
     hj = jnp.einsum("oae,obe->abe", Jj, Jj).reshape(36, -1)
     h = (segsum_fm(hi, edge_i, n_poses)
          + segsum_fm(hj, edge_j, n_poses))
+    if axis_name is not None:
+        g = jax.lax.psum(g, axis_name)
+        h = jax.lax.psum(h, axis_name)
     # Fixed (and fully unobserved) poses get identity blocks so the
     # damped preconditioner stays invertible; their gradient is zero so
     # PCG leaves them untouched (same trick as the BA builder's
@@ -130,119 +162,221 @@ def solve_pgo(
     meas [nE, 6], sqrt_info [nE, 6, 6] optional, fixed [N] bool (pose 0
     is fixed by default — the gauge anchor).  LM trust-region semantics
     and PCG stopping mirror the BA path (algo/lm.py, solver/pcg.py).
+
+    `option.world_size > 1` shards the EDGE axis over a 1-D device mesh
+    (same layout as the BA path, parallel/mesh.py): pose state is
+    replicated, every per-edge array lives only on its shard, and the
+    whole LM loop runs as one SPMD program with psums at the reduction
+    sites (cost, gradient, block diagonal, matvec output).
     """
     option = option or ProblemOption()
-    # f64 only when actually available (x64 enabled) — otherwise jnp
-    # would silently truncate and warn on every asarray below.
+    # f64 only when actually available (x64 enabled) — otherwise warn
+    # loudly, same precision contract as flat_solve.
+    warn_if_x64_unavailable(option.dtype)
     dtype = (
         jnp.float64
         if np.dtype(option.dtype) == np.float64 and jax.config.jax_enable_x64
         else jnp.float32)
     n_poses = int(poses0.shape[0])
-    poses_fm = jnp.asarray(np.ascontiguousarray(poses0.T), dtype)
-    ei = jnp.asarray(edge_i, jnp.int32)
-    ej = jnp.asarray(edge_j, jnp.int32)
-    meas_fm = jnp.asarray(np.ascontiguousarray(np.asarray(meas).T), dtype)
+    world = int(option.world_size)
+
+    # Host-side prep: pad the edge axis to a multiple of world_size with
+    # masked-out edges (core/types.pad_edges — one padding contract for
+    # the BA and PGO families).
+    edge_i = np.asarray(edge_i, np.int32)
+    edge_j = np.asarray(edge_j, np.int32)
+    meas_np = np.asarray(meas)
+    si_np = None if sqrt_info is None else np.asarray(sqrt_info)
+    n_e = edge_i.shape[0]
+    n_pad = (-n_e) % world
+    emask = None
+    if n_pad:
+        meas_np, edge_i, edge_j, emask_np = pad_edges(
+            meas_np, edge_i, edge_j, world, dtype=np.float64)
+        emask = jnp.asarray(emask_np, dtype)
+        if si_np is not None:
+            si_np = np.concatenate(
+                [si_np, np.zeros((n_pad, 6, 6), si_np.dtype)])
+
     if fixed is None:
         fixed_np = np.zeros(n_poses, bool)
         fixed_np[0] = True
     else:
         fixed_np = np.asarray(fixed, bool)
+
+    poses_fm = jnp.asarray(np.ascontiguousarray(poses0.T), dtype)
     fixed_j = jnp.asarray(fixed_np)
-    free_i = 1.0 - jnp.take(fixed_j, ei).astype(dtype)[None, None, :]
-    free_j = 1.0 - jnp.take(fixed_j, ej).astype(dtype)[None, None, :]
-    si = None
-    if sqrt_info is not None:
-        si = jnp.asarray(
-            np.ascontiguousarray(np.transpose(np.asarray(sqrt_info),
-                                              (1, 2, 0))), dtype)
+    ei = jnp.asarray(edge_i)
+    ej = jnp.asarray(edge_j)
+    meas_fm = jnp.asarray(np.ascontiguousarray(meas_np.T), dtype)
+    si = (None if si_np is None else jnp.asarray(
+        np.ascontiguousarray(np.transpose(si_np, (1, 2, 0))), dtype))
 
     algo_opt = option.algo_option
     solver_opt = option.solver_option
+    axis_name = EDGE_AXIS if world > 1 else None
 
     from megba_tpu.solver.pcg import _pcg_core, block_inv
 
-    def lin(p):
-        return _linearize(p, ei, ej, meas_fm, si, free_i, free_j)
+    # emask (only when the edge axis was padded) and si (only when the
+    # caller weights edges) ride as optional trailing operands, so the
+    # common unpadded/unweighted solve never pays their multiplies.
+    extra_keys = []
+    extras = []
+    extra_specs = []
+    if emask is not None:
+        extra_keys.append("emask")
+        extras.append(emask)
+        extra_specs.append(P(EDGE_AXIS))
+    if si is not None:
+        extra_keys.append("si")
+        extras.append(si)
+        extra_specs.append(P(None, None, EDGE_AXIS))
 
-    def step_system(r, Ji, Jj, region):
-        g, h_rows = _grad_and_diag(r, Ji, Jj, ei, ej, n_poses, fixed_j)
-        damp = 1.0 + 1.0 / region
-        h_blocks = jnp.moveaxis(h_rows.reshape(6, 6, n_poses), -1, 0)
-        h_damped = h_blocks * (
-            jnp.eye(POSE_DIM, dtype=dtype) * (damp - 1.0) + 1.0)
-        minv = block_inv(h_damped)
+    def run(poses_fm, fixed_j, ei, ej, meas_fm, *extras_in):
+        kw = dict(zip(extra_keys, extras_in))
+        emask = kw.get("emask")
+        si_ = kw.get("si")
+        free_i = 1.0 - jnp.take(fixed_j, ei).astype(dtype)[None, None, :]
+        free_j = 1.0 - jnp.take(fixed_j, ej).astype(dtype)[None, None, :]
 
-        def matvec(x):  # [6, N] -> [6, N]; damped H x, matrix-free
-            xi = jnp.take(x, ei, axis=1)
-            xj = jnp.take(x, ej, axis=1)
-            u = (jnp.einsum("oae,ae->oe", Ji, xi)
-                 + jnp.einsum("oae,ae->oe", Jj, xj))
-            out = (segsum_fm(jnp.einsum("oae,oe->ae", Ji, u), ei, n_poses)
-                   + segsum_fm(jnp.einsum("oae,oe->ae", Jj, u), ej,
-                               n_poses))
-            # LM damping on the block diagonal only (reference
-            # LMLinearSystem semantics): += (1/region) * D_blocks x.
-            dx_d = jnp.einsum("nab,bn->an", h_blocks, x) * (damp - 1.0)
-            return out + dx_d
+        def lin(p):
+            return _linearize(p, ei, ej, meas_fm, si_, free_i, free_j,
+                              emask, axis_name)
 
-        def precond(x):
-            return jnp.einsum("nab,bn->an", minv, x)
+        def grad_and_diag(r, Ji, Jj):
+            return _grad_and_diag(r, Ji, Jj, ei, ej, n_poses, fixed_j,
+                                  axis_name)
 
-        dx, iters, _ = _pcg_core(
-            matvec, precond, -g, solver_opt.max_iter, solver_opt.tol,
-            solver_opt.refuse_ratio, solver_opt.tol_relative)
-        return dx, iters, g
+        def step_system(g, h_rows, Ji, Jj, region):
+            damp = 1.0 + 1.0 / region
+            h_blocks = jnp.moveaxis(h_rows.reshape(6, 6, n_poses), -1, 0)
+            # Diagonal ENTRIES of each 6x6 block: rows 0,7,...,35 of the
+            # [36, N] row store.
+            h_diag = h_rows[:: POSE_DIM + 1]
+            h_damped = h_blocks * (
+                jnp.eye(POSE_DIM, dtype=dtype) * (damp - 1.0) + 1.0)
+            minv = block_inv(h_damped)
 
-    r0, Ji0, Jj0, cost0 = lin(poses_fm)
-    state0 = dict(
-        k=jnp.int32(0), accepted=jnp.int32(0), pcg_total=jnp.int32(0),
-        poses=poses_fm, r=r0, Ji=Ji0, Jj=Jj0, cost=cost0,
-        region=jnp.asarray(algo_opt.initial_region, dtype),
-        v=jnp.asarray(2.0, dtype), stop=jnp.bool_(False))
+            def matvec(x):  # [6, N] -> [6, N]; damped H x, matrix-free
+                xi = jnp.take(x, ei, axis=1)
+                xj = jnp.take(x, ej, axis=1)
+                u = (jnp.einsum("oae,ae->oe", Ji, xi)
+                     + jnp.einsum("oae,ae->oe", Jj, xj))
+                out = (segsum_fm(jnp.einsum("oae,oe->ae", Ji, u), ei,
+                                 n_poses)
+                       + segsum_fm(jnp.einsum("oae,oe->ae", Jj, u), ej,
+                                   n_poses))
+                if axis_name is not None:
+                    out = jax.lax.psum(out, axis_name)
+                # LM damping scales diagonal ENTRIES by (1 + 1/region),
+                # matching h_damped above and the BA path's damp_blocks
+                # (reference extractOldAndApplyNewDiag semantics); x and
+                # h_diag are replicated, so this is added AFTER the psum.
+                dx_d = h_diag * x * (damp - 1.0)
+                return out + dx_d
 
-    def cond(s):
-        return (s["k"] < algo_opt.max_iter) & (~s["stop"])
+            def precond(x):
+                return jnp.einsum("nab,bn->an", minv, x)
 
-    def body(s):
-        dx, pcg_iters, g = step_system(s["r"], s["Ji"], s["Jj"], s["region"])
-        dx_norm = jnp.sqrt(jnp.sum(dx * dx))
-        x_norm = jnp.sqrt(jnp.sum(s["poses"] ** 2))
-        converged = dx_norm <= algo_opt.epsilon2 * (x_norm + algo_opt.epsilon1)
-        poses_new = s["poses"] + dx
+            dx, iters, _ = _pcg_core(
+                matvec, precond, -g, solver_opt.max_iter, solver_opt.tol,
+                solver_opt.refuse_ratio, solver_opt.tol_relative)
+            return dx, iters
 
-        # Gain ratio exactly as the BA loop (lm.py:219-260): predicted
-        # = ||J dx + r||^2, denominator clamped sign-preservingly.
-        dxi = jnp.take(dx, ei, axis=1)
-        dxj = jnp.take(dx, ej, axis=1)
-        jdx = (jnp.einsum("oae,ae->oe", s["Ji"], dxi)
-               + jnp.einsum("oae,ae->oe", s["Jj"], dxj) + s["r"])
-        predicted = comp_sum_sq(jdx.reshape(-1))
-        denominator = jnp.minimum(predicted - s["cost"], -_TINY)
-        _, _, _, cost_new = lin(poses_new)
-        rho = (cost_new - s["cost"]) / denominator
-        accept = (cost_new < s["cost"]) & (~converged)
+        r0, Ji0, Jj0, cost0 = lin(poses_fm)
+        g0, h0 = grad_and_diag(r0, Ji0, Jj0)
+        state0 = dict(
+            k=jnp.int32(0), accepted=jnp.int32(0), pcg_total=jnp.int32(0),
+            poses=poses_fm, r=r0, Ji=Ji0, Jj=Jj0, g=g0, h_rows=h0,
+            cost=cost0,
+            region=jnp.asarray(algo_opt.initial_region, dtype),
+            v=jnp.asarray(2.0, dtype), stop=jnp.bool_(False))
 
-        r_n, Ji_n, Jj_n = jax.lax.cond(
-            accept,
-            lambda _: lin(poses_new)[:3],
-            lambda _: (s["r"], s["Ji"], s["Jj"]),
-            None)
-        g_inf = jnp.max(jnp.abs(g))
-        region_accept = s["region"] / jnp.maximum(
-            jnp.asarray(1.0 / 3.0, dtype), 1.0 - (2.0 * rho - 1.0) ** 3)
+        def cond(s):
+            return (s["k"] < algo_opt.max_iter) & (~s["stop"])
+
+        def body(s):
+            dx, pcg_iters = step_system(s["g"], s["h_rows"], s["Ji"],
+                                        s["Jj"], s["region"])
+            dx_norm = jnp.sqrt(jnp.sum(dx * dx))
+            x_norm = jnp.sqrt(jnp.sum(s["poses"] ** 2))
+            converged = dx_norm <= algo_opt.epsilon2 * (
+                x_norm + algo_opt.epsilon1)
+            poses_new = s["poses"] + dx
+
+            # Gain ratio exactly as the BA loop (lm.py:219-260):
+            # predicted = ||J dx + r||^2 (edge-sharded -> psum),
+            # denominator clamped sign-preservingly.
+            dxi = jnp.take(dx, ei, axis=1)
+            dxj = jnp.take(dx, ej, axis=1)
+            jdx = (jnp.einsum("oae,ae->oe", s["Ji"], dxi)
+                   + jnp.einsum("oae,ae->oe", s["Jj"], dxj) + s["r"])
+            predicted = comp_sum_sq(jdx.reshape(-1))
+            if axis_name is not None:
+                predicted = jax.lax.psum(predicted, axis_name)
+            denominator = jnp.minimum(predicted - s["cost"], -_TINY)
+            _, _, _, cost_new = lin(poses_new)
+            rho = (cost_new - s["cost"]) / denominator
+            accept = (cost_new < s["cost"]) & (~converged)
+
+            # Accept branch relinearizes AND rebuilds g/h (the BA
+            # loop's accept-branch rebuild, lm.py:_relinearize) — so
+            # the gradient stop below reads the RELINEARIZED gradient
+            # of the accepted point (reference lm_algo.cu checks the
+            # post-update ||g||_inf) and the next iteration's
+            # step_system reuses g/h from the carry instead of
+            # recomputing.  On reject everything carries over unchanged
+            # and the accept-gated stop never fires.
+            def _accept_lin(_):
+                r2, Ji2, Jj2, _c = lin(poses_new)
+                g2, h2 = grad_and_diag(r2, Ji2, Jj2)
+                return r2, Ji2, Jj2, g2, h2, jnp.max(jnp.abs(g2))
+
+            def _keep_old(_):
+                return (s["r"], s["Ji"], s["Jj"], s["g"], s["h_rows"],
+                        jnp.asarray(jnp.inf, dtype))
+
+            r_n, Ji_n, Jj_n, g_n, h_n, g_inf = jax.lax.cond(
+                accept, _accept_lin, _keep_old, None)
+            region_accept = s["region"] / jnp.maximum(
+                jnp.asarray(1.0 / 3.0, dtype), 1.0 - (2.0 * rho - 1.0) ** 3)
+            return dict(
+                k=s["k"] + 1,
+                accepted=s["accepted"]
+                + jnp.where(accept, 1, 0).astype(jnp.int32),
+                pcg_total=s["pcg_total"] + pcg_iters,
+                poses=jnp.where(accept, poses_new, s["poses"]),
+                r=r_n, Ji=Ji_n, Jj=Jj_n, g=g_n, h_rows=h_n,
+                cost=jnp.where(accept, cost_new, s["cost"]),
+                region=jnp.where(accept, region_accept,
+                                 s["region"] / s["v"]),
+                v=jnp.where(accept, jnp.asarray(2.0, dtype), s["v"] * 2.0),
+                stop=converged | (accept & (g_inf <= algo_opt.epsilon1)))
+
+        out = jax.lax.while_loop(cond, body, state0)
+        # Per-edge carries (r/J/g/h) are internal; return only the
+        # replicated observables so the sharded out_specs stay P().
         return dict(
-            k=s["k"] + 1,
-            accepted=s["accepted"] + jnp.where(accept, 1, 0).astype(jnp.int32),
-            pcg_total=s["pcg_total"] + pcg_iters,
-            poses=jnp.where(accept, poses_new, s["poses"]),
-            r=r_n, Ji=Ji_n, Jj=Jj_n,
-            cost=jnp.where(accept, cost_new, s["cost"]),
-            region=jnp.where(accept, region_accept, s["region"] / s["v"]),
-            v=jnp.where(accept, jnp.asarray(2.0, dtype), s["v"] * 2.0),
-            stop=converged | (accept & (g_inf <= algo_opt.epsilon1)))
+            poses=out["poses"], cost=out["cost"], cost0=cost0,
+            k=out["k"], accepted=out["accepted"],
+            pcg_total=out["pcg_total"], region=out["region"],
+            stop=out["stop"])
 
-    out = jax.lax.while_loop(cond, body, state0)
+    args = [poses_fm, fixed_j, ei, ej, meas_fm, *extras]
+    if world > 1:
+        mesh = make_mesh(world)
+        rep = P()
+        in_specs = [rep, rep, P(EDGE_AXIS), P(EDGE_AXIS),
+                    P(None, EDGE_AXIS), *extra_specs]
+        sharded = jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P()))
+        with jax.default_device(mesh.devices.flat[0]):
+            out = sharded(*args)
+    else:
+        out = run(*args)
+
+    cost0 = out["cost0"]
     result = PGOResult(
         poses=jnp.swapaxes(out["poses"], 0, 1),
         cost=out["cost"], initial_cost=cost0, iterations=out["k"],

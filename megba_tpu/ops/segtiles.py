@@ -538,7 +538,14 @@ def _jtj_fallback_chunked(J, r, plan: DevicePlan, d: int, od: int,
     if pad:
         J = jnp.pad(J, ((0, 0), (0, pad)))
         r = jnp.pad(r, ((0, 0), (0, pad)))
-        seg = jnp.pad(seg, (0, pad), constant_values=plan.num_segments)
+        # Pad with num_blocks*block, not num_segments: sharded plans
+        # padded by _pad_device_plan carry junk-block slots with seg up
+        # to num_blocks*block - 1 >= num_segments, so only this value is
+        # guaranteed >= every live or junk seg — keeping the padded tail
+        # non-decreasing as indices_are_sorted=True promises.  Still
+        # out of range, so the scatter drops it.
+        seg = jnp.pad(seg, (0, pad),
+                      constant_values=plan.num_blocks * plan.block)
 
     def body(k, acc):
         start = k * chunk

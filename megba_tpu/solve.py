@@ -30,6 +30,7 @@ from megba_tpu.parallel.mesh import (
     get_or_build_program,
     make_mesh,
 )
+from megba_tpu.utils.backend import warn_if_x64_unavailable
 
 
 def default_use_tiled(dtype) -> bool:
@@ -118,16 +119,7 @@ def flat_solve(
     MEGBA_TILED=1/0 force-enables/disables.
     """
     dtype = np.dtype(option.dtype)
-    if dtype == np.float64 and not jax.config.jax_enable_x64:
-        import warnings
-
-        warnings.warn(
-            "ProblemOption(dtype=float64) but jax x64 is disabled — JAX "
-            "will silently compute in float32. Call "
-            'jax.config.update("jax_enable_x64", True) first (CPU '
-            "recommended; TPU float64 is emulated) or set dtype=float32.",
-            stacklevel=2,
-        )
+    warn_if_x64_unavailable(dtype)
     # copy=False: at Final-13682 scale obs alone is ~70MB; don't duplicate
     # arrays that are already the right dtype.
     cameras = np.asarray(cameras).astype(dtype, copy=False)

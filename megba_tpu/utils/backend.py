@@ -115,3 +115,27 @@ def ensure_usable_backend(timeout_s: float = 120.0) -> bool:
 
     jax.config.update("jax_platforms", "cpu")
     return True
+
+
+def warn_if_x64_unavailable(dtype) -> bool:
+    """Warn when a float64 request will silently compute in float32.
+
+    One shared precision contract for every public solve entry point
+    (flat_solve, solve_pgo, ...).  Returns True when the warning fired.
+    """
+    import numpy as np
+
+    import jax
+
+    if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+        import warnings
+
+        warnings.warn(
+            "ProblemOption(dtype=float64) but jax x64 is disabled — JAX "
+            "will silently compute in float32. Call "
+            'jax.config.update("jax_enable_x64", True) first (CPU '
+            "recommended; TPU float64 is emulated) or set dtype=float32.",
+            stacklevel=3,
+        )
+        return True
+    return False

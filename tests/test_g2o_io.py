@@ -1,0 +1,195 @@
+"""g2o text-format ingestion: round-trip, conventions, SE(2) lift, solve.
+
+The reference has no g2o file support (its only loader is the BAL text
+parser, examples/BAL_Double.cpp:74-139) — this module covers the
+capability-beyond-reference path that connects the PGO family to the
+standard pose-graph dataset format.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+from megba_tpu.io.g2o import (
+    G2OGraph,
+    _info_g2o_to_ours,
+    _info_ours_to_g2o,
+    read_g2o,
+    solve_g2o,
+    sqrt_info_of,
+    write_g2o,
+)
+from megba_tpu.models.pgo import make_synthetic_pose_graph, solve_pgo
+from megba_tpu.ops import geo
+
+
+def _option(max_iter=25):
+    return ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-12,
+                               epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=100, tol=1e-14,
+                                   refuse_ratio=1e30),
+    )
+
+
+def _graph_of(g, info=None, fixed=None):
+    n_e = len(g.edge_i)
+    n = g.poses0.shape[0]
+    if fixed is None:
+        fixed = np.zeros(n, bool)
+        fixed[0] = True
+    return G2OGraph(
+        poses=g.poses0, edge_i=g.edge_i, edge_j=g.edge_j, meas=g.meas,
+        info=np.tile(np.eye(6), (n_e, 1, 1)) if info is None else info,
+        fixed=fixed, ids=np.arange(n, dtype=np.int64))
+
+
+def _rotmats(aa):
+    return np.asarray(jax.vmap(geo.angle_axis_to_rotation_matrix)(
+        jnp.asarray(aa)))
+
+
+def test_roundtrip_exact_se3():
+    g = make_synthetic_pose_graph(num_poses=12, loop_closures=3, seed=1)
+    rng = np.random.default_rng(0)
+    # Random SPD info per edge exercises the permutation + chart maps.
+    a = rng.standard_normal((len(g.edge_i), 6, 6))
+    info = a @ np.transpose(a, (0, 2, 1)) + 6 * np.eye(6)
+    graph = _graph_of(g, info=info)
+    graph.fixed[5] = True
+
+    buf = io.StringIO()
+    write_g2o(buf, graph)
+    back = read_g2o(io.StringIO(buf.getvalue()))
+
+    assert not back.se2
+    np.testing.assert_array_equal(back.ids, graph.ids)
+    np.testing.assert_array_equal(back.edge_i, graph.edge_i)
+    np.testing.assert_array_equal(back.edge_j, graph.edge_j)
+    np.testing.assert_array_equal(back.fixed, graph.fixed)
+    # Rotations round-trip through the quaternion chart as SO(3)
+    # elements; translations exactly (up to text precision).
+    np.testing.assert_allclose(_rotmats(back.poses[:, :3]),
+                               _rotmats(graph.poses[:, :3]), atol=1e-7)
+    np.testing.assert_allclose(back.poses[:, 3:], graph.poses[:, 3:],
+                               atol=1e-7)
+    np.testing.assert_allclose(_rotmats(back.meas[:, :3]),
+                               _rotmats(graph.meas[:, :3]), atol=1e-7)
+    np.testing.assert_allclose(back.info, graph.info, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_info_permutation_involution():
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((6, 6))
+    om = a @ a.T + 6 * np.eye(6)
+    np.testing.assert_allclose(_info_g2o_to_ours(_info_ours_to_g2o(om)),
+                               om, rtol=1e-12)
+    # The chart factor: rotation block (ours rows 0-2) maps to the g2o
+    # quaternion block (rows 3-5) scaled by 4, translation unscaled.
+    ours = _info_g2o_to_ours(np.eye(6))
+    np.testing.assert_allclose(np.diag(ours), [0.25] * 3 + [1.0] * 3)
+
+
+def test_file_route_matches_direct_solve():
+    g = make_synthetic_pose_graph(num_poses=14, loop_closures=4,
+                                  drift_noise=0.05, seed=2)
+    buf = io.StringIO()
+    write_g2o(buf, _graph_of(g))
+    graph, res = solve_g2o(io.StringIO(buf.getvalue()), _option())
+    res_direct = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, _option())
+    assert float(res.cost) < 1e-9 * max(float(res.initial_cost), 1.0)
+    # Identity info -> sqrt_info_of returns None -> byte-identical path.
+    assert sqrt_info_of(graph) is None
+    np.testing.assert_allclose(float(res.cost), float(res_direct.cost),
+                               rtol=1e-9, atol=1e-14)
+
+
+def test_weighted_solve_and_psd_sqrt():
+    g = make_synthetic_pose_graph(num_poses=10, loop_closures=2, seed=4)
+    n_e = len(g.edge_i)
+    info = np.tile(np.diag([4.0, 4.0, 4.0, 9.0, 9.0, 9.0]), (n_e, 1, 1))
+    graph = _graph_of(g, info=info)
+    w = sqrt_info_of(graph)
+    assert w is not None
+    np.testing.assert_allclose(
+        np.einsum("eab,eac->ebc", w, w), info, rtol=1e-12)
+    _, res = solve_g2o(graph, _option())
+    assert float(res.cost) < 1e-9
+
+    # Positive-SEMIdefinite info (an unconstrained DOF) must factor
+    # cleanly, not crash.
+    info_psd = np.tile(np.diag([1.0, 1.0, 1.0, 1.0, 1.0, 0.0]),
+                       (n_e, 1, 1))
+    w_psd = sqrt_info_of(_graph_of(g, info=info_psd))
+    np.testing.assert_allclose(
+        np.einsum("eab,eac->ebc", w_psd, w_psd), info_psd, atol=1e-12)
+
+    # Indefinite info is a data error and must say which edge.
+    info_bad = info.copy()
+    info_bad[3] = np.diag([1.0, 1.0, 1.0, 1.0, 1.0, -2.0])
+    with pytest.raises(ValueError, match="edge 3"):
+        sqrt_info_of(_graph_of(g, info=info_bad))
+
+
+def test_se2_lift_solves_planar():
+    # A drifted square with one loop closure; all records SE2.
+    text = """\
+# planar graph
+VERTEX_SE2 0 0 0 0
+VERTEX_SE2 1 1.1 0.05 1.62
+VERTEX_SE2 2 1.02 1.08 3.2
+VERTEX_SE2 3 -0.07 0.93 -1.55
+EDGE_SE2 0 1 1 0 1.5707963 1 0 0 1 0 1
+EDGE_SE2 1 2 1 0 1.5707963 1 0 0 1 0 1
+EDGE_SE2 2 3 1 0 1.5707963 1 0 0 1 0 1
+EDGE_SE2 3 0 1 0 1.5707963 1 0 0 1 0 1
+FIX 0
+"""
+    graph = read_g2o(io.StringIO(text))
+    assert graph.se2
+    assert graph.poses.shape == (4, 6)
+    # Lifted info: unit weight on the out-of-plane rows.
+    np.testing.assert_allclose(np.diag(graph.info[0]),
+                               [1, 1, 1, 1, 1, 1], atol=1e-12)
+    _, res = solve_g2o(graph, _option())
+    assert float(res.cost) < 1e-12
+    poses = np.asarray(res.poses)
+    # Solution stays planar: no z translation, no in-plane rotation axes.
+    assert float(np.abs(poses[:, [0, 1, 5]]).max()) < 1e-8
+    # The four poses close a unit square.
+    np.testing.assert_allclose(poses[2, 3:5], [1.0, 1.0], atol=1e-6)
+
+
+def test_malformed_lines_raise_with_line_numbers():
+    with pytest.raises(ValueError, match="line 1: VERTEX_SE3:QUAT"):
+        read_g2o(io.StringIO("VERTEX_SE3:QUAT 5 1.0 2.0\n"))
+    with pytest.raises(ValueError, match="line 2: EDGE_SE3:QUAT"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+            "EDGE_SE3:QUAT 0 0 1 2 3\n"))
+    with pytest.raises(ValueError, match="unknown vertex"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE2 0 0 0 0\n"
+            "EDGE_SE2 0 7 1 0 0 1 0 0 1 0 1\n"))
+    with pytest.raises(ValueError, match="no supported VERTEX"):
+        read_g2o(io.StringIO("# empty\nUNKNOWN_TAG 1 2 3\n"))
+
+
+def test_unknown_tags_skipped_and_default_anchor():
+    text = """\
+VERTEX_TRACKXYZ 99 1 2 3
+VERTEX_SE2 4 0 0 0
+VERTEX_SE2 7 1 0 0
+EDGE_SE2 4 7 1 0 0 1 0 0 1 0 1
+"""
+    graph = read_g2o(io.StringIO(text))
+    np.testing.assert_array_equal(graph.ids, [4, 7])
+    # No FIX line -> lowest-id vertex anchors the gauge.
+    np.testing.assert_array_equal(graph.fixed, [True, False])
