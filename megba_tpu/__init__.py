@@ -36,7 +36,24 @@ from megba_tpu.problem import (
     PointVertex,
     VertexKind,
 )
+from megba_tpu.ops.robust import RobustKind
 from megba_tpu.solve import solve_bal
+
+
+def solve_pgo(*args, **kwargs):
+    """Solve an SE(3) pose graph — see models/pgo.py (lazy import: the
+    PGO family is optional for BA-only users)."""
+    from megba_tpu.models.pgo import solve_pgo as _solve_pgo
+
+    return _solve_pgo(*args, **kwargs)
+
+
+def solve_g2o(*args, **kwargs):
+    """Read + solve a .g2o pose-graph file — see io/g2o.py."""
+    from megba_tpu.io.g2o import solve_g2o as _solve_g2o
+
+    return _solve_g2o(*args, **kwargs)
+
 
 __version__ = "0.1.0"
 
@@ -56,8 +73,11 @@ __all__ = [
     "PointVertex",
     "PreconditionerKind",
     "ProblemOption",
+    "RobustKind",
     "SolverKind",
     "SolverOption",
     "VertexKind",
     "solve_bal",
+    "solve_g2o",
+    "solve_pgo",
 ]

@@ -41,7 +41,8 @@ from megba_tpu.core.fm import segsum_fm
 from megba_tpu.core.types import pad_edges
 from megba_tpu.parallel.mesh import EDGE_AXIS, make_mesh
 from megba_tpu.ops import geo
-from megba_tpu.ops.accum import comp_sum_sq
+from megba_tpu.ops.accum import comp_sum, comp_sum_sq
+from megba_tpu.ops.robust import RobustKind, robustify
 from megba_tpu.utils.backend import warn_if_x64_unavailable
 
 POSE_DIM = 6
@@ -75,13 +76,18 @@ class PGOResult(NamedTuple):
 
 
 def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
-               emask=None, axis_name=None):
-    """r [6, nE], Ji/Jj [6, 6, nE] (weighted, fixed-masked), cost.
+               emask=None, axis_name=None,
+               robust=None, robust_delta=1.0):
+    """r [6, nE], Ji/Jj [6, 6, nE] (weighted, fixed-masked), cost, wcost.
 
     `emask` [nE] zeroes padding edges (sharded solves pad the edge axis
     to a multiple of world_size, same scheme as core/types.pad_edges);
-    with `axis_name` set the cost is psum-reduced so every shard carries
-    the replicated global cost.
+    with `axis_name` set the costs are psum-reduced so every shard
+    carries the replicated global values.  With a robust kernel the
+    returned r/Ji/Jj are IRLS-reweighted (same scheme as the BA loop,
+    algo/lm.py): `cost` is Sum rho (the accept observable) and `wcost`
+    the weighted squared norm (the quadratic-model observable); without
+    one they coincide.
     """
 
     def g(x12, m):
@@ -104,10 +110,25 @@ def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
         r = r * emask[None, :]
         Ji = Ji * emask[None, None, :]
         Jj = Jj * emask[None, None, :]
-    cost = comp_sum_sq(r.reshape(-1))
+    if robust is None or robust == RobustKind.NONE:
+        wcost = comp_sum_sq(r.reshape(-1))
+        cost = wcost
+    else:
+        # Same IRLS kernel as the BA path (ops/robust.robustify, with
+        # Ji/Jj flattened to its row form).  Padding edges are inert:
+        # r = 0 -> s = 0 -> rho = 0, w = 1.
+        n_e = r.shape[1]
+        r, Ji_f, Jj_f, rho_e = robustify(
+            r, Ji.reshape(POSE_DIM * POSE_DIM, n_e),
+            Jj.reshape(POSE_DIM * POSE_DIM, n_e), robust, robust_delta)
+        Ji = Ji_f.reshape(POSE_DIM, POSE_DIM, n_e)
+        Jj = Jj_f.reshape(POSE_DIM, POSE_DIM, n_e)
+        cost = comp_sum(rho_e)
+        wcost = comp_sum_sq(r.reshape(-1))
     if axis_name is not None:
         cost = jax.lax.psum(cost, axis_name)
-    return r, Ji, Jj, cost
+        wcost = jax.lax.psum(wcost, axis_name)
+    return r, Ji, Jj, cost, wcost
 
 
 def _grad_fm(r, Ji, Jj, edge_i, edge_j, n_poses):
@@ -168,6 +189,10 @@ def solve_pgo(
     replicated, every per-edge array lives only on its shard, and the
     whole LM loop runs as one SPMD program with psums at the reduction
     sites (cost, gradient, block diagonal, matvec output).
+
+    `option.robust_kind`/`robust_delta` enable IRLS robust losses
+    (Huber/Cauchy, ops/robust.py) — the standard defence against bad
+    loop closures; `result.cost` is then Sum rho.
     """
     option = option or ProblemOption()
     # f64 only when actually available (x64 enabled) — otherwise warn
@@ -242,7 +267,8 @@ def solve_pgo(
 
         def lin(p):
             return _linearize(p, ei, ej, meas_fm, si_, free_i, free_j,
-                              emask, axis_name)
+                              emask, axis_name,
+                              option.robust_kind, option.robust_delta)
 
         def grad_and_diag(r, Ji, Jj):
             return _grad_and_diag(r, Ji, Jj, ei, ej, n_poses, fixed_j,
@@ -284,12 +310,12 @@ def solve_pgo(
                 solver_opt.refuse_ratio, solver_opt.tol_relative)
             return dx, iters
 
-        r0, Ji0, Jj0, cost0 = lin(poses_fm)
+        r0, Ji0, Jj0, cost0, wcost0 = lin(poses_fm)
         g0, h0 = grad_and_diag(r0, Ji0, Jj0)
         state0 = dict(
             k=jnp.int32(0), accepted=jnp.int32(0), pcg_total=jnp.int32(0),
             poses=poses_fm, r=r0, Ji=Ji0, Jj=Jj0, g=g0, h_rows=h0,
-            cost=cost0,
+            cost=cost0, wcost=wcost0,
             region=jnp.asarray(algo_opt.initial_region, dtype),
             v=jnp.asarray(2.0, dtype), stop=jnp.bool_(False))
 
@@ -315,8 +341,13 @@ def solve_pgo(
             predicted = comp_sum_sq(jdx.reshape(-1))
             if axis_name is not None:
                 predicted = jax.lax.psum(predicted, axis_name)
-            denominator = jnp.minimum(predicted - s["cost"], -_TINY)
-            _, _, _, cost_new = lin(poses_new)
+            # The quadratic model lives in the (robust-)weighted
+            # residuals, so its decrease is measured from the carried
+            # weighted norm; accept uses the true (robustified) cost —
+            # the exact split the BA loop makes (lm.py).  Without a
+            # robust kernel the two coincide.
+            denominator = jnp.minimum(predicted - s["wcost"], -_TINY)
+            _, _, _, cost_new, wcost_new = lin(poses_new)
             rho = (cost_new - s["cost"]) / denominator
             accept = (cost_new < s["cost"]) & (~converged)
 
@@ -329,7 +360,7 @@ def solve_pgo(
             # recomputing.  On reject everything carries over unchanged
             # and the accept-gated stop never fires.
             def _accept_lin(_):
-                r2, Ji2, Jj2, _c = lin(poses_new)
+                r2, Ji2, Jj2, _c, _w = lin(poses_new)
                 g2, h2 = grad_and_diag(r2, Ji2, Jj2)
                 return r2, Ji2, Jj2, g2, h2, jnp.max(jnp.abs(g2))
 
@@ -349,6 +380,7 @@ def solve_pgo(
                 poses=jnp.where(accept, poses_new, s["poses"]),
                 r=r_n, Ji=Ji_n, Jj=Jj_n, g=g_n, h_rows=h_n,
                 cost=jnp.where(accept, cost_new, s["cost"]),
+                wcost=jnp.where(accept, wcost_new, s["wcost"]),
                 region=jnp.where(accept, region_accept,
                                  s["region"] / s["v"]),
                 v=jnp.where(accept, jnp.asarray(2.0, dtype), s["v"] * 2.0),
