@@ -514,7 +514,15 @@ def _jtj_fallback_chunked(J, r, plan: DevicePlan, d: int, od: int,
     seg = (plan.local
            + plan.tile_block.repeat(plan.tile)[None, :] * plan.block)[0]
     n = seg.shape[0]
-    out = jnp.zeros((feat, plan.num_segments), J.dtype)
+    # Derive the accumulator from J so that inside shard_map its
+    # varying-axes type matches the loop body's output (J/r/seg are
+    # device-varying; a plain jnp.zeros carry is replicated-typed and
+    # lax.fori_loop rejects the carry-type mismatch).  isnan keeps the
+    # seed finite-zero even when J[0, 0] is inf/NaN (J * 0 would
+    # broadcast NaN into every accumulator cell) while still making the
+    # value data-dependent for the varying-axes tracer.
+    seed = jnp.isnan(J[0, 0]).astype(J.dtype) * 0
+    out = jnp.zeros((feat, plan.num_segments), J.dtype) + seed
 
     def rows_of(Jc, rc):
         return jnp.concatenate([
