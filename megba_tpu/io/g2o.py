@@ -37,19 +37,18 @@ info factors cleanly; ||W r||^2 = r^T Omega r).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, TextIO, Union
+from typing import Optional, TextIO, Union
 
 import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from megba_tpu.ops import geo
 
 # Our residual row order is [rotation (log map), translation]; g2o's is
 # [translation, quaternion vector].  _PERM maps our row a to g2o row
 # _PERM[a].
 _PERM = np.array([3, 4, 5, 0, 1, 2])
+
+# Row/col pairs of the g2o upper-triangular info serialization, row
+# major: (0,0) (0,1) ... (0,5) (1,1) ... (5,5).
+_TRIU = np.triu_indices(6)
 
 
 @dataclasses.dataclass
@@ -72,62 +71,90 @@ class G2OGraph:
     se2: bool = False
 
 
-def _upper_tri_to_full(vals: Sequence[float], n: int) -> np.ndarray:
-    m = np.zeros((n, n))
-    k = 0
-    for a in range(n):
-        for b in range(a, n):
-            m[a, b] = m[b, a] = vals[k]
-            k += 1
+def _upper_tri_to_full_batch(tri: np.ndarray, n: int = 6) -> np.ndarray:
+    """[..., n(n+1)/2] row-major upper-tri values -> [..., n, n] full."""
+    rows, cols = np.triu_indices(n)
+    m = np.zeros((*tri.shape[:-1], n, n))
+    m[..., rows, cols] = tri
+    m[..., cols, rows] = tri
     return m
 
 
 def _quat_xyzw_to_aa(q_xyzw: np.ndarray) -> np.ndarray:
-    """[..., 4] (qx,qy,qz,qw) -> [..., 3] angle-axis (host-side)."""
-    q_wxyz = np.concatenate([q_xyzw[..., 3:4], q_xyzw[..., :3]], axis=-1)
-    return np.asarray(
-        jax.vmap(geo.quaternion_to_angle_axis)(
-            jnp.asarray(q_wxyz.reshape(-1, 4))),
-        dtype=np.float64).reshape(*q_xyzw.shape[:-1], 3)
+    """[..., 4] (qx,qy,qz,qw) -> [..., 3] angle-axis.
+
+    Pure vectorised numpy (host-side parse path — a JAX dispatch per
+    file costs more than the whole parse): angle = 2 atan2(||v||, w)
+    with the small-angle series 2/w * (1 - ||v||^2 / (3 w^2)) guard,
+    matching ops/geo.quaternion_to_angle_axis (verified by round-trip
+    tests against it).
+    """
+    q = np.asarray(q_xyzw, np.float64)
+    v = q[..., :3]
+    w = q[..., 3]
+    # Fold the double cover exactly as geo.quaternion_to_angle_axis:
+    # q and -q are the same rotation; taking w >= 0 keeps the returned
+    # angle on the principal branch [0, pi] (otherwise w < 0 inputs
+    # come back with norm in (pi, 2pi], up to the exp-map singularity).
+    v = np.where(w[..., None] < 0, -v, v)
+    w = np.abs(w)
+    s2 = np.einsum("...i,...i->...", v, v)
+    s = np.sqrt(s2)
+    big = s > 1e-8
+    with np.errstate(invalid="ignore", divide="ignore"):
+        k_big = 2.0 * np.arctan2(s, w) / np.where(big, s, 1.0)
+    w_safe = np.where(w == 0.0, 1.0, w)
+    k_small = 2.0 / w_safe * (1.0 - s2 / (3.0 * w_safe * w_safe))
+    k = np.where(big, k_big, k_small)
+    return v * k[..., None]
 
 
 def _aa_to_quat_xyzw(aa: np.ndarray) -> np.ndarray:
-    """[..., 3] angle-axis -> [..., 4] (qx,qy,qz,qw) via R (host-side)."""
-    q_wxyz = np.asarray(
-        jax.vmap(lambda a: geo.rotation_matrix_to_quaternion(
-            geo.angle_axis_to_rotation_matrix(a)))(
-                jnp.asarray(aa.reshape(-1, 3))),
-        dtype=np.float64)
+    """[..., 3] angle-axis -> [..., 4] (qx,qy,qz,qw), vectorised numpy.
+
+    q = [sin(theta/2) axis, cos(theta/2)]; the small-angle branch uses
+    sin(x)/x ~= 1/2 - theta^2/48 on the half angle.
+    """
+    a = np.asarray(aa, np.float64)
+    theta2 = np.einsum("...i,...i->...", a, a)
+    theta = np.sqrt(theta2)
+    big = theta > 1e-8
+    with np.errstate(invalid="ignore", divide="ignore"):
+        k_big = np.sin(theta / 2.0) / np.where(big, theta, 1.0)
+    k = np.where(big, k_big, 0.5 - theta2 / 48.0)
     return np.concatenate(
-        [q_wxyz[:, 1:4], q_wxyz[:, 0:1]],
-        axis=-1).reshape(*aa.shape[:-1], 4)
+        [a * k[..., None], np.cos(theta / 2.0)[..., None]], axis=-1)
+
+
+_CHART_SCALE = np.array([0.5, 0.5, 0.5, 1.0, 1.0, 1.0])
 
 
 def _info_g2o_to_ours(info_g2o: np.ndarray) -> np.ndarray:
-    """Permute [t, q] -> [rot, t] and apply the dq = d(aa)/2 chart."""
-    m = info_g2o[np.ix_(_PERM, _PERM)]
-    scale = np.array([0.5, 0.5, 0.5, 1.0, 1.0, 1.0])
-    return m * scale[:, None] * scale[None, :]
+    """Permute [t, q] -> [rot, t] and apply the dq = d(aa)/2 chart.
+
+    Batched: works on [..., 6, 6].
+    """
+    m = info_g2o[..., _PERM[:, None], _PERM[None, :]]
+    return m * _CHART_SCALE[:, None] * _CHART_SCALE[None, :]
 
 
 def _info_ours_to_g2o(info_ours: np.ndarray) -> np.ndarray:
     inv = np.argsort(_PERM)
-    scale = np.array([0.5, 0.5, 0.5, 1.0, 1.0, 1.0])
-    m = info_ours / (scale[:, None] * scale[None, :])
-    return m[np.ix_(inv, inv)]
+    m = info_ours / (_CHART_SCALE[:, None] * _CHART_SCALE[None, :])
+    return m[..., inv[:, None], inv[None, :]]
 
 
 def _lift_se2_info(info3: np.ndarray) -> np.ndarray:
-    """SE(2) info over (x, y, theta) -> our 6x6 [rot, t] order.
+    """SE(2) info over (x, y, theta) [..., 3, 3] -> our 6x6 [rot, t].
 
     In-plane entries land on rows [rz(=2), tx(=3), ty(=4)]; the three
     out-of-plane rows (rx, ry, tz) get unit weight so lifted edges pin
     relative out-of-plane motion to zero.
     """
-    out = np.eye(6)
+    out = np.tile(np.eye(6), (*info3.shape[:-2], 1, 1))
     # our row indices: theta -> 2 (z rotation), x -> 3, y -> 4
     idx = np.array([3, 4, 2])  # g2o (x, y, theta) -> our rows
-    out[np.ix_(idx, idx)] = info3
+    out[..., idx[:, None], idx[None, :]] = info3
     return out
 
 
@@ -137,13 +164,16 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
         with open(source) as f:
             return read_g2o(f)
 
-    # Parse into flat host lists first; the quaternion -> angle-axis
-    # conversions happen ONCE on the batched arrays afterwards (a vmap
-    # dispatch per line would cost a blocking JAX round-trip each on
-    # files with thousands of records).
-    verts: dict[int, np.ndarray] = {}  # vid -> [t(3), quat_xyzw(4)]
+    # Parse into flat per-tag token lists first; ALL numeric work (float
+    # conversion, tri -> full info expansion, permutation/chart, quat ->
+    # angle-axis) happens once on batched numpy arrays afterwards — a
+    # per-line conversion costs more than the whole batched pass on
+    # files with tens of thousands of records.
+    verts: dict[int, tuple[bool, list]] = {}  # vid -> (is_se2, tokens)
     fixed_ids: set[int] = set()
-    edges: list[tuple[int, int, np.ndarray, np.ndarray]] = []  # raw 7 + info
+    e_ids: list[tuple[int, int]] = []
+    e_se2: list[bool] = []
+    e_vals: list[list] = []  # SE3: 28 tokens; SE2: 9 tokens
     se2_seen = False
     se3_seen = False
 
@@ -153,45 +183,36 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             continue
         tag = tok[0]
         if tag == "VERTEX_SE3:QUAT":
-            vals = np.array([float(x) for x in tok[2:]])
-            if vals.shape[0] != 7:
+            if len(tok) != 9:
                 raise ValueError(
                     f"line {ln}: VERTEX_SE3:QUAT needs 7 values "
-                    f"(x y z qx qy qz qw), got {vals.shape[0]}")
-            verts[int(tok[1])] = vals
+                    f"(x y z qx qy qz qw), got {len(tok) - 2}")
+            verts[int(tok[1])] = (False, tok[2:])
             se3_seen = True
         elif tag == "VERTEX_SE2":
             if len(tok) != 5:
                 raise ValueError(
                     f"line {ln}: VERTEX_SE2 needs 3 values (x y theta), "
                     f"got {len(tok) - 2}")
-            x, y, th = (float(v) for v in tok[2:5])
-            # theta as a z-axis quaternion, converted with the batch.
-            verts[int(tok[1])] = np.array([x, y, 0.0, 0.0, 0.0,
-                                           np.sin(th / 2), np.cos(th / 2)])
+            verts[int(tok[1])] = (True, tok[2:])
             se2_seen = True
         elif tag == "EDGE_SE3:QUAT":
-            i, j = int(tok[1]), int(tok[2])
-            vals = np.array([float(x) for x in tok[3:]])
-            if vals.shape[0] != 7 + 21:
+            if len(tok) != 3 + 7 + 21:
                 raise ValueError(
                     f"line {ln}: EDGE_SE3:QUAT needs 7 measurement + 21 "
-                    f"info values, got {vals.shape[0]}")
-            info = _info_g2o_to_ours(_upper_tri_to_full(vals[7:], 6))
-            edges.append((i, j, vals[:7], info))
+                    f"info values, got {len(tok) - 3}")
+            e_ids.append((int(tok[1]), int(tok[2])))
+            e_se2.append(False)
+            e_vals.append(tok[3:])
             se3_seen = True
         elif tag == "EDGE_SE2":
-            i, j = int(tok[1]), int(tok[2])
-            vals = np.array([float(x) for x in tok[3:]])
-            if vals.shape[0] != 3 + 6:
+            if len(tok) != 3 + 3 + 6:
                 raise ValueError(
                     f"line {ln}: EDGE_SE2 needs 3 measurement + 6 info "
-                    f"values, got {vals.shape[0]}")
-            dx, dy, dth = vals[:3]
-            raw = np.array([dx, dy, 0.0, 0.0, 0.0,
-                            np.sin(dth / 2), np.cos(dth / 2)])
-            info = _lift_se2_info(_upper_tri_to_full(vals[3:], 3))
-            edges.append((i, j, raw, info))
+                    f"values, got {len(tok) - 3}")
+            e_ids.append((int(tok[1]), int(tok[2])))
+            e_se2.append(True)
+            e_vals.append(tok[3:])
             se2_seen = True
         elif tag == "FIX":
             fixed_ids.update(int(t) for t in tok[1:])
@@ -203,25 +224,60 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
         raise ValueError("no supported VERTEX records found")
     ids = np.array(sorted(verts), dtype=np.int64)
     index = {vid: k for k, vid in enumerate(ids)}
-    raw_v = np.stack([verts[vid] for vid in ids])  # [N, 7]
+
+    def split_rows(flags, toks, width_se3, width_se2):
+        """Mixed SE3/SE2 token rows -> ([n,7] pose raw, per-kind floats).
+
+        The [n, 7] form is [t(3), quat_xyzw(4)] with SE2 thetas encoded
+        as z-axis quaternions.  Float conversion happens in ONE numpy
+        call per kind (C-level string parsing).
+        """
+        flags = np.asarray(flags, bool)
+        se3_rows = np.nonzero(~flags)[0]
+        se2_rows = np.nonzero(flags)[0]
+        se3_raw = np.asarray(
+            [toks[k] for k in se3_rows], np.float64).reshape(-1, width_se3)
+        se2_raw = np.asarray(
+            [toks[k] for k in se2_rows], np.float64).reshape(-1, width_se2)
+        raw7 = np.zeros((len(toks), 7))
+        raw7[:, 6] = 1.0  # identity quaternion default
+        raw7[se3_rows] = se3_raw[:, :7]
+        raw7[se2_rows, 0] = se2_raw[:, 0]
+        raw7[se2_rows, 1] = se2_raw[:, 1]
+        raw7[se2_rows, 5] = np.sin(se2_raw[:, 2] / 2)
+        raw7[se2_rows, 6] = np.cos(se2_raw[:, 2] / 2)
+        return raw7, se3_raw, se2_raw, se3_rows, se2_rows
+
+    raw_v, _, _, _, _ = split_rows(
+        [verts[vid][0] for vid in ids],
+        [verts[vid][1] for vid in ids], 7, 3)
     poses = np.concatenate(
         [_quat_xyzw_to_aa(raw_v[:, 3:7]), raw_v[:, :3]], axis=1)
 
-    n_e = len(edges)
-    edge_i = np.zeros(n_e, np.int32)
-    edge_j = np.zeros(n_e, np.int32)
-    raw_e = np.zeros((n_e, 7))
-    info = np.zeros((n_e, 6, 6))
-    for k, (i, j, raw, om) in enumerate(edges):
-        if i not in index or j not in index:
-            raise ValueError(f"edge ({i}, {j}) references unknown vertex")
-        edge_i[k] = index[i]
-        edge_j[k] = index[j]
-        raw_e[k] = raw
-        info[k] = om
-    meas = (np.concatenate(
-        [_quat_xyzw_to_aa(raw_e[:, 3:7]), raw_e[:, :3]], axis=1)
-        if n_e else np.zeros((0, 6)))
+    n_e = len(e_ids)
+    try:
+        edge_i = np.asarray([index[i] for i, _ in e_ids],
+                            np.int32).reshape(n_e)
+        edge_j = np.asarray([index[j] for _, j in e_ids],
+                            np.int32).reshape(n_e)
+    except KeyError as exc:
+        raise ValueError(
+            f"edge references unknown vertex {exc.args[0]}") from None
+    if n_e:
+        raw_e, se3_raw, se2_raw, se3_rows, se2_rows = split_rows(
+            e_se2, e_vals, 28, 9)
+        meas = np.concatenate(
+            [_quat_xyzw_to_aa(raw_e[:, 3:7]), raw_e[:, :3]], axis=1)
+        info = np.zeros((n_e, 6, 6))
+        if se3_rows.size:
+            info[se3_rows] = _info_g2o_to_ours(
+                _upper_tri_to_full_batch(se3_raw[:, 7:], 6))
+        if se2_rows.size:
+            info[se2_rows] = _lift_se2_info(
+                _upper_tri_to_full_batch(se2_raw[:, 3:], 3))
+    else:
+        meas = np.zeros((0, 6))
+        info = np.zeros((0, 6, 6))
 
     fixed = np.zeros(len(ids), bool)
     for vid in fixed_ids:
@@ -260,12 +316,11 @@ def write_g2o(dest: Union[str, TextIO], graph: G2OGraph,
         if graph.fixed[k]:
             dest.write(f"FIX {int(graph.ids[k])}\n")
     meas_q = _aa_to_quat_xyzw(graph.meas[:, :3])
+    tri_all = _info_ours_to_g2o(graph.info)[:, _TRIU[0], _TRIU[1]]
     for e in range(graph.edge_i.shape[0]):
         m_t = graph.meas[e, 3:]
         q = meas_q[e]
-        om = _info_ours_to_g2o(graph.info[e])
-        tri = " ".join(
-            f"{om[a, b]:.9g}" for a in range(6) for b in range(a, 6))
+        tri = " ".join(f"{v:.9g}" for v in tri_all[e])
         dest.write(
             f"EDGE_SE3:QUAT {int(graph.ids[graph.edge_i[e]])} "
             f"{int(graph.ids[graph.edge_j[e]])} "

@@ -193,3 +193,31 @@ EDGE_SE2 4 7 1 0 0 1 0 0 1 0 1
     np.testing.assert_array_equal(graph.ids, [4, 7])
     # No FIX line -> lowest-id vertex anchors the gauge.
     np.testing.assert_array_equal(graph.fixed, [True, False])
+
+
+def test_negative_w_quaternions_fold_to_principal_branch():
+    """q and -q are the same rotation; exporters emit either sign.
+
+    The parser must fold w < 0 inputs onto the principal angle-axis
+    branch [0, pi] exactly like ops/geo.quaternion_to_angle_axis
+    (negating produces norm in (pi, 2pi] and a discontinuity at the
+    ||aa|| = 2pi exp-map singularity for near-identity rotations).
+    """
+    from megba_tpu.io.g2o import _quat_xyzw_to_aa
+
+    rng = np.random.default_rng(5)
+    q = rng.standard_normal((64, 4))
+    q /= np.linalg.norm(q, axis=1, keepdims=True)
+    aa_pos = _quat_xyzw_to_aa(q)
+    aa_neg = _quat_xyzw_to_aa(-q)
+    np.testing.assert_allclose(aa_neg, aa_pos, atol=1e-12)
+    assert float(np.linalg.norm(aa_pos, axis=1).max()) <= np.pi + 1e-12
+    # Matches the geo implementation it claims to mirror.
+    ref = np.asarray(jax.vmap(geo.quaternion_to_angle_axis)(
+        jnp.asarray(np.concatenate([q[:, 3:4], q[:, :3]], axis=1))))
+    np.testing.assert_allclose(aa_pos, ref, atol=1e-6)
+    # Near-identity negative-w quaternions stay near zero, both sides
+    # of the small-angle branch.
+    for eps in (1e-9, 2e-8, 1e-6):
+        aa = _quat_xyzw_to_aa(np.array([eps, 0.0, 0.0, -1.0]))
+        assert float(np.linalg.norm(aa)) < 1e-5, (eps, aa)
