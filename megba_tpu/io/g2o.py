@@ -41,6 +41,8 @@ from typing import Optional, TextIO, Union
 
 import numpy as np
 
+from megba_tpu.core.host_se3 import aa_to_quat, quat_to_aa
+
 # Our residual row order is [rotation (log map), translation]; g2o's is
 # [translation, quaternion vector].  _PERM maps our row a to g2o row
 # _PERM[a].
@@ -80,50 +82,10 @@ def _upper_tri_to_full_batch(tri: np.ndarray, n: int = 6) -> np.ndarray:
     return m
 
 
-def _quat_xyzw_to_aa(q_xyzw: np.ndarray) -> np.ndarray:
-    """[..., 4] (qx,qy,qz,qw) -> [..., 3] angle-axis.
-
-    Pure vectorised numpy (host-side parse path — a JAX dispatch per
-    file costs more than the whole parse): angle = 2 atan2(||v||, w)
-    with the small-angle series 2/w * (1 - ||v||^2 / (3 w^2)) guard,
-    matching ops/geo.quaternion_to_angle_axis (verified by round-trip
-    tests against it).
-    """
-    q = np.asarray(q_xyzw, np.float64)
-    v = q[..., :3]
-    w = q[..., 3]
-    # Fold the double cover exactly as geo.quaternion_to_angle_axis:
-    # q and -q are the same rotation; taking w >= 0 keeps the returned
-    # angle on the principal branch [0, pi] (otherwise w < 0 inputs
-    # come back with norm in (pi, 2pi], up to the exp-map singularity).
-    v = np.where(w[..., None] < 0, -v, v)
-    w = np.abs(w)
-    s2 = np.einsum("...i,...i->...", v, v)
-    s = np.sqrt(s2)
-    big = s > 1e-8
-    with np.errstate(invalid="ignore", divide="ignore"):
-        k_big = 2.0 * np.arctan2(s, w) / np.where(big, s, 1.0)
-    w_safe = np.where(w == 0.0, 1.0, w)
-    k_small = 2.0 / w_safe * (1.0 - s2 / (3.0 * w_safe * w_safe))
-    k = np.where(big, k_big, k_small)
-    return v * k[..., None]
-
-
-def _aa_to_quat_xyzw(aa: np.ndarray) -> np.ndarray:
-    """[..., 3] angle-axis -> [..., 4] (qx,qy,qz,qw), vectorised numpy.
-
-    q = [sin(theta/2) axis, cos(theta/2)]; the small-angle branch uses
-    sin(x)/x ~= 1/2 - theta^2/48 on the half angle.
-    """
-    a = np.asarray(aa, np.float64)
-    theta2 = np.einsum("...i,...i->...", a, a)
-    theta = np.sqrt(theta2)
-    big = theta > 1e-8
-    with np.errstate(invalid="ignore", divide="ignore"):
-        k_big = np.sin(theta / 2.0) / np.where(big, theta, 1.0)
-    k = np.where(big, k_big, 0.5 - theta2 / 48.0)
-    return np.concatenate(
-        [a * k[..., None], np.cos(theta / 2.0)[..., None]], axis=-1)
+# Vectorised host-side chart maps (shared with the synthetic pose-graph
+# generator; see core/host_se3.py for the branch/double-cover details).
+_quat_xyzw_to_aa = quat_to_aa
+_aa_to_quat_xyzw = aa_to_quat
 
 
 _CHART_SCALE = np.array([0.5, 0.5, 0.5, 1.0, 1.0, 1.0])

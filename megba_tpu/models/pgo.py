@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from megba_tpu.common import ProblemOption
 from megba_tpu.core.fm import segsum_fm
+from megba_tpu.core.host_se3 import compose, relative
 from megba_tpu.core.types import pad_edges
 from megba_tpu.parallel.mesh import EDGE_AXIS, make_mesh
 from megba_tpu.ops import geo
@@ -433,24 +434,6 @@ class SyntheticPoseGraph:
     meas: np.ndarray  # [nE, 6]
 
 
-def _compose(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """T_a ∘ T_b in [aa, t] coordinates (numpy, host-side)."""
-    Ra = np.asarray(geo.angle_axis_to_rotation_matrix(jnp.asarray(a[:3])))
-    Rb = np.asarray(geo.angle_axis_to_rotation_matrix(jnp.asarray(b[:3])))
-    R = Ra @ Rb
-    aa = np.asarray(geo.rotation_matrix_to_angle_axis(jnp.asarray(R)))
-    return np.concatenate([aa, Ra @ b[3:] + a[3:]])
-
-
-def _relative(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """T_a^{-1} ∘ T_b in [aa, t] coordinates."""
-    Ra = np.asarray(geo.angle_axis_to_rotation_matrix(jnp.asarray(a[:3])))
-    Rb = np.asarray(geo.angle_axis_to_rotation_matrix(jnp.asarray(b[:3])))
-    R = Ra.T @ Rb
-    aa = np.asarray(geo.rotation_matrix_to_angle_axis(jnp.asarray(R)))
-    return np.concatenate([aa, Ra.T @ (b[3:] - a[3:])])
-
-
 def make_synthetic_pose_graph(
     num_poses: int = 32,
     loop_closures: int = 6,
@@ -462,14 +445,17 @@ def make_synthetic_pose_graph(
 
     Measurements are exact relative poses (+ optional noise); the init
     integrates NOISY odometry, so it drifts — the classic PGO setting
-    where loop closures pull the chain back onto the circle.
+    where loop closures pull the chain back onto the circle.  All host
+    math is batched numpy (core/host_se3.py), so generation scales to
+    100k+ poses.
     """
     rng = np.random.default_rng(seed)
+    th = 2 * np.pi * np.arange(num_poses) / num_poses
     poses_gt = np.zeros((num_poses, 6))
-    for k in range(num_poses):
-        th = 2 * np.pi * k / num_poses
-        poses_gt[k, :3] = [0.0, 0.0, th]
-        poses_gt[k, 3:] = [np.cos(th), np.sin(th), 0.05 * np.sin(3 * th)]
+    poses_gt[:, 2] = th
+    poses_gt[:, 3] = np.cos(th)
+    poses_gt[:, 4] = np.sin(th)
+    poses_gt[:, 5] = 0.05 * np.sin(3 * th)
 
     ei = list(range(num_poses - 1))
     ej = list(range(1, num_poses))
@@ -480,16 +466,14 @@ def make_synthetic_pose_graph(
         ej.append(b)
     ei, ej = np.asarray(ei, np.int32), np.asarray(ej, np.int32)
 
-    meas = np.stack([
-        _relative(poses_gt[a], poses_gt[b])
-        + meas_noise * rng.standard_normal(6)
-        for a, b in zip(ei, ej)])
+    meas = (relative(poses_gt[ei], poses_gt[ej])
+            + meas_noise * rng.standard_normal((len(ei), 6)))
 
     poses0 = poses_gt.copy()
     cur = poses_gt[0].copy()
+    odo_noise = drift_noise * rng.standard_normal((num_poses - 1, 6))
     for k in range(1, num_poses):
-        odo = meas[k - 1] + drift_noise * rng.standard_normal(6)
-        cur = _compose(cur, odo)
+        cur = compose(cur, meas[k - 1] + odo_noise[k - 1])
         poses0[k] = cur
     return SyntheticPoseGraph(
         poses_gt=poses_gt, poses0=poses0, edge_i=ei, edge_j=ej, meas=meas)

@@ -1,0 +1,79 @@
+"""Host-side (numpy) SE(3) helpers vs the device-side geo (jax) ops.
+
+core/host_se3.py is the batched numpy math the IO/problem-construction
+paths use; its charts must agree with ops/geo.py, which the solver
+differentiates through.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.core import host_se3
+from megba_tpu.ops import geo
+
+
+def _rand_poses(rng, n, scale=2.0):
+    p = rng.standard_normal((n, 6))
+    p[:, :3] *= scale  # rotation angles across both |aa| branches
+    return p
+
+
+def test_charts_match_geo():
+    rng = np.random.default_rng(0)
+    aa = np.concatenate([
+        rng.standard_normal((40, 3)) * 2.0,
+        rng.standard_normal((10, 3)) * 1e-9,  # small-angle branch
+        np.zeros((1, 3)),
+    ])
+    q = host_se3.aa_to_quat(aa)
+    # Unit norm and w >= 0 convention on the way back.
+    np.testing.assert_allclose(np.linalg.norm(q, axis=-1), 1.0, rtol=1e-12)
+    back = host_se3.quat_to_aa(q)
+    # |aa| <= pi round-trips exactly; larger angles fold to the
+    # principal branch — compare as rotations via geo.
+    R1 = np.asarray(jax.vmap(geo.angle_axis_to_rotation_matrix)(
+        jnp.asarray(aa)))
+    R2 = np.asarray(jax.vmap(geo.angle_axis_to_rotation_matrix)(
+        jnp.asarray(back)))
+    np.testing.assert_allclose(R1, R2, atol=1e-7)
+    # quat chart agrees with geo's quaternion_to_angle_axis (wxyz).
+    q_wxyz = np.concatenate([q[:, 3:4], q[:, :3]], axis=1)
+    ref = np.asarray(jax.vmap(geo.quaternion_to_angle_axis)(
+        jnp.asarray(q_wxyz)))
+    np.testing.assert_allclose(back, ref, atol=1e-6)
+
+
+def test_compose_relative_consistency():
+    rng = np.random.default_rng(1)
+    a = _rand_poses(rng, 32)
+    b = _rand_poses(rng, 32)
+    ab = host_se3.compose(a, b)
+    # relative(a, compose(a, b)) == b as SE(3) elements.
+    rel = host_se3.relative(a, ab)
+    Rb = np.asarray(jax.vmap(geo.angle_axis_to_rotation_matrix)(
+        jnp.asarray(b[:, :3])))
+    Rr = np.asarray(jax.vmap(geo.angle_axis_to_rotation_matrix)(
+        jnp.asarray(rel[:, :3])))
+    np.testing.assert_allclose(Rr, Rb, atol=1e-9)
+    np.testing.assert_allclose(rel[:, 3:], b[:, 3:], atol=1e-9)
+    # relative(a, b) agrees with the solver's between_residual zero:
+    # between_residual(a, b, relative(a, b)) == 0.
+    from megba_tpu.models.pgo import between_residual
+
+    r = jax.vmap(between_residual)(
+        jnp.asarray(a), jnp.asarray(b),
+        jnp.asarray(host_se3.relative(a, b)))
+    assert float(jnp.max(jnp.abs(r))) < 1e-9
+
+
+def test_quat_rotate_matches_matrix():
+    rng = np.random.default_rng(2)
+    aa = rng.standard_normal((16, 3)) * 2.0
+    v = rng.standard_normal((16, 3))
+    R = np.asarray(jax.vmap(geo.angle_axis_to_rotation_matrix)(
+        jnp.asarray(aa)))
+    out = host_se3.quat_rotate(host_se3.aa_to_quat(aa), v)
+    np.testing.assert_allclose(out, np.einsum("nij,nj->ni", R, v),
+                               atol=1e-10)
