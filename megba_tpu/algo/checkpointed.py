@@ -1,11 +1,18 @@
-"""Preemption-safe solve driver: LM in chunks with on-disk snapshots.
+"""Preemption-safe solve drivers: LM in chunks with on-disk snapshots.
 
 Capability the reference does NOT have (SURVEY.md §5.3/5.4: no failure
 recovery, no disk checkpointing — a crash loses the job).  The jitted LM
 loop runs in chunks of `checkpoint_every` iterations; between chunks the
 full resume state (parameters + trust region + back-off factor +
-iteration count) is written atomically, and `solve_checkpointed` resumes
-from an existing snapshot transparently — the TPU-pod preemption norm.
+iteration count) is written atomically, and the drivers resume from an
+existing snapshot transparently — the TPU-pod preemption norm.
+
+One generic chunk loop (`_run_chunked`) serves both model families:
+`solve_checkpointed` (BA, through the shared flat_solve pipeline so all
+chunks of one configuration reuse ONE compiled program) and
+`solve_pgo_checkpointed` (SE(3) pose graphs — same property via
+models/pgo's cached program; the resume state rides as dynamic
+operands in both).
 """
 
 from __future__ import annotations
@@ -38,6 +45,104 @@ def _topology_fingerprint(cameras, points, cam_idx, pt_idx) -> np.ndarray:
          h(cam_idx), h(pt_idx)], np.int64)
 
 
+def _replace(result, **fields):
+    """dataclasses.replace / NamedTuple._replace, whichever applies."""
+    if dataclasses.is_dataclass(result):
+        return dataclasses.replace(result, **fields)
+    return result._replace(**fields)
+
+
+def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
+                 total, checkpoint_path, checkpoint_every):
+    """The shared chunk loop: resume, solve in chunks, snapshot, aggregate.
+
+    `solve_chunk(params, max_iter, region, v) -> (result, new_params)`
+    runs up to `max_iter` LM iterations from `params` with the given
+    trust-region resume state (None, None on a fresh start).  `result`
+    must expose cost / initial_cost / region / v / iterations / accepted
+    / pcg_iterations / stopped.  `dump_params(params)` returns the two
+    arrays the snapshot format stores; `load_params(st)` inverts it.
+    """
+    if checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    done = 0
+    region = None
+    v = None
+    accepted_total = 0
+    pcg_total = 0
+    first_cost = None
+    already_stopped = False
+
+    # Problem identity guard: a stale/foreign snapshot with mismatched
+    # shapes would otherwise be resumed silently (jnp.take clamps
+    # out-of-range indices instead of erroring) and yield garbage.  The
+    # graph topology is summarised by a cheap order-sensitive hash of
+    # the index arrays, not just the counts.
+    if os.path.exists(checkpoint_path):
+        st = load_state(checkpoint_path)
+        saved_topo = st.get("extra_topology")
+        if saved_topo is None or not np.array_equal(
+                np.asarray(saved_topo), topo):
+            raise ValueError(
+                f"checkpoint {checkpoint_path!r} was written for a "
+                f"different problem (topology fingerprint "
+                f"{None if saved_topo is None else np.asarray(saved_topo).tolist()} "
+                f"!= {topo.tolist()}); refusing to resume — delete the "
+                "snapshot or point checkpoint_path elsewhere")
+        params = load_params(st)
+        region = float(st["region"])
+        v = float(st["extra_v"])
+        done = int(st["iteration"])
+        accepted_total = int(st.get("extra_accepted", 0))
+        pcg_total = int(st.get("extra_pcg", 0))
+        if "extra_first_cost" in st:
+            first_cost = jnp.asarray(st["extra_first_cost"])
+        already_stopped = bool(st.get("extra_stopped", False))
+
+    result = None
+    while not already_stopped and done < total:
+        chunk = min(checkpoint_every, total - done)
+        result, params = solve_chunk(params, chunk, region, v)
+        region = float(result.region)
+        v = float(result.v)
+        if first_cost is None:
+            first_cost = result.initial_cost
+        accepted_total += int(result.accepted)
+        pcg_total += int(result.pcg_iterations)
+        ran = int(result.iterations)
+        done += ran
+        stopped = bool(result.stopped) or ran < chunk
+        arr_a, arr_b = dump_params(params)
+        save_state(
+            checkpoint_path, arr_a, arr_b,
+            region=region, cost=float(result.cost), iteration=done,
+            extra={"v": np.asarray(v),
+                   "accepted": np.asarray(accepted_total),
+                   "pcg": np.asarray(pcg_total),
+                   "first_cost": np.asarray(float(first_cost)),
+                   "stopped": np.asarray(stopped),
+                   "topology": topo})
+        if stopped:
+            break  # converged (possibly exactly on the chunk boundary)
+
+    if result is None:  # resumed at/past total (or converged): evaluate
+        result, params = solve_chunk(params, 0, region, v)
+        if first_cost is None:
+            first_cost = result.initial_cost
+        if already_stopped:
+            result = _replace(result, stopped=jnp.bool_(True))
+
+    # Report whole-solve aggregates, not last-chunk ones.
+    return _replace(
+        result,
+        initial_cost=first_cost,
+        iterations=jnp.asarray(done, jnp.int32),
+        accepted=jnp.asarray(accepted_total, jnp.int32),
+        pcg_iterations=jnp.asarray(pcg_total, jnp.int32),
+    )
+
+
 def solve_checkpointed(
     residual_jac_fn,
     cameras,
@@ -51,104 +156,86 @@ def solve_checkpointed(
     verbose: bool = False,
     **solve_kwargs,
 ) -> LMResult:
-    """Run the LM solve, snapshotting every `checkpoint_every` iterations.
+    """Run the BA LM solve, snapshotting every `checkpoint_every` iters.
 
     If `checkpoint_path` exists, resumes from it (same problem assumed).
     Runs through the shared flat_solve pipeline, so all chunks of the
-    same configuration reuse ONE compiled program (the resume state rides
-    as dynamic operands).  Extra kwargs flow to `solve.flat_solve`
+    same configuration reuse ONE compiled program (the resume state
+    rides as dynamic operands).  Extra kwargs flow to `solve.flat_solve`
     (sqrt_info, cam_fixed, pt_fixed, use_tiled...).
     """
     from megba_tpu.solve import flat_solve
-    if checkpoint_every < 1:
-        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
-    total = option.algo_option.max_iter
-    done = 0
-    region = None
-    v = None
-    accepted_total = 0
-    pcg_total = 0
-    first_cost = None
-    already_stopped = False
 
-    # Problem identity guard: a stale/foreign snapshot with mismatched
-    # shapes would otherwise be resumed silently (jnp.take clamps
-    # out-of-range indices instead of erroring) and yield garbage.  The
-    # graph topology is summarised by a cheap order-sensitive hash of the
-    # index arrays, not just the counts.
-    topo = _topology_fingerprint(cameras, points, cam_idx, pt_idx)
+    cam_dtype = cameras.dtype
+    pt_dtype = points.dtype
 
-    if os.path.exists(checkpoint_path):
-        st = load_state(checkpoint_path)
-        saved_topo = st.get("extra_topology")
-        if saved_topo is None or not np.array_equal(np.asarray(saved_topo), topo):
-            raise ValueError(
-                f"checkpoint {checkpoint_path!r} was written for a different "
-                f"problem (topology fingerprint "
-                f"{None if saved_topo is None else np.asarray(saved_topo).tolist()} "
-                f"!= {topo.tolist()}); refusing to resume — delete the "
-                "snapshot or point checkpoint_path elsewhere")
-        cameras = jnp.asarray(st["cameras"], cameras.dtype)
-        points = jnp.asarray(st["points"], points.dtype)
-        region = float(st["region"])
-        v = float(st["extra_v"])
-        done = int(st["iteration"])
-        accepted_total = int(st.get("extra_accepted", 0))
-        pcg_total = int(st.get("extra_pcg", 0))
-        if "extra_first_cost" in st:
-            first_cost = jnp.asarray(st["extra_first_cost"])
-        already_stopped = bool(st.get("extra_stopped", False))
-
-    result = None
-    while not already_stopped and done < total:
-        chunk = min(checkpoint_every, total - done)
+    def solve_chunk(params, max_iter, region, v):
+        cams, pts = params
         chunk_option = dataclasses.replace(
             option,
-            algo_option=dataclasses.replace(option.algo_option, max_iter=chunk),
-        )
+            algo_option=dataclasses.replace(
+                option.algo_option, max_iter=max_iter))
         result = flat_solve(
-            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx,
+            residual_jac_fn, cams, pts, obs, cam_idx, pt_idx,
             chunk_option, verbose=verbose,
             initial_region=region, initial_v=v, **solve_kwargs)
-        cameras, points = result.cameras, result.points
-        region = result.region
-        v = result.v
-        if first_cost is None:
-            first_cost = result.initial_cost
-        accepted_total += int(result.accepted)
-        pcg_total += int(result.pcg_iterations)
-        ran = int(result.iterations)
-        done += ran
-        stopped = bool(result.stopped) or ran < chunk
-        save_state(
-            checkpoint_path, np.asarray(cameras), np.asarray(points),
-            region=float(region), cost=float(result.cost), iteration=done,
-            extra={"v": np.asarray(float(v)),
-                   "accepted": np.asarray(accepted_total),
-                   "pcg": np.asarray(pcg_total),
-                   "first_cost": np.asarray(float(first_cost)),
-                   "stopped": np.asarray(stopped),
-                   "topology": topo})
-        if stopped:
-            break  # converged (possibly exactly on the chunk boundary)
+        return result, (result.cameras, result.points)
 
-    if result is None:  # resumed at/past total (or converged): evaluate state
-        result = flat_solve(
-            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx,
-            dataclasses.replace(
-                option,
-                algo_option=dataclasses.replace(option.algo_option, max_iter=0)),
-            initial_region=region, initial_v=v, verbose=verbose, **solve_kwargs)
-        if first_cost is None:
-            first_cost = result.initial_cost
-        if already_stopped:
-            result = dataclasses.replace(result, stopped=jnp.bool_(True))
+    return _run_chunked(
+        solve_chunk,
+        params=(cameras, points),
+        dump_params=lambda p: (np.asarray(p[0]), np.asarray(p[1])),
+        load_params=lambda st: (jnp.asarray(st["cameras"], cam_dtype),
+                                jnp.asarray(st["points"], pt_dtype)),
+        topo=_topology_fingerprint(cameras, points, cam_idx, pt_idx),
+        total=option.algo_option.max_iter,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every,
+    )
 
-    # Report whole-solve aggregates, not last-chunk ones.
-    return dataclasses.replace(
-        result,
-        initial_cost=first_cost,
-        iterations=jnp.asarray(done, jnp.int32),
-        accepted=jnp.asarray(accepted_total, jnp.int32),
-        pcg_iterations=jnp.asarray(pcg_total, jnp.int32),
+
+def solve_pgo_checkpointed(
+    poses0,
+    edge_i,
+    edge_j,
+    meas,
+    option: ProblemOption,
+    checkpoint_path: str,
+    checkpoint_every: int = 5,
+    verbose: bool = False,
+    **solve_kwargs,
+):
+    """Preemption-safe chunked PGO solve (models/pgo.solve_pgo).
+
+    Same contract as `solve_checkpointed`: chunks of `checkpoint_every`
+    LM iterations, atomic snapshots of the full resume state between
+    chunks, transparent resume after a topology-fingerprint check, and
+    one cached compiled program across chunks (the trust-region state is
+    a dynamic operand of models/pgo's program cache).  Extra kwargs flow
+    to `solve_pgo` (sqrt_info, fixed...).  The pose table reuses the
+    "cameras" slot of the shared snapshot format; "points" carries a
+    placeholder.
+    """
+    from megba_tpu.models.pgo import solve_pgo
+
+    def solve_chunk(params, max_iter, region, v):
+        chunk_option = dataclasses.replace(
+            option,
+            algo_option=dataclasses.replace(
+                option.algo_option, max_iter=max_iter))
+        result = solve_pgo(
+            params, edge_i, edge_j, meas, chunk_option, verbose=verbose,
+            initial_region=region, initial_v=v, **solve_kwargs)
+        return result, np.asarray(result.poses)
+
+    poses = np.asarray(poses0)
+    return _run_chunked(
+        solve_chunk,
+        params=poses,
+        dump_params=lambda p: (np.asarray(p), np.zeros((0, 1))),
+        load_params=lambda st: np.asarray(st["cameras"]),
+        topo=_topology_fingerprint(poses, np.zeros((0, 1)), edge_i, edge_j),
+        total=option.algo_option.max_iter,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every,
     )

@@ -29,6 +29,7 @@ per product, exactly the implicit-Schur playbook of the BA path.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import NamedTuple, Optional
 
 import jax
@@ -73,6 +74,7 @@ class PGOResult(NamedTuple):
     accepted: jax.Array
     pcg_iterations: jax.Array
     region: jax.Array
+    v: jax.Array  # trust-region back-off factor (resume state)
     stopped: jax.Array
 
 
@@ -177,6 +179,8 @@ def solve_pgo(
     sqrt_info: Optional[np.ndarray] = None,
     fixed: Optional[np.ndarray] = None,
     verbose: bool = False,
+    initial_region: Optional[float] = None,
+    initial_v: Optional[float] = None,
 ) -> PGOResult:
     """Solve an SE(3) pose graph.  PUBLIC edge-major boundary.
 
@@ -238,28 +242,65 @@ def solve_pgo(
     si = (None if si_np is None else jnp.asarray(
         np.ascontiguousarray(np.transpose(si_np, (1, 2, 0))), dtype))
 
+    # emask (only when the edge axis was padded) and si (only when the
+    # caller weights edges) ride as optional trailing operands, so the
+    # common unpadded/unweighted solve never pays their multiplies.
+    extra_keys = []
+    extras = []
+    if emask is not None:
+        extra_keys.append("emask")
+        extras.append(emask)
+    if si is not None:
+        extra_keys.append("si")
+        extras.append(si)
+
+    prog, mesh = _pgo_program(option, world, n_poses, np.dtype(dtype),
+                              tuple(extra_keys))
+    region0 = (option.algo_option.initial_region if initial_region is None
+               else initial_region)
+    v0 = 2.0 if initial_v is None else initial_v
+    args = [poses_fm, fixed_j, ei, ej, meas_fm,
+            jnp.asarray(region0, dtype), jnp.asarray(v0, dtype), *extras]
+    if mesh is not None:
+        with jax.default_device(mesh.devices.flat[0]):
+            out = prog(*args)
+    else:
+        out = prog(*args)
+
+    cost0 = out["cost0"]
+    result = PGOResult(
+        poses=jnp.swapaxes(out["poses"], 0, 1),
+        cost=out["cost"], initial_cost=cost0, iterations=out["k"],
+        accepted=out["accepted"], pcg_iterations=out["pcg_total"],
+        region=out["region"], v=out["v"], stopped=out["stop"])
+    if verbose:
+        print(f"PGO: cost {float(cost0):.6e} -> {float(result.cost):.6e} "
+              f"in {int(result.iterations)} LM iters "
+              f"({int(result.accepted)} accepted, "
+              f"{int(result.pcg_iterations)} PCG)", flush=True)
+    return result
+
+
+@functools.lru_cache(maxsize=32)
+def _pgo_program(option: ProblemOption, world: int, n_poses: int,
+                 np_dtype: np.dtype, extra_keys: tuple):
+    """Build (once per configuration) the jitted PGO LM program.
+
+    Returns (program, mesh-or-None).  Cached so repeat solves of one
+    configuration — the checkpointed chunk driver, parameter sweeps —
+    pay tracing + compilation once; the trust-region resume state
+    (region0, v0) rides as DYNAMIC operands, exactly like the BA path's
+    get_or_build_program contract (parallel/mesh.py).  jit handles
+    shape-based re-specialisation internally.
+    """
+    dtype = np_dtype
     algo_opt = option.algo_option
     solver_opt = option.solver_option
     axis_name = EDGE_AXIS if world > 1 else None
 
     from megba_tpu.solver.pcg import _pcg_core, block_inv
 
-    # emask (only when the edge axis was padded) and si (only when the
-    # caller weights edges) ride as optional trailing operands, so the
-    # common unpadded/unweighted solve never pays their multiplies.
-    extra_keys = []
-    extras = []
-    extra_specs = []
-    if emask is not None:
-        extra_keys.append("emask")
-        extras.append(emask)
-        extra_specs.append(P(EDGE_AXIS))
-    if si is not None:
-        extra_keys.append("si")
-        extras.append(si)
-        extra_specs.append(P(None, None, EDGE_AXIS))
-
-    def run(poses_fm, fixed_j, ei, ej, meas_fm, *extras_in):
+    def run(poses_fm, fixed_j, ei, ej, meas_fm, region0, v0, *extras_in):
         kw = dict(zip(extra_keys, extras_in))
         emask = kw.get("emask")
         si_ = kw.get("si")
@@ -317,8 +358,8 @@ def solve_pgo(
             k=jnp.int32(0), accepted=jnp.int32(0), pcg_total=jnp.int32(0),
             poses=poses_fm, r=r0, Ji=Ji0, Jj=Jj0, g=g0, h_rows=h0,
             cost=cost0, wcost=wcost0,
-            region=jnp.asarray(algo_opt.initial_region, dtype),
-            v=jnp.asarray(2.0, dtype), stop=jnp.bool_(False))
+            region=jnp.asarray(region0, dtype),
+            v=jnp.asarray(v0, dtype), stop=jnp.bool_(False))
 
         def cond(s):
             return (s["k"] < algo_opt.max_iter) & (~s["stop"])
@@ -394,33 +435,18 @@ def solve_pgo(
             poses=out["poses"], cost=out["cost"], cost0=cost0,
             k=out["k"], accepted=out["accepted"],
             pcg_total=out["pcg_total"], region=out["region"],
-            stop=out["stop"])
+            v=out["v"], stop=out["stop"])
 
-    args = [poses_fm, fixed_j, ei, ej, meas_fm, *extras]
     if world > 1:
         mesh = make_mesh(world)
         rep = P()
+        spec_of = {"emask": P(EDGE_AXIS), "si": P(None, None, EDGE_AXIS)}
         in_specs = [rep, rep, P(EDGE_AXIS), P(EDGE_AXIS),
-                    P(None, EDGE_AXIS), *extra_specs]
-        sharded = jax.jit(jax.shard_map(
-            run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P()))
-        with jax.default_device(mesh.devices.flat[0]):
-            out = sharded(*args)
-    else:
-        out = run(*args)
-
-    cost0 = out["cost0"]
-    result = PGOResult(
-        poses=jnp.swapaxes(out["poses"], 0, 1),
-        cost=out["cost"], initial_cost=cost0, iterations=out["k"],
-        accepted=out["accepted"], pcg_iterations=out["pcg_total"],
-        region=out["region"], stopped=out["stop"])
-    if verbose:
-        print(f"PGO: cost {float(cost0):.6e} -> {float(result.cost):.6e} "
-              f"in {int(result.iterations)} LM iters "
-              f"({int(result.accepted)} accepted, "
-              f"{int(result.pcg_iterations)} PCG)", flush=True)
-    return result
+                    P(None, EDGE_AXIS), rep, rep,
+                    *(spec_of[k] for k in extra_keys)]
+        return jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P())), mesh
+    return jax.jit(run), None
 
 
 @dataclasses.dataclass

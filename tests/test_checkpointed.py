@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from megba_tpu.algo import lm_solve, solve_checkpointed
 from megba_tpu.common import AlgoOption, JacobianMode, ProblemOption, SolverOption
@@ -133,3 +134,65 @@ def test_resume_rejects_foreign_checkpoint(tmp_path):
     with pytest.raises(ValueError, match="different problem"):
         solve_checkpointed(f, *args, option, checkpoint_path=ck,
                            checkpoint_every=4)
+
+
+def _pgo_setup(seed=0, max_iter=12):
+    from megba_tpu.models.pgo import make_synthetic_pose_graph
+
+    g = make_synthetic_pose_graph(num_poses=20, loop_closures=4,
+                                  drift_noise=0.05, seed=seed)
+    option = ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-12,
+                               epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=100, tol=1e-14,
+                                   refuse_ratio=1e30))
+    return g, option
+
+
+def test_pgo_checkpointed_equals_straight_run(tmp_path):
+    from megba_tpu.algo.checkpointed import solve_pgo_checkpointed
+    from megba_tpu.models.pgo import solve_pgo
+
+    g, option = _pgo_setup()
+    straight = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option)
+    ck = str(tmp_path / "pgo.npz")
+    chunked = solve_pgo_checkpointed(
+        g.poses0, g.edge_i, g.edge_j, g.meas, option,
+        checkpoint_path=ck, checkpoint_every=3)
+    np.testing.assert_allclose(float(chunked.cost), float(straight.cost),
+                               rtol=1e-8, atol=1e-18)
+    assert int(chunked.iterations) == int(straight.iterations)
+    assert int(chunked.accepted) == int(straight.accepted)
+    st = load_state(ck)
+    assert int(st["iteration"]) >= 1 and "extra_v" in st
+
+
+def test_pgo_resume_from_partial_checkpoint(tmp_path):
+    import dataclasses
+
+    from megba_tpu.algo.checkpointed import solve_pgo_checkpointed
+    from megba_tpu.models.pgo import solve_pgo
+
+    g, option = _pgo_setup(seed=1)
+    ck = str(tmp_path / "pgo_partial.npz")
+    short = dataclasses.replace(
+        option,
+        algo_option=dataclasses.replace(option.algo_option, max_iter=4))
+    solve_pgo_checkpointed(g.poses0, g.edge_i, g.edge_j, g.meas, short,
+                           checkpoint_path=ck, checkpoint_every=4)
+    st1 = load_state(ck)
+    assert int(st1["iteration"]) == 4
+    resumed = solve_pgo_checkpointed(
+        g.poses0, g.edge_i, g.edge_j, g.meas, option,
+        checkpoint_path=ck, checkpoint_every=4)
+    straight = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option)
+    np.testing.assert_allclose(float(resumed.cost), float(straight.cost),
+                               rtol=1e-8, atol=1e-18)
+
+    # Foreign snapshot is refused with a topology message.
+    g2, _ = _pgo_setup(seed=2)
+
+    with pytest.raises(ValueError, match="different problem"):
+        solve_pgo_checkpointed(g2.poses0, g2.edge_i, g2.edge_j, g2.meas,
+                               option, checkpoint_path=ck)
