@@ -71,6 +71,29 @@ def _emit_verbose_line(token, k, c, a, p):
 _next_verbose_token = itertools.count(1).__next__
 
 
+def emit_verbose_iteration(token, k, cost, accept, pcg_iters,
+                           axis_name=None):
+    """Emit one per-iteration line from inside a jitted LM body.
+
+    Host callback printing the reference's observable (cost, log10 cost,
+    elapsed ms — lm_algo.cu:149-162); elapsed is measured host-side from
+    this solve's first callback (iteration 0 starts the clock keyed by
+    the per-solve token — jitted programs are cached across solves, so a
+    trace-time baseline would be frozen at the FIRST solve's start).
+    With `axis_name` set, only shard 0 emits — one line per iteration,
+    not one per shard.  Shared by the BA and PGO loops.
+    """
+    def _print(args):
+        jax.debug.callback(_emit_verbose_line, *args)
+
+    args = (token, k, cost, accept, pcg_iters)
+    if axis_name is None:
+        _print(args)
+    else:
+        jax.lax.cond(jax.lax.axis_index(axis_name) == 0, _print,
+                     lambda _: None, args)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class LMResult:
@@ -310,26 +333,10 @@ def lm_solve(
             stop=converged | (accept & stop_accept),
         )
         if verbose:
-            def _print(args):
-                # Host callback: prints the reference's per-iteration line
-                # (cost, log10 cost, elapsed ms — lm_algo.cu:149-162).
-                # Elapsed is measured host-side from this solve's first
-                # iteration callback (iteration 0 starts the clock keyed
-                # by the per-solve token — the jitted program is cached
-                # across solves, so a trace-time baseline would be frozen
-                # at the FIRST solve's start).
-                jax.debug.callback(_emit_verbose_line, *args)
-
             token = (jnp.int32(0) if verbose_token is None
                      else jnp.asarray(verbose_token, jnp.int32))
-            args = (token, s["k"], cost_new, accept, pcg.iterations)
-            if axis_name is None:
-                _print(args)
-            else:
-                # One line per iteration, not one per shard.
-                jax.lax.cond(
-                    jax.lax.axis_index(axis_name) == 0, _print,
-                    lambda _: None, args)
+            emit_verbose_iteration(token, s["k"], cost_new, accept,
+                                   pcg.iterations, axis_name)
         return s_next
 
     out = jax.lax.while_loop(cond, body, state0)

@@ -255,12 +255,15 @@ def solve_pgo(
         extras.append(si)
 
     prog, mesh = _pgo_program(option, world, n_poses, np.dtype(dtype),
-                              tuple(extra_keys))
+                              tuple(extra_keys), bool(verbose))
     region0 = (option.algo_option.initial_region if initial_region is None
                else initial_region)
     v0 = 2.0 if initial_v is None else initial_v
+    from megba_tpu.algo.lm import _next_verbose_token
+
     args = [poses_fm, fixed_j, ei, ej, meas_fm,
-            jnp.asarray(region0, dtype), jnp.asarray(v0, dtype), *extras]
+            jnp.asarray(region0, dtype), jnp.asarray(v0, dtype),
+            jnp.asarray(_next_verbose_token(), jnp.int32), *extras]
     if mesh is not None:
         with jax.default_device(mesh.devices.flat[0]):
             out = prog(*args)
@@ -283,24 +286,28 @@ def solve_pgo(
 
 @functools.lru_cache(maxsize=32)
 def _pgo_program(option: ProblemOption, world: int, n_poses: int,
-                 np_dtype: np.dtype, extra_keys: tuple):
+                 np_dtype: np.dtype, extra_keys: tuple,
+                 verbose: bool = False):
     """Build (once per configuration) the jitted PGO LM program.
 
     Returns (program, mesh-or-None).  Cached so repeat solves of one
     configuration — the checkpointed chunk driver, parameter sweeps —
     pay tracing + compilation once; the trust-region resume state
-    (region0, v0) rides as DYNAMIC operands, exactly like the BA path's
-    get_or_build_program contract (parallel/mesh.py).  jit handles
-    shape-based re-specialisation internally.
+    (region0, v0) and the verbose-clock token ride as DYNAMIC operands,
+    exactly like the BA path's get_or_build_program contract
+    (parallel/mesh.py).  jit handles shape-based re-specialisation
+    internally.
     """
     dtype = np_dtype
     algo_opt = option.algo_option
     solver_opt = option.solver_option
     axis_name = EDGE_AXIS if world > 1 else None
 
+    from megba_tpu.algo.lm import emit_verbose_iteration
     from megba_tpu.solver.pcg import _pcg_core, block_inv
 
-    def run(poses_fm, fixed_j, ei, ej, meas_fm, region0, v0, *extras_in):
+    def run(poses_fm, fixed_j, ei, ej, meas_fm, region0, v0,
+            verbose_token, *extras_in):
         kw = dict(zip(extra_keys, extras_in))
         emask = kw.get("emask")
         si_ = kw.get("si")
@@ -414,7 +421,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
                 accept, _accept_lin, _keep_old, None)
             region_accept = s["region"] / jnp.maximum(
                 jnp.asarray(1.0 / 3.0, dtype), 1.0 - (2.0 * rho - 1.0) ** 3)
-            return dict(
+            s_next = dict(
                 k=s["k"] + 1,
                 accepted=s["accepted"]
                 + jnp.where(accept, 1, 0).astype(jnp.int32),
@@ -427,6 +434,12 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
                                  s["region"] / s["v"]),
                 v=jnp.where(accept, jnp.asarray(2.0, dtype), s["v"] * 2.0),
                 stop=converged | (accept & (g_inf <= algo_opt.epsilon1)))
+            if verbose:
+                # Reference-style per-iteration line, same shared
+                # mechanism as the BA loop (algo/lm.py).
+                emit_verbose_iteration(verbose_token, s["k"], cost_new,
+                                       accept, pcg_iters, axis_name)
+            return s_next
 
         out = jax.lax.while_loop(cond, body, state0)
         # Per-edge carries (r/J/g/h) are internal; return only the
@@ -442,7 +455,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
         rep = P()
         spec_of = {"emask": P(EDGE_AXIS), "si": P(None, None, EDGE_AXIS)}
         in_specs = [rep, rep, P(EDGE_AXIS), P(EDGE_AXIS),
-                    P(None, EDGE_AXIS), rep, rep,
+                    P(None, EDGE_AXIS), rep, rep, rep,
                     *(spec_of[k] for k in extra_keys)]
         return jax.jit(jax.shard_map(
             run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P())), mesh
