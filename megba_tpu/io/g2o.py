@@ -120,10 +120,26 @@ def _lift_se2_info(info3: np.ndarray) -> np.ndarray:
     return out
 
 
+def _open_text(path: str, mode: str = "rt"):
+    """Open a (possibly .gz / .bz2 compressed) text file — public
+    pose-graph datasets ship in all three forms."""
+    lower = path.lower()
+    if lower.endswith(".gz"):
+        import gzip
+
+        return gzip.open(path, mode)
+    if lower.endswith(".bz2"):
+        import bz2
+
+        return bz2.open(path, mode)
+    return open(path, mode)
+
+
 def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
-    """Parse a .g2o file (SE3:QUAT or SE2 records; FIX supported)."""
+    """Parse a .g2o file (SE3:QUAT or SE2 records; FIX supported;
+    .gz/.bz2 transparently decompressed)."""
     if isinstance(source, str):
-        with open(source) as f:
+        with _open_text(source) as f:
             return read_g2o(f)
 
     # Parse into flat per-tag token lists first; ALL numeric work (float
@@ -259,9 +275,10 @@ def write_g2o(dest: Union[str, TextIO], graph: G2OGraph,
 
     Always writes the SE(3) form — lifted SE(2) graphs round-trip
     through it losslessly (z/roll/pitch stay zero at the optimum).
+    A .gz/.bz2 destination is compressed transparently.
     """
     if isinstance(dest, str):
-        with open(dest, "w") as f:
+        with _open_text(dest, "wt") as f:
             write_g2o(f, graph, poses)
         return
 

@@ -221,3 +221,24 @@ def test_negative_w_quaternions_fold_to_principal_branch():
     for eps in (1e-9, 2e-8, 1e-6):
         aa = _quat_xyzw_to_aa(np.array([eps, 0.0, 0.0, -1.0]))
         assert float(np.linalg.norm(aa)) < 1e-5, (eps, aa)
+
+
+def test_compressed_roundtrip(tmp_path):
+    """.gz and .bz2 g2o files read/write transparently (public datasets
+    ship compressed)."""
+    g = make_synthetic_pose_graph(num_poses=8, loop_closures=2, seed=6)
+    graph = _graph_of(g)
+    for ext in ("g2o", "g2o.gz", "g2o.bz2"):
+        path = str(tmp_path / f"graph.{ext}")
+        write_g2o(path, graph)
+        back = read_g2o(path)
+        np.testing.assert_array_equal(back.ids, graph.ids)
+        np.testing.assert_allclose(_rotmats(back.poses[:, :3]),
+                                   _rotmats(graph.poses[:, :3]), atol=1e-7)
+        np.testing.assert_allclose(back.poses[:, 3:], graph.poses[:, 3:],
+                                   atol=1e-7)
+    # Compressed output is actually compressed.
+    import gzip
+
+    with gzip.open(str(tmp_path / "graph.g2o.gz"), "rt") as f:
+        assert f.readline().startswith("VERTEX_SE3:QUAT")
