@@ -47,6 +47,12 @@ def main(argv=None) -> float:
     ap.add_argument("--epsilon2", type=float, default=1e-14)
     ap.add_argument("--synthetic_poses", type=int, default=64)
     ap.add_argument("--synthetic_loop_closures", type=int, default=10)
+    ap.add_argument("--world_size", type=int, default=1,
+                    help="shard the edge axis over this many devices")
+    ap.add_argument("--robust", choices=["none", "huber", "cauchy"],
+                    default="none",
+                    help="IRLS robust loss against bad loop closures")
+    ap.add_argument("--robust_delta", type=float, default=1.0)
     args = ap.parse_args(argv)
 
     path = args.path
@@ -77,8 +83,13 @@ def main(argv=None) -> float:
         print(f"{path}: {len(graph.ids)} poses, {len(graph.edge_i)} edges "
               f"[{kind}], parsed in {t_parse:.2f}s")
 
+        from megba_tpu.ops.robust import RobustKind
+
         option = ProblemOption(
             dtype=np.float32,
+            world_size=args.world_size,
+            robust_kind=RobustKind[args.robust.upper()],
+            robust_delta=args.robust_delta,
             algo_option=AlgoOption(max_iter=args.max_iter,
                                    initial_region=args.tau,
                                    epsilon1=args.epsilon1,

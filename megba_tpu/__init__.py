@@ -32,8 +32,10 @@ from megba_tpu.problem import (
     BaseEdge,
     BaseProblem,
     BaseVertex,
+    BetweenEdge,
     CameraVertex,
     PointVertex,
+    PoseVertex,
     VertexKind,
 )
 from megba_tpu.ops.robust import RobustKind
@@ -65,12 +67,14 @@ __all__ = [
     "BaseEdge",
     "BaseProblem",
     "BaseVertex",
+    "BetweenEdge",
     "CameraVertex",
     "ComputeKind",
     "Device",
     "JacobianMode",
     "LinearSystemKind",
     "PointVertex",
+    "PoseVertex",
     "PreconditionerKind",
     "ProblemOption",
     "RobustKind",

@@ -320,16 +320,9 @@ def sqrt_info_of(graph: G2OGraph) -> Optional[np.ndarray]:
     """
     if np.allclose(graph.info, np.eye(6)[None]):
         return None
-    w, v = np.linalg.eigh(graph.info)  # Omega = V diag(w) V^T
-    floor = -1e-9 * np.maximum(w.max(axis=-1, keepdims=True), 1.0)
-    bad = np.nonzero((w < floor).any(axis=-1))[0]
-    if bad.size:
-        raise ValueError(
-            f"edge {int(bad[0])} (of {len(w)}) has an indefinite "
-            f"information matrix (eigenvalues {w[bad[0]]})")
-    # W = diag(sqrt(w)) V^T satisfies W^T W = Omega.
-    return np.sqrt(np.maximum(w, 0.0))[:, :, None] * np.transpose(
-        v, (0, 2, 1))
+    from megba_tpu.core.linalg import psd_sqrt
+
+    return psd_sqrt(graph.info, what="edge")
 
 
 def solve_g2o(source, option=None, verbose: bool = False):

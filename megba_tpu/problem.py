@@ -36,11 +36,13 @@ from megba_tpu.ops.residuals import (
 
 
 class VertexKind(enum.Enum):
-    """Reference BaseVertex kind() (base_vertex.h:52-56)."""
+    """Reference BaseVertex kind() (base_vertex.h:52-56), extended with
+    POSE for the pose-graph family the reference cannot express."""
 
     CAMERA = 0
     POINT = 1
     NONE = 2
+    POSE = 3
 
 
 class BaseVertex:
@@ -67,6 +69,21 @@ class CameraVertex(BaseVertex):
 
 class PointVertex(BaseVertex):
     kind = VertexKind.POINT
+
+
+class PoseVertex(BaseVertex):
+    """An SE(3) pose [angle_axis (3), translation (3)] — the pose-graph
+    family (models/pgo.py).  Inexpressible in the reference: its edges
+    are hard-wired to camera+landmark pairs (base_edge.h)."""
+
+    kind = VertexKind.POSE
+
+    def __init__(self, estimation: np.ndarray, fixed: bool = False):
+        super().__init__(estimation, fixed)
+        if self.estimation.shape != (6,):
+            raise ValueError(
+                f"PoseVertex needs 6 parameters [angle_axis, t], got "
+                f"shape {self.estimation.shape}")
 
 
 class BaseEdge:
@@ -117,6 +134,34 @@ class BaseEdge:
         camera = self.vertex_estimation(0)
         point = self.vertex_estimation(1)
         return bal_residual(camera, point, self.get_measurement())
+
+
+class BetweenEdge(BaseEdge):
+    """SE(3) between-factor over two PoseVertex (models/pgo.py).
+
+    measurement: the expected relative pose T_i^{-1} T_j as
+    [angle_axis (3), translation (3)]; information: optional 6x6 matrix
+    in the solver's [rotation, translation] row order.  The residual is
+    the fixed between-factor of the PGO pipeline
+    (pgo.between_residual); custom forward() is not supported here.
+    """
+
+    def __init__(self, vertices=None, measurement=None, information=None):
+        super().__init__(vertices, measurement, information)
+        if self.measurement is not None and self.measurement.shape != (6,):
+            raise ValueError(
+                f"BetweenEdge measurement must be 6 values "
+                f"[angle_axis, t], got shape {self.measurement.shape}")
+        if (self.information is not None
+                and self.information.shape != (6, 6)):
+            raise ValueError(
+                f"BetweenEdge information must be 6x6, got shape "
+                f"{self.information.shape}")
+
+    def forward(self) -> jnp.ndarray:  # pragma: no cover - guard only
+        raise NotImplementedError(
+            "BetweenEdge uses the PGO pipeline's fixed between-factor "
+            "residual; custom forward() is not supported for pose edges")
 
 
 def _edge_residual_jac_fn(proto: BaseEdge):
@@ -188,12 +233,25 @@ class BaseProblem:
                 f"{self._edge_type.__name__}"
             )
         kinds = [v.kind for v in edge.vertices]
-        if kinds != [VertexKind.CAMERA, VertexKind.POINT]:
+        if kinds == [VertexKind.POSE, VertexKind.POSE]:
+            if not isinstance(edge, BetweenEdge):
+                raise TypeError(
+                    "pose-pose edges must be BetweenEdge (the PGO "
+                    "pipeline's fixed between-factor residual)")
+        elif isinstance(edge, BetweenEdge):
+            # The converse guard: a BetweenEdge over non-pose vertices
+            # would otherwise be misrouted to the PGO pipeline.
+            raise TypeError(
+                "BetweenEdge requires two PoseVertex endpoints, got "
+                f"{[k.name for k in kinds]}")
+        elif kinds != [VertexKind.CAMERA, VertexKind.POINT]:
             # The reference classifies ONE/TWO_CAMERA/MULTI kinds
             # (base_edge.cpp:27-36) but, like us, only implements the
-            # Schur pipeline for ONE_CAMERA_ONE_POINT.
+            # Schur pipeline for ONE_CAMERA_ONE_POINT; pose graphs go
+            # through the PGO pipeline (a family beyond the reference).
             raise NotImplementedError(
-                "only (CameraVertex, PointVertex) edges are supported"
+                "edges must be (CameraVertex, PointVertex) or "
+                "(PoseVertex, PoseVertex)"
             )
         for v in edge.vertices:
             if id(v) not in self._vertex_ids:
@@ -244,7 +302,58 @@ class BaseProblem:
             sqrt_info = np.transpose(np.linalg.cholesky(infos), (0, 2, 1))
         return cameras, points, obs, cam_idx, pt_idx, cam_fixed, pt_fixed, sqrt_info, cams, pts
 
-    def solve(self, verbose: bool = False) -> LMResult:
+    def _lower_pgo(self):
+        poses = [(i, v) for i, v in self._vertices.items()
+                 if v.kind == VertexKind.POSE]
+        if not poses or not self._edges:
+            raise ValueError("pose-graph problem needs poses and edges")
+        rank = {id(v): r for r, (_, v) in enumerate(poses)}
+        table = np.stack([v.estimation for _, v in poses])
+        fixed = np.array([v.fixed for _, v in poses])
+        edge_i = np.array([rank[id(e.vertices[0])] for e in self._edges],
+                          np.int32)
+        edge_j = np.array([rank[id(e.vertices[1])] for e in self._edges],
+                          np.int32)
+        meas = np.stack([e.measurement for e in self._edges])
+        sqrt_info = None
+        if any(e.information is not None for e in self._edges):
+            from megba_tpu.core.linalg import psd_sqrt
+
+            infos = np.stack(
+                [e.information if e.information is not None else np.eye(6)
+                 for e in self._edges])
+            # PSD-safe (zero rows = unconstrained DOFs are common in
+            # pose graphs; W^T W = info, same contract as the g2o path).
+            sqrt_info = psd_sqrt(infos, what="edge")
+        return table, edge_i, edge_j, meas, fixed, sqrt_info, poses
+
+    def _solve_pgo(self, verbose: bool):
+        from megba_tpu.models.pgo import solve_pgo
+
+        table, edge_i, edge_j, meas, fixed, sqrt_info, poses = \
+            self._lower_pgo()
+        result = solve_pgo(
+            table, edge_i, edge_j, meas, self.option,
+            sqrt_info=sqrt_info,
+            # No FIX-ed vertex -> solve_pgo's default gauge anchor
+            # (the first pose).
+            fixed=fixed if fixed.any() else None,
+            verbose=verbose)
+        out = np.asarray(result.poses, dtype=np.float64)
+        for r, (_, v) in enumerate(poses):
+            v.estimation = out[r].copy()
+        self.result = result
+        return result
+
+    def solve(self, verbose: bool = False):
+        """Solve and write back (reference base_problem.cpp:273-278).
+
+        Returns an LMResult for BA graphs; pose graphs (PoseVertex +
+        BetweenEdge) route through the PGO pipeline and return a
+        PGOResult.
+        """
+        if self._edges and isinstance(self._edges[0], BetweenEdge):
+            return self._solve_pgo(verbose)
         opt = self.option
         (cameras, points, obs, cam_idx, pt_idx,
          cam_fixed, pt_fixed, sqrt_info, cams, pts) = self._lower()

@@ -153,3 +153,116 @@ def test_world_size_two_through_api():
     s, pb, cams, pts = build_problem(option=opt, seed=6)
     res = pb.solve()
     assert float(res.cost) < float(res.initial_cost) * 1e-2
+
+
+def test_pose_graph_facade_matches_direct_solve():
+    """PoseVertex + BetweenEdge through BaseProblem == solve_pgo.
+
+    The g2o-style object API covers the pose-graph family too (a family
+    the reference's camera/landmark-typed edges cannot express).
+    """
+    from megba_tpu.common import AlgoOption, SolverOption
+    from megba_tpu.models.pgo import make_synthetic_pose_graph, solve_pgo
+    from megba_tpu.problem import BetweenEdge, PoseVertex
+
+    g = make_synthetic_pose_graph(num_poses=14, loop_closures=3,
+                                  drift_noise=0.05, seed=9)
+    option = ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=20, epsilon1=1e-12,
+                               epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=100, tol=1e-14,
+                                   refuse_ratio=1e30))
+
+    pb = BaseProblem(option)
+    verts = []
+    for k, p in enumerate(g.poses0):
+        v = PoseVertex(p, fixed=(k == 0))
+        verts.append(v)
+        pb.append_vertex(k, v)
+    for a, b, m in zip(g.edge_i, g.edge_j, g.meas):
+        pb.append_edge(BetweenEdge([verts[a], verts[b]], measurement=m))
+
+    result = pb.solve()
+    direct = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option)
+    np.testing.assert_allclose(float(result.cost), float(direct.cost),
+                               rtol=1e-9, atol=1e-18)
+    # Write-back: vertices hold the solution; the anchor did not move.
+    np.testing.assert_allclose(
+        np.stack([v.estimation for v in verts]),
+        np.asarray(direct.poses), atol=1e-12)
+    np.testing.assert_array_equal(verts[0].estimation, g.poses0[0])
+
+    # Weighted edges route through the same Cholesky convention as BA.
+    pb2 = BaseProblem(option)
+    verts2 = [PoseVertex(p, fixed=(k == 0))
+              for k, p in enumerate(g.poses0)]
+    for k, v in enumerate(verts2):
+        pb2.append_vertex(k, v)
+    for a, b, m in zip(g.edge_i, g.edge_j, g.meas):
+        pb2.append_edge(BetweenEdge([verts2[a], verts2[b]], measurement=m,
+                                    information=4.0 * np.eye(6)))
+    r2 = pb2.solve()
+    r2_direct = solve_pgo(
+        g.poses0, g.edge_i, g.edge_j, g.meas, option,
+        sqrt_info=np.tile(2.0 * np.eye(6), (len(g.edge_i), 1, 1)))
+    np.testing.assert_allclose(float(r2.cost), float(r2_direct.cost),
+                               rtol=1e-9, atol=1e-18)
+
+
+def test_pose_graph_facade_validation():
+    from megba_tpu.problem import BetweenEdge, PoseVertex
+
+    pb = BaseProblem(ProblemOption())
+    v0 = PoseVertex(np.zeros(6))
+    v1 = PoseVertex(np.ones(6))
+    pb.append_vertex(0, v0)
+    pb.append_vertex(1, v1)
+    # A plain BaseEdge over poses is rejected (its forward is the BAL
+    # reprojection model).
+    with pytest.raises(TypeError, match="BetweenEdge"):
+        pb.append_edge(BaseEdge([v0, v1], measurement=np.zeros(6)))
+    # Wrong parameter count caught at construction.
+    with pytest.raises(ValueError, match="6 parameters"):
+        PoseVertex(np.zeros(7))
+
+
+def test_between_edge_guards():
+    from megba_tpu.problem import BetweenEdge, PoseVertex
+
+    # Measurement/information shape caught at construction.
+    p0, p1 = PoseVertex(np.zeros(6)), PoseVertex(np.ones(6))
+    with pytest.raises(ValueError, match="6 values"):
+        BetweenEdge([p0, p1], measurement=np.zeros(3))
+    with pytest.raises(ValueError, match="6x6"):
+        BetweenEdge([p0, p1], measurement=np.zeros(6),
+                    information=np.eye(3))
+
+    # BetweenEdge over non-pose vertices is rejected at append.
+    pb = BaseProblem(ProblemOption())
+    cam = CameraVertex(np.zeros(9))
+    pt = PointVertex(np.zeros(3))
+    pb.append_vertex(0, cam)
+    pb.append_vertex(1, pt)
+    with pytest.raises(TypeError, match="two PoseVertex"):
+        pb.append_edge(BetweenEdge([cam, pt], measurement=np.zeros(6)))
+
+    # PSD (singular) information factors cleanly through the facade.
+    from megba_tpu.models.pgo import make_synthetic_pose_graph
+
+    g = make_synthetic_pose_graph(num_poses=8, loop_closures=2, seed=4)
+    pb2 = BaseProblem(ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=10, epsilon1=1e-12,
+                               epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=60, tol=1e-12,
+                                   refuse_ratio=1e30)))
+    verts = [PoseVertex(p, fixed=(k == 0)) for k, p in enumerate(g.poses0)]
+    for k, v in enumerate(verts):
+        pb2.append_vertex(k, v)
+    info_psd = np.diag([1.0, 1.0, 1.0, 1.0, 1.0, 0.0])
+    for a, b, m in zip(g.edge_i, g.edge_j, g.meas):
+        pb2.append_edge(BetweenEdge([verts[a], verts[b]], measurement=m,
+                                    information=info_psd))
+    res = pb2.solve()
+    assert np.isfinite(float(res.cost))
