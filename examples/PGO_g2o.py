@@ -53,6 +53,10 @@ def main(argv=None) -> float:
                     default="none",
                     help="IRLS robust loss against bad loop closures")
     ap.add_argument("--robust_delta", type=float, default=1.0)
+    ap.add_argument("--init", choices=["file", "spanning_tree"],
+                    default="file",
+                    help="spanning_tree: bootstrap poses from the "
+                         "measurements instead of the file's estimates")
     args = ap.parse_args(argv)
 
     path = args.path
@@ -99,7 +103,8 @@ def main(argv=None) -> float:
                                        refuse_ratio=1e30),
         )
         t0 = time.perf_counter()
-        graph, res = solve_g2o(graph, option, verbose=True)
+        graph, res = solve_g2o(graph, option, verbose=True,
+                               init=args.init)
         print(f"solve: {time.perf_counter() - t0:.2f}s")
 
         if args.out:

@@ -325,13 +325,28 @@ def sqrt_info_of(graph: G2OGraph) -> Optional[np.ndarray]:
     return psd_sqrt(graph.info, what="edge")
 
 
-def solve_g2o(source, option=None, verbose: bool = False):
-    """Read (path / file / G2OGraph), solve, return (graph, PGOResult)."""
-    from megba_tpu.models.pgo import solve_pgo
+def solve_g2o(source, option=None, verbose: bool = False,
+              init: str = "file"):
+    """Read (path / file / G2OGraph), solve, return (graph, PGOResult).
+
+    `init="spanning_tree"` re-initializes poses by composing
+    measurements along a BFS spanning tree from the anchors
+    (models/pgo.spanning_tree_init) instead of trusting the file's
+    VERTEX estimates — the standard bootstrap for exports with garbage
+    or missing initial guesses.
+    """
+    from megba_tpu.models.pgo import solve_pgo, spanning_tree_init
 
     graph = source if isinstance(source, G2OGraph) else read_g2o(source)
+    poses = graph.poses
+    if init == "spanning_tree":
+        poses = spanning_tree_init(poses, graph.edge_i, graph.edge_j,
+                                   graph.meas, graph.fixed)
+    elif init != "file":
+        raise ValueError(f"init must be 'file' or 'spanning_tree', "
+                         f"got {init!r}")
     result = solve_pgo(
-        graph.poses, graph.edge_i, graph.edge_j, graph.meas,
+        poses, graph.edge_i, graph.edge_j, graph.meas,
         option, sqrt_info=sqrt_info_of(graph), fixed=graph.fixed,
         verbose=verbose)
     return graph, result

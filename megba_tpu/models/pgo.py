@@ -473,6 +473,62 @@ class SyntheticPoseGraph:
     meas: np.ndarray  # [nE, 6]
 
 
+def spanning_tree_init(
+    poses0: np.ndarray,
+    edge_i: np.ndarray,
+    edge_j: np.ndarray,
+    meas: np.ndarray,
+    fixed: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Re-initialize poses by composing measurements along a BFS tree.
+
+    The standard pose-graph bootstrap (what g2o practitioners run before
+    LM): anchors keep their input pose; every other pose is reached by
+    composing between-factor measurements along a breadth-first spanning
+    tree from the nearest anchor, traversing edges forward
+    (T_j = T_i o m) or backward (T_i = T_j o m^{-1}).  Far more robust
+    than trusting arbitrary VERTEX estimates from a .g2o export, and
+    exact on noise-free odometry.  Poses unreachable from any anchor
+    keep their input estimate.  Host-side numpy (core/host_se3).
+    """
+    from collections import deque
+
+    poses0 = np.asarray(poses0, np.float64)
+    n = poses0.shape[0]
+    edge_i = np.asarray(edge_i)
+    edge_j = np.asarray(edge_j)
+    meas = np.asarray(meas, np.float64)
+    if fixed is None:
+        fixed_np = np.zeros(n, bool)
+        fixed_np[0] = True
+    else:
+        fixed_np = np.asarray(fixed, bool)
+        if not fixed_np.any():
+            fixed_np = fixed_np.copy()
+            fixed_np[0] = True
+
+    adj: list[list[tuple[int, int, bool]]] = [[] for _ in range(n)]
+    for k in range(len(edge_i)):
+        a, b = int(edge_i[k]), int(edge_j[k])
+        adj[a].append((b, k, True))   # forward: T_b = T_a o m_k
+        adj[b].append((a, k, False))  # backward: T_a = T_b o m_k^{-1}
+
+    out = poses0.copy()
+    seen = fixed_np.copy()
+    queue = deque(np.nonzero(fixed_np)[0].tolist())
+    # Inverse measurement: T^{-1} = (R^T, -R^T t) = relative(T, identity).
+    inv_meas = relative(meas, np.zeros_like(meas))
+    while queue:
+        a = queue.popleft()
+        for b, k, forward in adj[a]:
+            if seen[b]:
+                continue
+            seen[b] = True
+            out[b] = compose(out[a], meas[k] if forward else inv_meas[k])
+            queue.append(b)
+    return out
+
+
 def make_synthetic_pose_graph(
     num_poses: int = 32,
     loop_closures: int = 6,
