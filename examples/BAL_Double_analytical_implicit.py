@@ -11,5 +11,9 @@ import numpy as np
 from examples.common import run_example
 from megba_tpu.common import ComputeKind, JacobianMode
 
+def main(argv=None) -> float:
+    return run_example(np.float64, JacobianMode.ANALYTICAL, ComputeKind.IMPLICIT, argv)
+
+
 if __name__ == "__main__":
-    run_example(np.float64, JacobianMode.ANALYTICAL, ComputeKind.IMPLICIT)
+    main()

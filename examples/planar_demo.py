@@ -15,26 +15,34 @@ import numpy as np
 
 jax.config.update("jax_enable_x64", True)
 
-import jax.numpy as jnp
-
-from megba_tpu.algo import lm_solve
 from megba_tpu.common import AlgoOption, JacobianMode, ProblemOption, SolverOption
 from megba_tpu.models import planar
 from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.solve import flat_solve
 
-if __name__ == "__main__":
+
+def main(num_cameras=12, num_points=200, obs_per_point=5,
+         max_iter=20) -> float:
     s = planar.make_synthetic_planar(
-        num_cameras=12, num_points=200, obs_per_point=5, noise=0.2,
-        param_noise=3e-2, seed=0)
+        num_cameras=num_cameras, num_points=num_points,
+        obs_per_point=obs_per_point, noise=0.2, param_noise=3e-2, seed=0)
     f = make_residual_jacobian_fn(residual_fn=planar.residual,
                                   mode=JacobianMode.AUTODIFF)
     option = ProblemOption(
-        algo_option=AlgoOption(max_iter=20, epsilon1=1e-10, epsilon2=1e-13),
-        solver_option=SolverOption(max_iter=150, tol=1e-12, refuse_ratio=1e30))
-    res = lm_solve(
-        f, jnp.asarray(s.cameras0), jnp.asarray(s.points0), jnp.asarray(s.obs),
-        jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx), jnp.ones(len(s.obs)),
-        option, verbose=True)
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-10,
+                               epsilon2=1e-13),
+        solver_option=SolverOption(max_iter=150, tol=1e-12,
+                                   refuse_ratio=1e30))
+    # The public edge-major boundary (flat_solve) owns the feature-major
+    # transpose, padding, and jit caching — same pipeline as the BAL CLIs.
+    res = flat_solve(
+        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+        verbose=True)
     print(
         f"planar BA: cost {float(res.initial_cost):.4e} -> {float(res.cost):.6e} "
         f"in {int(res.iterations)} iterations")
+    return float(res.cost)
+
+
+if __name__ == "__main__":
+    main()

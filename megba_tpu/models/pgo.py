@@ -163,7 +163,9 @@ def _grad_and_diag(r, Ji, Jj, edge_i, edge_j, n_poses, fixed,
     # damped preconditioner stays invertible; their gradient is zero so
     # PCG leaves them untouched (same trick as the BA builder's
     # edge-less-vertex identity blocks).
-    eye = jnp.eye(POSE_DIM).reshape(36, 1)
+    # dtype pinned: a bare jnp.eye is float64 under x64 and would upcast
+    # h (and through it the whole PCG state) in float32 solves.
+    eye = jnp.eye(POSE_DIM, dtype=h.dtype).reshape(36, 1)
     guard = fixed | (h[0] == 0)
     h = jnp.where(guard[None, :], eye, h)
     g = g * (1.0 - fixed.astype(g.dtype))[None, :]

@@ -1,0 +1,53 @@
+"""Every example entry point runs end to end on tiny synthetic scenes.
+
+The example CLIs are the reference's de-facto integration tests
+(SURVEY.md §4); a demo drifting out of sync with an internal API change
+must fail CI, not a user.  Each runs in-process with tiny shapes so the
+whole module stays in the quick lane.
+"""
+
+import importlib
+import os
+
+import numpy as np
+import pytest
+
+
+def _tiny_bal_argv():
+    return ["--max_iter", "2", "--synthetic_cameras", "4",
+            "--synthetic_points", "40", "--synthetic_obs_per_point", "3"]
+
+
+@pytest.mark.parametrize("name", [
+    "BAL_Double", "BAL_Float", "BAL_Double_analytical",
+    "BAL_Float_analytical", "BAL_Double_implicit",
+    "BAL_Double_analytical_implicit",
+])
+def test_bal_examples_run(name):
+    mod = importlib.import_module(f"examples.{name}")
+    cost = mod.main(_tiny_bal_argv())
+    assert np.isfinite(cost)
+
+
+def test_planar_demo_runs():
+    planar_demo = importlib.import_module("examples.planar_demo")
+    cost = planar_demo.main(num_cameras=4, num_points=30, obs_per_point=3,
+                            max_iter=3)
+    assert np.isfinite(cost)
+
+
+def test_pgo_demo_runs():
+    pgo_demo = importlib.import_module("examples.pgo_demo")
+    cost = pgo_demo.main(["--num_poses", "10", "--loop_closures", "2",
+                          "--max_iter", "5"])
+    assert np.isfinite(cost)
+
+
+def test_pgo_g2o_example_runs(tmp_path):
+    PGO_g2o = importlib.import_module("examples.PGO_g2o")
+    out = str(tmp_path / "solved.g2o")
+    cost = PGO_g2o.main(["--synthetic_poses", "10",
+                         "--synthetic_loop_closures", "2",
+                         "--max_iter", "5", "--out", out])
+    assert np.isfinite(cost)
+    assert os.path.exists(out)
