@@ -242,3 +242,25 @@ def test_compressed_roundtrip(tmp_path):
 
     with gzip.open(str(tmp_path / "graph.g2o.gz"), "rt") as f:
         assert f.readline().startswith("VERTEX_SE3:QUAT")
+
+
+def test_file_route_sharded_matches_single():
+    """solve_g2o(world_size=8) on the virtual CPU mesh == world 1.
+
+    The file route composes with the edge-sharded lowering (the g2o
+    parser feeds the same solve_pgo boundary the sharded tests cover).
+    """
+    import dataclasses
+
+    g = make_synthetic_pose_graph(num_poses=11, loop_closures=2,
+                                  drift_noise=0.05, seed=8)
+    buf = io.StringIO()
+    write_g2o(buf, _graph_of(g))
+    text = buf.getvalue()
+    _, res1 = solve_g2o(io.StringIO(text), _option(max_iter=8))
+    _, res8 = solve_g2o(
+        io.StringIO(text),
+        dataclasses.replace(_option(max_iter=8), world_size=8))
+    np.testing.assert_allclose(float(res8.cost), float(res1.cost),
+                               rtol=1e-9, atol=1e-18)
+    assert int(res8.iterations) == int(res1.iterations)
