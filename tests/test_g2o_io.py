@@ -264,3 +264,29 @@ def test_file_route_sharded_matches_single():
     np.testing.assert_allclose(float(res8.cost), float(res1.cost),
                                rtol=1e-9, atol=1e-18)
     assert int(res8.iterations) == int(res1.iterations)
+
+
+def test_mixed_se2_se3_records():
+    """One file mixing SE3:QUAT and SE2 records parses coherently:
+    SE2 rows are lifted in place, ids/info interleave correctly."""
+    text = """\
+VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1
+VERTEX_SE2 1 1 0 0.5
+VERTEX_SE3:QUAT 2 2 0 0 0 0 0.2474 0.9689
+EDGE_SE3:QUAT 0 2 2 0 0 0 0 0.2474 0.9689 1 0 0 0 0 0 1 0 0 0 0 1 0 0 0 2 0 0 2 0 2
+EDGE_SE2 0 1 1 0 0.5 3 0 0 3 0 3
+"""
+    graph = read_g2o(io.StringIO(text))
+    assert not graph.se2  # mixed file counts as SE3
+    np.testing.assert_array_equal(graph.ids, [0, 1, 2])
+    # SE2 vertex lifted: z-rotation 0.5, in-plane translation.
+    np.testing.assert_allclose(graph.poses[1], [0, 0, 0.5, 1, 0, 0],
+                               atol=1e-9)
+    # SE2 edge info lifted with unit out-of-plane rows; SE3 edge info
+    # permuted/chart-scaled (rotation diag 2 -> 0.5, translation 1).
+    np.testing.assert_allclose(np.diag(graph.info[1]),
+                               [1, 1, 3, 3, 3, 1], atol=1e-12)
+    np.testing.assert_allclose(np.diag(graph.info[0]),
+                               [0.5, 0.5, 0.5, 1, 1, 1], atol=1e-4)
+    _, res = solve_g2o(graph, _option(max_iter=10))
+    assert float(res.cost) < 1e-10
