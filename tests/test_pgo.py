@@ -259,3 +259,24 @@ def test_spanning_tree_init():
     ej = np.array([1, 2], np.int32)
     init2 = spanning_tree_init(garbage[:5], ei, ej, g.meas[:2])
     np.testing.assert_array_equal(init2[3:], garbage[3:5])
+
+
+@pytest.mark.slow
+def test_pgo_sharded_matches_single_at_scale():
+    """World-8 parity at non-degenerate scale (5k poses / ~6.2k edges):
+    real padding remainders, thousands of segments per shard."""
+    import dataclasses
+
+    g = make_synthetic_pose_graph(num_poses=5000, loop_closures=1200,
+                                  drift_noise=0.01, seed=17)
+
+    def opt(world):
+        return dataclasses.replace(_option(max_iter=6), world_size=world)
+
+    res1 = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, opt(1))
+    res8 = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, opt(8))
+    np.testing.assert_allclose(float(res8.cost), float(res1.cost),
+                               rtol=1e-9)
+    assert int(res8.iterations) == int(res1.iterations)
+    np.testing.assert_allclose(np.asarray(res8.poses),
+                               np.asarray(res1.poses), atol=1e-8)
