@@ -22,7 +22,17 @@ from megba_tpu.solve import flat_solve
 
 
 def main(num_cameras=12, num_points=200, obs_per_point=5,
-         max_iter=20) -> float:
+         max_iter=20, argv=None) -> float:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_cameras", type=int, default=num_cameras)
+    ap.add_argument("--num_points", type=int, default=num_points)
+    ap.add_argument("--obs_per_point", type=int, default=obs_per_point)
+    ap.add_argument("--max_iter", type=int, default=max_iter)
+    args = ap.parse_args(argv)
+    num_cameras, num_points = args.num_cameras, args.num_points
+    obs_per_point, max_iter = args.obs_per_point, args.max_iter
     s = planar.make_synthetic_planar(
         num_cameras=num_cameras, num_points=num_points,
         obs_per_point=obs_per_point, noise=0.2, param_noise=3e-2, seed=0)

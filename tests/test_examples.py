@@ -2,20 +2,55 @@
 
 The example CLIs are the reference's de-facto integration tests
 (SURVEY.md §4); a demo drifting out of sync with an internal API change
-must fail CI, not a user.  Each runs in-process with tiny shapes so the
-whole module stays in the quick lane.
+must fail CI, not a user.  Each runs as a real subprocess (the actual
+CLI surface, argv parsing and __main__ included) with the CPU-pinned
+environment — in-process imports were observed to push the suite's
+single XLA process into a compiler segfault at full-suite compile
+volume, and a subprocess per example isolates global jax state anyway.
 """
 
-import importlib
 import os
+import re
+import subprocess
+import sys
 
 import numpy as np
 import pytest
 
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
-def _tiny_bal_argv():
-    return ["--max_iter", "2", "--synthetic_cameras", "4",
-            "--synthetic_points", "40", "--synthetic_obs_per_point", "3"]
+
+def _run(script, args, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # The conftest's 8-virtual-device XLA_FLAGS must not leak into the
+    # subprocess: a real CLI invocation has no such topology (and the
+    # per-device thread pools cost on the 1-core sandbox).
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, cwd=_ROOT,
+        env=env)
+    assert proc.returncode == 0, (
+        f"{script} failed (rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+def _final_cost(out, marker):
+    """Extract the final cost from the line containing `marker` and
+    assert it is finite — 'cost nan' must fail, not pass on the marker
+    alone."""
+    line = next(ln for ln in out.splitlines() if marker in ln)
+    floats = re.findall(r"-?(?:\d+\.?\d*|nan|inf)(?:e[+-]?\d+)?", line,
+                        re.IGNORECASE)
+    costs = [float(x) for x in floats]
+    assert costs and all(np.isfinite(c) for c in costs), line
+    return costs
+
+
+_TINY_BAL = ["--max_iter", "2", "--synthetic_cameras", "4",
+             "--synthetic_points", "40", "--synthetic_obs_per_point", "3"]
 
 
 @pytest.mark.parametrize("name", [
@@ -24,30 +59,27 @@ def _tiny_bal_argv():
     "BAL_Double_analytical_implicit",
 ])
 def test_bal_examples_run(name):
-    mod = importlib.import_module(f"examples.{name}")
-    cost = mod.main(_tiny_bal_argv())
-    assert np.isfinite(cost)
+    out = _run(f"{name}.py", _TINY_BAL)
+    _final_cost(out, "Finished")
 
 
 def test_planar_demo_runs():
-    planar_demo = importlib.import_module("examples.planar_demo")
-    cost = planar_demo.main(num_cameras=4, num_points=30, obs_per_point=3,
-                            max_iter=3)
-    assert np.isfinite(cost)
+    out = _run("planar_demo.py", ["--num_cameras", "4", "--num_points",
+                                  "30", "--obs_per_point", "3",
+                                  "--max_iter", "3"])
+    _final_cost(out, "planar BA: cost")
 
 
 def test_pgo_demo_runs():
-    pgo_demo = importlib.import_module("examples.pgo_demo")
-    cost = pgo_demo.main(["--num_poses", "10", "--loop_closures", "2",
-                          "--max_iter", "5"])
-    assert np.isfinite(cost)
+    out = _run("pgo_demo.py", ["--num_poses", "10", "--loop_closures",
+                               "2", "--max_iter", "5"])
+    _final_cost(out, "PGO: cost")
 
 
 def test_pgo_g2o_example_runs(tmp_path):
-    PGO_g2o = importlib.import_module("examples.PGO_g2o")
-    out = str(tmp_path / "solved.g2o")
-    cost = PGO_g2o.main(["--synthetic_poses", "10",
-                         "--synthetic_loop_closures", "2",
-                         "--max_iter", "5", "--out", out])
-    assert np.isfinite(cost)
-    assert os.path.exists(out)
+    out_path = str(tmp_path / "solved.g2o")
+    out = _run("PGO_g2o.py", ["--synthetic_poses", "10",
+                              "--synthetic_loop_closures", "2",
+                              "--max_iter", "5", "--out", out_path])
+    _final_cost(out, "PGO: cost")
+    assert os.path.exists(out_path)
