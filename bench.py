@@ -135,11 +135,17 @@ def main() -> None:
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache,
         ensure_usable_backend,
         install_graceful_term,
     )
 
     install_graceful_term()
+    # Persistent on-disk compile cache: a tunnel window must not spend
+    # its first chip-minutes recompiling venice-scale programs that a
+    # previous run (or the CPU fallback of the same shapes) already
+    # compiled (VERDICT r04 weak-spot 2).
+    enable_persistent_compile_cache()
 
     # ensure_usable_backend re-asserts the caller's JAX_PLATFORMS over
     # the axon plugin's startup override and skips the tunnel probe

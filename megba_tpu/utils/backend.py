@@ -56,6 +56,37 @@ def accelerator_usable(timeout_s: float = 120.0) -> bool:
     return _probe_result
 
 
+def enable_persistent_compile_cache(cache_dir: Optional[str] = None) -> str:
+    """Point JAX at an on-disk compilation cache and make it eager.
+
+    Chip minutes through the single-client tunnel are the scarcest
+    resource in this sandbox; without a persistent cache every tunnel
+    window starts by recompiling the same venice-scale programs
+    (tens of seconds to minutes each).  Call before the first jit in
+    every chip-facing entry point.  MEGBA_COMPILE_CACHE_DIR overrides
+    the default location; returns the directory used.
+
+    min_compile_time_secs=0 caches even fast compiles (the warmup pass
+    compiles tiny shapes first), and min_entry_size_bytes=0 keeps small
+    executables.  Errors reading/writing the cache stay non-fatal
+    (jax_raise_persistent_cache_errors defaults False).
+    """
+    import jax
+
+    if cache_dir is None:
+        cache_dir = (
+            os.environ.get("MEGBA_COMPILE_CACHE_DIR")
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))), ".jax_cache"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 def install_graceful_term() -> None:
     """Convert SIGTERM into a clean SystemExit (atexit runs).
 

@@ -1,5 +1,13 @@
 #!/bin/bash
-# Detached TPU measurement pass: tests -> benches -> profile -> sweep.
+# Detached TPU measurement pass, smallest-first so every chip-minute of
+# an unpredictable tunnel window lands evidence before the window can
+# close (VERDICT r04 next-round item 1):
+#
+#   warmup (tiny shapes, populates the persistent compile cache)
+#   -> TPU test lane (kernel correctness on hardware, VERDICT item 3)
+#   -> tile/block sweep (pick tuned constants BEFORE macro numbers)
+#   -> trafalgar bench -> phase profile -> venice -> final -> final_mixed
+#
 # Launch with:  nohup bash scripts/run_tpu_round.sh > tpu_round.log 2>&1 &
 # NEVER kill any of these processes mid-run (single-client tunnel:
 # killing a claim holder wedges it for hours).  Everything is sized to
@@ -7,11 +15,21 @@
 #
 # Every artifact is git-committed THE MOMENT it lands (the tunnel wedge
 # has twice eaten end-of-round results): per-config bench JSON, the tpu
-# test-lane log, PROFILE_RAW.json, SWEEP_RAW.json, and tpu_round.log
+# test-lane log, SWEEP_RAW.json, PROFILE_RAW.json, and tpu_round.log
 # itself.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 echo "=== $(date -u) TPU round start ==="
+
+# Persistent XLA compile cache: belt (env vars, inherited by every
+# child) and braces (enable_persistent_compile_cache() inside each
+# entry point).  Venice-scale compiles cost tens of seconds to minutes;
+# paying them once per shape EVER instead of once per process is the
+# single biggest lever on measurement-per-chip-minute.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 commit_now() {
   # Best-effort immediate evidence commit; never let a git hiccup (e.g.
@@ -36,14 +54,40 @@ echo "--- probe"
 if ! probe; then
   echo "probe failed; aborting"; exit 1
 fi
+# The bash probe above just proved the tunnel healthy; skip the per-
+# entry-point subprocess re-probe (each one claims the single-client
+# tunnel for up to 120s — chip-minutes spent proving what we know).
+export MEGBA_BENCH_SKIP_PROBE=1
+
+echo "--- warmup: tiny-shape compile pass (populates the persistent cache)"
+# entry() + jit in one short process: proves end-to-end lowering on
+# hardware in under a minute, and if the tunnel dies mid-window later
+# runs of the same shapes start from the on-disk cache.  The SIGTERM
+# handler goes in BEFORE jax so a fired timeout exits through PJRT
+# teardown instead of orphaning the tunnel claim (the wedge cause).
+timeout -k 60 900 python - <<'EOF' 2>&1 | tail -5
+import signal
+signal.signal(signal.SIGTERM, lambda s, f: (_ for _ in ()).throw(SystemExit(143)))
+import __graft_entry__ as G
+import jax
+fn, args = G.entry()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+print("warmup entry cost:", float(out[0]))
+EOF
+COMMIT_MSG="TPU warmup compile pass" commit_now
 
 echo "--- tpu test lane"
 MEGBA_TPU_TESTS=1 python -m pytest tests/ -m tpu -p no:cacheprovider -q \
   2>&1 | tee tpu_test_lane.log
 COMMIT_MSG="Record TPU test-lane run" commit_now tpu_test_lane.log
 
-echo "--- benches"
-for cfg in trafalgar venice ladybug final final_mixed; do
+echo "--- tile/block sweep trafalgar-scale (measured; picks tuned constants)"
+MEGBA_BENCH_CONFIG=trafalgar python scripts/sweep_tiles.py || true
+COMMIT_MSG="Record hardware tile/block sweep (trafalgar)" commit_now SWEEP_RAW.json
+
+echo "--- benches (smallest first)"
+for cfg in trafalgar venice final final_mixed; do
   echo "=== bench $cfg $(date -u) ==="
   if MEGBA_BENCH_CONFIG=$cfg python bench.py | tee "BENCH_tpu_${cfg}.json"
   then
@@ -51,6 +95,13 @@ for cfg in trafalgar venice ladybug final final_mixed; do
       commit_now "BENCH_tpu_${cfg}.json"
   else
     echo "bench $cfg FAILED"
+  fi
+  # Phase profile right after the first successful macro bench so a
+  # short window still yields a measured (not modelled) phase table.
+  if [ "$cfg" = trafalgar ]; then
+    echo "--- profile trafalgar $(date -u)"
+    MEGBA_BENCH_CONFIG=trafalgar python scripts/profile_phases.py || true
+    COMMIT_MSG="Record hardware phase profile (trafalgar)" commit_now PROFILE_RAW.json
   fi
 done
 

@@ -72,9 +72,13 @@ def analytic(plan_c, plan_p):
 
 
 def main():
-    from megba_tpu.utils.backend import install_graceful_term
+    from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache,
+        install_graceful_term,
+    )
 
     install_graceful_term()
+    enable_persistent_compile_cache()
     import jax
 
     from megba_tpu.utils.backend import respect_jax_platforms

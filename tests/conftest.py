@@ -34,6 +34,14 @@ if not TPU_LANE:
     # (before any device query) keeps the whole suite off the TPU.
     jax.config.update("jax_platforms", "cpu")
 
+if TPU_LANE:
+    # Chip minutes are scarce: persist compiled executables across TPU
+    # lane runs (and share them with bench/profile runs of the same
+    # shapes) so a tunnel window is spent measuring, not recompiling.
+    from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
 _cpus = jax.devices("cpu") if not TPU_LANE else []
 if _cpus:
     jax.config.update("jax_default_device", _cpus[0])
