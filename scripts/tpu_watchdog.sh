@@ -1,42 +1,80 @@
 #!/bin/bash
-# TPU tunnel watchdog: probe periodically; the moment the backend comes
-# up, hand off to the full measurement pass (scripts/run_tpu_round.sh).
+# TPU tunnel watchdog: detect a live tunnel fast and cheaply, then hand
+# off to the full measurement pass (scripts/run_tpu_round.sh).
 # Launch detached:  nohup bash scripts/tpu_watchdog.sh >> tpu_probe.log 2>&1 &
 #
-# Every probe attempt (success or timeout) is appended to tpu_probe.log
-# with a UTC timestamp so a wedged-all-round tunnel leaves committed
-# evidence (VERDICT r02 item 7).  The probe runs in a subprocess with a
-# generous timeout: backend acquisition through the single-client tunnel
-# can take minutes when healthy, and a hung probe must not block the
-# loop forever.
+# Two-stage probing (WEDGE.md):  the axon PJRT client's first network
+# leg is GET http://127.0.0.1:8083/init — when the loopback relay is
+# down (the observed wedge mode, every outage round 1-5), that connect
+# is refused instantly and jax.devices() retries forever inside native
+# code.  So stage 1 is a 1-second pure-bash TCP pre-check of
+# 127.0.0.1:8083 every POLL_S seconds: no jax, no claim, nothing that
+# can be SIGKILLed mid-claim, and a tunnel window is noticed within
+# ~POLL_S instead of up to 15 min into it.  Only when the port accepts
+# does stage 2 run the real SIGTERM-handled jax probe (which can still
+# take minutes when healthy).
+#
+# State TRANSITIONS are logged with UTC timestamps (plus a heartbeat
+# every HEARTBEAT_N polls) so a wedged-all-round tunnel leaves committed
+# evidence without megabytes of refused-connect spam.
 set -u
 cd "$(dirname "$0")/.."
 
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-300}"
-SLEEP_BETWEEN="${SLEEP_BETWEEN:-900}"
+POLL_S="${POLL_S:-45}"
+HEARTBEAT_N="${HEARTBEAT_N:-40}"      # ~30 min at POLL_S=45
+BACKOFF_S="${BACKOFF_S:-900}"         # after a relay-up-but-probe-dead probe
 MAX_HOURS="${MAX_HOURS:-11}"
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 
-attempt=0
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  attempt=$((attempt + 1))
-  echo "=== probe attempt $attempt $(date -u +%Y-%m-%dT%H:%M:%SZ) (timeout ${PROBE_TIMEOUT}s) ==="
-  # The probe installs a SIGTERM handler BEFORE touching jax so the
-  # `timeout` TERM produces a clean PJRT teardown (releases any partial
-  # tunnel claim); -k 30 SIGKILLs only if the child is stuck in C code.
-  if timeout -k 30 "$PROBE_TIMEOUT" python -c "
+relay_up() {
+  timeout 1 bash -c 'echo > /dev/tcp/127.0.0.1/8083' 2>/dev/null
+}
+
+jax_probe() {
+  # SIGTERM handler BEFORE jax so the `timeout` TERM produces a clean
+  # PJRT teardown (releases any partial tunnel claim); -k 30 SIGKILLs
+  # only if the child is stuck in native code.
+  timeout -k 30 "$PROBE_TIMEOUT" python -c "
 import signal
 signal.signal(signal.SIGTERM, lambda s, f: (_ for _ in ()).throw(SystemExit(143)))
 import jax
 print('devices:', jax.devices(), flush=True)
-"; then
-    echo "=== tunnel ALIVE at $(date -u +%Y-%m-%dT%H:%M:%SZ); launching TPU round ==="
-    bash scripts/run_tpu_round.sh >> tpu_round.log 2>&1
-    echo "=== TPU round finished at $(date -u +%Y-%m-%dT%H:%M:%SZ) (see tpu_round.log) ==="
-    exit 0
-  else
-    echo "--- probe failed/timed out (rc=$?) at $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+"
+}
+
+state="unknown"
+poll=0
+down_polls=0
+echo "=== watchdog start $(date -u +%Y-%m-%dT%H:%M:%SZ) (poll ${POLL_S}s, pre-check 127.0.0.1:8083) ==="
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  poll=$((poll + 1))
+  if relay_up; then
+    down_polls=0
+    echo "=== relay :8083 ACCEPTING at $(date -u +%Y-%m-%dT%H:%M:%SZ) (poll $poll); running jax probe ==="
+    jax_probe
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      echo "=== tunnel ALIVE at $(date -u +%Y-%m-%dT%H:%M:%SZ); launching TPU round ==="
+      bash scripts/run_tpu_round.sh >> tpu_round.log 2>&1
+      echo "=== TPU round finished at $(date -u +%Y-%m-%dT%H:%M:%SZ) (see tpu_round.log) ==="
+      exit 0
+    fi
+    # rc=124: timeout's SIGTERM sufficed (clean teardown). rc=137: the
+    # child was stuck in native code and took the -k SIGKILL. The
+    # distinction is round-4 evidence — keep it accurate in the log.
+    echo "--- relay up but jax probe failed (rc=$rc) at $(date -u +%Y-%m-%dT%H:%M:%SZ) — init/claim-leg failure mode (WEDGE.md); backing off ${BACKOFF_S}s"
+    state="relay-up-probe-dead"
+    sleep "$BACKOFF_S"
+    continue
   fi
-  sleep "$SLEEP_BETWEEN"
+  down_polls=$((down_polls + 1))
+  if [ "$state" != "relay-down" ]; then
+    echo "--- relay :8083 refused at $(date -u +%Y-%m-%dT%H:%M:%SZ) (poll $poll): tunnel down (relay absent)"
+    state="relay-down"
+  elif [ $((down_polls % HEARTBEAT_N)) -eq 0 ]; then
+    echo "--- heartbeat $(date -u +%Y-%m-%dT%H:%M:%SZ): relay down for $down_polls consecutive polls (~$((down_polls * POLL_S / 60)) min)"
+  fi
+  sleep "$POLL_S"
 done
 echo "=== watchdog deadline reached $(date -u +%Y-%m-%dT%H:%M:%SZ); tunnel never came up ==="
