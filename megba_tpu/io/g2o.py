@@ -71,6 +71,12 @@ class G2OGraph:
     fixed: np.ndarray
     ids: np.ndarray
     se2: bool = False
+    # Whether the source file carried explicit FIX records.  read_g2o
+    # defaults fixed[0]=True when none were present (the solver needs a
+    # gauge anchor), but write_g2o must not materialize that default as
+    # a FIX line the original file never had — external g2o consumers
+    # treat FIX as a semantic statement about gauge handling.
+    had_fix: bool = True
 
 
 def _upper_tri_to_full_batch(tri: np.ndarray, n: int = 6) -> np.ndarray:
@@ -154,6 +160,7 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
     e_vals: list[list] = []  # SE3: 28 tokens; SE2: 9 tokens
     se2_seen = False
     se3_seen = False
+    had_fix = False
 
     for ln, line in enumerate(source, 1):
         tok = line.split()
@@ -164,21 +171,29 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             if len(tok) != 9:
                 raise ValueError(
                     f"line {ln}: VERTEX_SE3:QUAT needs 7 values "
-                    f"(x y z qx qy qz qw), got {len(tok) - 2}")
-            verts[int(tok[1])] = (False, tok[2:])
+                    f"(x y z qx qy qz qw), got {max(0, len(tok) - 2)} "
+                    f"({len(tok)} tokens)")
+            vid = int(tok[1])
+            if vid in verts:
+                raise ValueError(f"line {ln}: duplicate VERTEX id {vid}")
+            verts[vid] = (False, tok[2:])
             se3_seen = True
         elif tag == "VERTEX_SE2":
             if len(tok) != 5:
                 raise ValueError(
                     f"line {ln}: VERTEX_SE2 needs 3 values (x y theta), "
-                    f"got {len(tok) - 2}")
-            verts[int(tok[1])] = (True, tok[2:])
+                    f"got {max(0, len(tok) - 2)} ({len(tok)} tokens)")
+            vid = int(tok[1])
+            if vid in verts:
+                raise ValueError(f"line {ln}: duplicate VERTEX id {vid}")
+            verts[vid] = (True, tok[2:])
             se2_seen = True
         elif tag == "EDGE_SE3:QUAT":
             if len(tok) != 3 + 7 + 21:
                 raise ValueError(
                     f"line {ln}: EDGE_SE3:QUAT needs 7 measurement + 21 "
-                    f"info values, got {len(tok) - 3}")
+                    f"info values, got {max(0, len(tok) - 3)} "
+                    f"({len(tok)} tokens)")
             e_ids.append((int(tok[1]), int(tok[2])))
             e_se2.append(False)
             e_vals.append(tok[3:])
@@ -187,12 +202,14 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             if len(tok) != 3 + 3 + 6:
                 raise ValueError(
                     f"line {ln}: EDGE_SE2 needs 3 measurement + 6 info "
-                    f"values, got {len(tok) - 3}")
+                    f"values, got {max(0, len(tok) - 3)} "
+                    f"({len(tok)} tokens)")
             e_ids.append((int(tok[1]), int(tok[2])))
             e_se2.append(True)
             e_vals.append(tok[3:])
             se2_seen = True
         elif tag == "FIX":
+            had_fix = True
             fixed_ids.update(int(t) for t in tok[1:])
         # Unknown tags (VERTEX_TRACKXYZ, landmark edges, ...) are
         # skipped: partial ingestion of mixed graphs is standard g2o
@@ -261,12 +278,17 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
     for vid in fixed_ids:
         if vid in index:
             fixed[index[vid]] = True
+    # had_fix must mean "the output's FIX rows came from the file":
+    # a FIX that only referenced skipped vertices (mixed graphs with
+    # unknown tags) leaves nothing anchored, and the fallback anchor
+    # below is ours, not the file's.
+    had_fix = had_fix and bool(fixed.any())
     if not fixed.any():
         fixed[0] = True  # gauge anchor, same default as solve_pgo
 
     return G2OGraph(poses=poses, edge_i=edge_i, edge_j=edge_j, meas=meas,
                     info=info, fixed=fixed, ids=ids,
-                    se2=se2_seen and not se3_seen)
+                    se2=se2_seen and not se3_seen, had_fix=had_fix)
 
 
 def write_g2o(dest: Union[str, TextIO], graph: G2OGraph,
@@ -275,7 +297,11 @@ def write_g2o(dest: Union[str, TextIO], graph: G2OGraph,
 
     Always writes the SE(3) form — lifted SE(2) graphs round-trip
     through it losslessly (z/roll/pitch stay zero at the optimum).
-    A .gz/.bz2 destination is compressed transparently.
+    A .gz/.bz2 destination is compressed transparently.  FIX records
+    are written only when the graph carried them (``had_fix``): the
+    solver's default gauge anchor (fixed[0]) is an internal choice, and
+    materializing it would hand external g2o consumers a FIX the
+    original file never declared.
     """
     if isinstance(dest, str):
         with _open_text(dest, "wt") as f:
@@ -291,9 +317,10 @@ def write_g2o(dest: Union[str, TextIO], graph: G2OGraph,
             f"VERTEX_SE3:QUAT {int(vid)} "
             f"{t[0]:.9g} {t[1]:.9g} {t[2]:.9g} "
             f"{q[0]:.9g} {q[1]:.9g} {q[2]:.9g} {q[3]:.9g}\n")
-    for k in range(len(graph.ids)):
-        if graph.fixed[k]:
-            dest.write(f"FIX {int(graph.ids[k])}\n")
+    if graph.had_fix:
+        for k in range(len(graph.ids)):
+            if graph.fixed[k]:
+                dest.write(f"FIX {int(graph.ids[k])}\n")
     meas_q = _aa_to_quat_xyzw(graph.meas[:, :3])
     tri_all = _info_ours_to_g2o(graph.info)[:, _TRIU[0], _TRIU[1]]
     for e in range(graph.edge_i.shape[0]):
