@@ -1,0 +1,200 @@
+"""Adversarial fixtures for the two layers that touch user bytes
+(VERDICT r04 item 8): the g2o reader (io/g2o.py) and the BAL loaders
+(io/bal.py + native/bal_parser.cpp).
+
+Real exports hit these cases routinely: duplicate edges from merged
+sessions, self-loop closures from buggy front-ends, disconnected
+components from dropped tracking, Windows line endings, and files
+truncated mid-transfer.  The reference has no ingestion layer beyond
+its example-side fscanf loop (reference examples/BAL_Double.cpp:74-139),
+so this coverage is ours to define: parse what is semantically valid,
+reject what is not — loudly, with context, never with a crash or a
+silently wrong graph.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+from megba_tpu.io.bal import load_bal, save_bal, loads_bal
+from megba_tpu.io.g2o import read_g2o, solve_g2o
+from megba_tpu.models.pgo import spanning_tree_init
+
+_EDGE_INFO = "1 0 0 0 0 0 1 0 0 0 0 1 0 0 0 1 0 0 1 0 1"
+
+
+def _opt(max_iter=10):
+    # Tight stops: the self-loop test adds a constant cost floor that
+    # would otherwise trip the relative-improvement stop early.
+    return ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-14,
+                               epsilon2=1e-16),
+        solver_option=SolverOption(max_iter=40, tol=1e-12),
+    )
+
+
+# ---------------------------------------------------------------- g2o
+
+
+def test_duplicate_edges_are_kept_as_repeated_constraints():
+    """Two identical EDGE records = the same factor twice (merged
+    sessions do this); both must survive parsing and the solve."""
+    text = f"""\
+VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1
+VERTEX_SE3:QUAT 1 1.2 0 0 0 0 0 1
+EDGE_SE3:QUAT 0 1 1 0 0 0 0 0 1 {_EDGE_INFO}
+EDGE_SE3:QUAT 0 1 1 0 0 0 0 0 1 {_EDGE_INFO}
+"""
+    g = read_g2o(io.StringIO(text))
+    assert g.edge_i.shape[0] == 2
+    _, res = solve_g2o(g, _opt())
+    assert float(res.cost) < 1e-6
+    # The doubled factor doubles the initial cost vs the single-edge
+    # graph — evidence the second record is not dropped.
+    g1 = read_g2o(io.StringIO("\n".join(text.splitlines()[:-1]) + "\n"))
+    _, res1 = solve_g2o(g1, _opt(max_iter=0))
+    _, res2 = solve_g2o(g, _opt(max_iter=0))
+    np.testing.assert_allclose(
+        float(res2.initial_cost), 2 * float(res1.initial_cost), rtol=1e-12)
+
+
+def test_self_loop_edge_contributes_constant_cost_only():
+    """EDGE i i m: the relative pose of a vertex to itself is the
+    identity regardless of the estimate, so the factor is a constant
+    cost offset with zero gradient — it must not crash or corrupt the
+    solve."""
+    text = f"""\
+VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1
+VERTEX_SE3:QUAT 1 1.3 0 0 0 0 0 1
+EDGE_SE3:QUAT 0 1 1 0 0 0 0 0 1 {_EDGE_INFO}
+EDGE_SE3:QUAT 1 1 0.5 0 0 0 0 0 1 {_EDGE_INFO}
+"""
+    g = read_g2o(io.StringIO(text))
+    _, res = solve_g2o(g, _opt())
+    # The real edge is solved to zero; the self-loop's 0.5^2/... cost
+    # floor remains (0.5**2 * 1.0 factor, halved by the 1/2 convention
+    # if any — just assert the floor is the self-loop residual norm).
+    assert np.isfinite(float(res.cost))
+    assert float(res.cost) == pytest.approx(0.25, rel=1e-4)
+    # And the movable vertex still reached its measurement.
+    np.testing.assert_allclose(res.poses[1, 3], 1.0, atol=1e-4)
+
+
+def test_spanning_tree_init_on_forest_keeps_unreachable_estimates():
+    """Disconnected components: the BFS init must initialize the
+    anchored component from measurements and leave unreachable poses
+    at their file estimates (not zeros, not garbage)."""
+    poses0 = np.array([
+        [0, 0, 0, 0, 0, 0],
+        [0, 0, 0, 9, 9, 9],     # reachable: bad file estimate
+        [0, 0, 0, 5, 5, 5],     # island A
+        [0, 0, 0, 6, 6, 6],     # island B, connected to A
+    ], np.float64)
+    edge_i = np.array([0, 2], np.int32)
+    edge_j = np.array([1, 3], np.int32)
+    meas = np.array([[0, 0, 0, 1, 0, 0],
+                     [0, 0, 0, 0, 2, 0]], np.float64)
+    fixed = np.array([True, False, False, False])
+    out = spanning_tree_init(poses0, edge_i, edge_j, meas, fixed)
+    # Component of the anchor: composed measurement wins.
+    np.testing.assert_allclose(out[1], [0, 0, 0, 1, 0, 0], atol=1e-12)
+    # Island: no path from an anchor -> file estimates preserved.
+    np.testing.assert_allclose(out[2], poses0[2])
+    np.testing.assert_allclose(out[3], poses0[3])
+
+
+def test_crlf_g2o_parses_identically(tmp_path):
+    text = f"""\
+VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1
+VERTEX_SE3:QUAT 1 1 0 0 0 0 0 1
+EDGE_SE3:QUAT 0 1 1 0 0 0 0 0 1 {_EDGE_INFO}
+FIX 0
+"""
+    lf = tmp_path / "lf.g2o"
+    crlf = tmp_path / "crlf.g2o"
+    lf.write_text(text)
+    crlf.write_bytes(text.replace("\n", "\r\n").encode())
+    a = read_g2o(str(lf))
+    b = read_g2o(str(crlf))
+    np.testing.assert_array_equal(a.ids, b.ids)
+    np.testing.assert_allclose(a.poses, b.poses)
+    np.testing.assert_allclose(a.meas, b.meas)
+    np.testing.assert_allclose(a.info, b.info)
+    assert a.had_fix == b.had_fix
+
+
+# ---------------------------------------------------------------- BAL
+
+
+def _tiny_bal_text():
+    return loads_bal(
+        "2 2 3\n"
+        "0 0 1.0 2.0\n"
+        "0 1 -1.5 0.25\n"
+        "1 1 3.0 -2.0\n"
+        + "\n".join(f"{0.01 * i:.17g}" for i in range(2 * 9 + 2 * 3)) + "\n"
+    )
+
+
+def test_crlf_bal_parses_identically(tmp_path):
+    bal = _tiny_bal_text()
+    lf = tmp_path / "lf.txt"
+    crlf = tmp_path / "crlf.txt"
+    save_bal(lf, bal)
+    crlf.write_bytes(lf.read_bytes().replace(b"\n", b"\r\n"))
+    a = load_bal(lf)
+    b = load_bal(crlf)
+    np.testing.assert_array_equal(a.cam_idx, b.cam_idx)
+    np.testing.assert_allclose(a.cameras, b.cameras)
+    np.testing.assert_allclose(a.points, b.points)
+    np.testing.assert_allclose(a.obs, b.obs)
+
+
+def test_truncated_bal_tail_raises_cleanly(tmp_path):
+    """A file cut mid-transfer (every byte length) must raise ValueError
+    — never crash the native scanner or hand back a partial problem.
+    The NUL-terminated-buffer design claims exactly this safety."""
+    bal = _tiny_bal_text()
+    full = tmp_path / "full.txt"
+    save_bal(full, bal)
+    raw = full.read_bytes()
+    cut = tmp_path / "cut.txt"
+    # Chop at several points: inside the header, mid-observations,
+    # mid-cameras.  (A cut inside the LAST token that leaves a valid
+    # numeric prefix — e.g. "0.23" -> "0.2" — is undetectable in a
+    # checksum-less text format; the reference's fscanf loader has the
+    # same property, so the contract here is "any cut that removes a
+    # whole token raises".)
+    for frac in (0.02, 0.3, 0.7):
+        cut.write_bytes(raw[: int(len(raw) * frac)])
+        with pytest.raises(ValueError):
+            load_bal(cut)
+    # One byte past the final complete token boundary: drop the last
+    # token entirely (cut at the preceding whitespace) -> must raise.
+    last_ws = raw.rstrip().rfind(b"\n")
+    cut.write_bytes(raw[:last_ws])
+    with pytest.raises(ValueError):
+        load_bal(cut)
+
+
+def test_bal_trailing_garbage_raises(tmp_path):
+    bal = _tiny_bal_text()
+    p = tmp_path / "garbage.txt"
+    save_bal(p, bal)
+    with open(p, "a") as f:
+        f.write("42.0 17.0\n")
+    with pytest.raises(ValueError):
+        load_bal(p)
+
+
+def test_empty_and_whitespace_bal_raise(tmp_path):
+    p = tmp_path / "empty.txt"
+    p.write_text("")
+    with pytest.raises(ValueError):
+        load_bal(p)
+    p.write_text(" \n \t \r\n ")
+    with pytest.raises(ValueError):
+        load_bal(p)
