@@ -225,7 +225,7 @@ def solve_pgo(
     if n_pad:
         meas_np, edge_i, edge_j, emask_np = pad_edges(
             meas_np, edge_i, edge_j, world, dtype=np.float64)
-        emask = jnp.asarray(emask_np, dtype)
+        emask = np.asarray(emask_np, dtype)
         if si_np is not None:
             si_np = np.concatenate(
                 [si_np, np.zeros((n_pad, 6, 6), si_np.dtype)])
@@ -236,13 +236,16 @@ def solve_pgo(
     else:
         fixed_np = np.asarray(fixed, bool)
 
-    poses_fm = jnp.asarray(np.ascontiguousarray(poses0.T), dtype)
-    fixed_j = jnp.asarray(fixed_np)
-    ei = jnp.asarray(edge_i)
-    ej = jnp.asarray(edge_j)
-    meas_fm = jnp.asarray(np.ascontiguousarray(meas_np.T), dtype)
-    si = (None if si_np is None else jnp.asarray(
-        np.ascontiguousarray(np.transpose(si_np, (1, 2, 0))), dtype))
+    # Host numpy until dispatch (same contract as flat_solve): the
+    # jitted program uploads once, and the multi-process path builds
+    # global arrays straight from host memory.
+    poses_fm = np.ascontiguousarray(poses0.T).astype(dtype, copy=False)
+    fixed_j = fixed_np
+    ei = np.asarray(edge_i)
+    ej = np.asarray(edge_j)
+    meas_fm = np.ascontiguousarray(meas_np.T).astype(dtype, copy=False)
+    si = (None if si_np is None else np.ascontiguousarray(
+        np.transpose(si_np, (1, 2, 0))).astype(dtype, copy=False))
 
     # emask (only when the edge axis was padded) and si (only when the
     # caller weights edges) ride as optional trailing operands, so the
@@ -267,8 +270,23 @@ def solve_pgo(
             jnp.asarray(region0, dtype), jnp.asarray(v0, dtype),
             jnp.asarray(_next_verbose_token(), jnp.int32), *extras]
     if mesh is not None:
-        with jax.default_device(mesh.devices.flat[0]):
-            out = prog(*args)
+        from megba_tpu.parallel.multihost import (
+            globalize_for_mesh, mesh_is_multiprocess)
+
+        if mesh_is_multiprocess(mesh):
+            # Multi-host: lift every operand into a global array (each
+            # process contributes its devices' shards) — same contract
+            # as distributed_lm_solve.
+            specs = _pgo_in_specs(tuple(extra_keys))
+            args = [globalize_for_mesh(mesh, a, s)
+                    for a, s in zip(args, specs)]
+            local0 = next(d for d in mesh.devices.flat
+                          if d.process_index == jax.process_index())
+            with jax.default_device(local0):
+                out = prog(*args)
+        else:
+            with jax.default_device(mesh.devices.flat[0]):
+                out = prog(*args)
     else:
         out = prog(*args)
 
@@ -284,6 +302,18 @@ def solve_pgo(
               f"({int(result.accepted)} accepted, "
               f"{int(result.pcg_iterations)} PCG)", flush=True)
     return result
+
+
+def _pgo_in_specs(extra_keys):
+    """Input partition specs of the sharded PGO program, in arg order.
+
+    One source of truth for _pgo_program's shard_map AND the dispatch
+    site's multi-process globalization (they must never drift apart).
+    """
+    rep = P()
+    spec_of = {"emask": P(EDGE_AXIS), "si": P(None, None, EDGE_AXIS)}
+    return [rep, rep, P(EDGE_AXIS), P(EDGE_AXIS), P(None, EDGE_AXIS),
+            rep, rep, rep, *(spec_of[k] for k in extra_keys)]
 
 
 @functools.lru_cache(maxsize=32)
@@ -454,14 +484,15 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
 
     if world > 1:
         mesh = make_mesh(world)
-        rep = P()
-        spec_of = {"emask": P(EDGE_AXIS), "si": P(None, None, EDGE_AXIS)}
-        in_specs = [rep, rep, P(EDGE_AXIS), P(EDGE_AXIS),
-                    P(None, EDGE_AXIS), rep, rep, rep,
-                    *(spec_of[k] for k in extra_keys)]
+        in_specs = _pgo_in_specs(extra_keys)
+        # poses_fm donated: the result's poses alias the input buffer
+        # (solve_pgo hands over a fresh feature-major copy per call, and
+        # the checkpointed chunk driver feeds each chunk's output into
+        # the next call without other readers).
         return jax.jit(jax.shard_map(
-            run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P())), mesh
-    return jax.jit(run), None
+            run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P()),
+            donate_argnums=(0,)), mesh
+    return jax.jit(run, donate_argnums=(0,)), None
 
 
 @dataclasses.dataclass

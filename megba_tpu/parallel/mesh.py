@@ -157,6 +157,21 @@ def distributed_lm_solve(
         residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
         cam_sorted)
 
+    from megba_tpu.parallel.multihost import (
+        globalize_for_mesh, mesh_is_multiprocess)
+
+    if mesh_is_multiprocess(mesh):
+        # Multi-host: the jitted program only accepts global arrays —
+        # each process contributes the shards its devices own.  Host
+        # prep ran identically on every host (flat_solve's multi-host
+        # contract), so each arg is lifted from the full local value.
+        args = [globalize_for_mesh(mesh, a, s)
+                for a, s in zip(args, in_specs)]
+        local0 = next(d for d in mesh.devices.flat
+                      if d.process_index == jax.process_index())
+        with jax.default_device(local0):
+            return jitted(*args)
+
     with jax.default_device(mesh.devices.flat[0]):
         return jitted(*args)
 
@@ -203,7 +218,9 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
             **kwargs)
 
     sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
-    return jax.jit(sharded)
+    # Donate the replicated parameter blocks (same contract as
+    # solve._build_single_solve: flat_solve hands over fresh operands).
+    return jax.jit(sharded, donate_argnums=(0, 1))
 
 
 # Global program cache for long-lived engines.  jax.jit caches by callable

@@ -70,3 +70,39 @@ def initialize_multihost(
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+def mesh_is_multiprocess(mesh) -> bool:
+    """True when the mesh's devices span more than one OS process."""
+    procs = {d.process_index for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+def globalize_for_mesh(mesh, x, spec):
+    """Lift one host array into a global jax.Array for a multi-process mesh.
+
+    A jitted program over a mesh that spans processes only accepts
+    *global* arrays: every process contributes the shards its own
+    devices hold.  Each process is expected to hold the FULL host-side
+    value (the multi-host contract of flat_solve/solve_pgo: all hosts
+    run the same host prep on the same problem), so
+    `jax.make_array_from_callback` — which asks for exactly the index
+    slices this process's devices own — is correct by construction for
+    any device-to-process layout and any per-process device count.
+    Pytrees (e.g. the tiled plans) are mapped leaf-wise with the same
+    spec.  Call with host numpy values where possible: the callback
+    then slices host memory directly (no device round-trip).
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    if x is None:
+        return None
+    sharding = NamedSharding(mesh, spec)
+
+    def lift(leaf):
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    return jax.tree_util.tree_map(lift, x)

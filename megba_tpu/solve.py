@@ -65,7 +65,13 @@ def _build_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted):
             initial_v=init_v, verbose_token=verbose_token,
             **dict(zip(keys, extras)))
 
-    return jax.jit(fn)
+    # Donate the parameter blocks: the result's cameras/points alias the
+    # inputs' buffers instead of allocating fresh ones (at Final scale
+    # ~53 MB f32 of params per solve call; matters most for chunked /
+    # checkpointed drivers that call the program in a loop).  Safe:
+    # flat_solve materializes fresh feature-major operands per call and
+    # never reads them after the solve.
+    return jax.jit(fn, donate_argnums=(0, 1))
 
 
 # Global program cache for long-lived engines (same pitfall and remedy as
@@ -194,24 +200,27 @@ def flat_solve(
                 np.eye(si.shape[1], dtype=dtype), (pad,) + si.shape[1:])
             si = np.concatenate([si, eye])
         # [nE, od, od] -> feature-major rows [od*od, nE]
-        sqrt_info_j = jnp.asarray(
-            np.ascontiguousarray(si.reshape(n_padded, -1).T))
+        sqrt_info_j = np.ascontiguousarray(si.reshape(n_padded, -1).T)
     else:
         sqrt_info_j = None
-    cam_fixed_j = None if cam_fixed is None else jnp.asarray(cam_fixed)
-    pt_fixed_j = None if pt_fixed is None else jnp.asarray(pt_fixed)
+    cam_fixed_j = None if cam_fixed is None else np.asarray(cam_fixed)
+    pt_fixed_j = None if pt_fixed is None else np.asarray(pt_fixed)
 
     # Feature-major boundary transposes (host numpy, once per solve).
-    cameras_fm = jnp.asarray(np.ascontiguousarray(cameras.T))
-    points_fm = jnp.asarray(np.ascontiguousarray(points.T))
-    obs_fm = jnp.asarray(np.ascontiguousarray(obs.T))
+    # Stay on HOST here: the jitted program uploads each operand exactly
+    # once on call — and the multi-process path builds global arrays
+    # straight from host memory (a premature jnp.asarray would cost a
+    # device->host->device round trip per operand there).
+    cameras_fm = np.ascontiguousarray(cameras.T)
+    points_fm = np.ascontiguousarray(points.T)
+    obs_fm = np.ascontiguousarray(obs.T)
 
     if ws > 1:
         mesh = make_mesh(ws)
         result = distributed_lm_solve(
             residual_jac_fn, cameras_fm, points_fm,
-            obs_fm, jnp.asarray(cam_idx), jnp.asarray(pt_idx),
-            jnp.asarray(mask), option, mesh,
+            obs_fm, np.asarray(cam_idx), np.asarray(pt_idx),
+            np.asarray(mask), option, mesh,
             sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
             verbose=verbose, cam_sorted=True, plans=plans,
             initial_region=initial_region, initial_v=initial_v,

@@ -1,0 +1,158 @@
+"""Double-semantics parity: f64 vs f32(+compensated reductions).
+
+The reference's default examples are double precision end to end
+(reference examples/BAL_Double.cpp:50-58, fp64 cuBLAS dispatch in its
+wrapper layer); on TPU this framework instead runs f32 storage with
+compensated f32 reductions (ops/accum.py) and makes a *semantic* claim:
+the optimizer follows the same trajectory to the same optimum within
+the f32 representation floor.  VERDICT r04 item 4 asks for that claim
+to be MEASURED, not made by construction.
+
+This script runs the identical problem (generated once in f64, cast for
+the f32 run) through the identical LM configuration in both dtypes on
+the CPU backend, captures the per-iteration cost curves from the
+solver's verbose lines (the reference's own observable,
+lm_algo.cu:149-162), and writes DOUBLE_PARITY.json with both curves and
+their relative gaps.  Exit code is nonzero if the final costs disagree
+beyond the stated tolerance, so CI can run a small-scale version.
+
+Usage:
+  MEGBA_PARITY_CONFIGS=trafalgar,venice [MEGBA_BENCH_SCALE=1.0] \
+      python scripts/double_parity.py
+"""
+from __future__ import annotations
+
+import contextlib
+import io as _io
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Final-cost agreement tolerance: the f32 cost functional at the f64
+# optimum differs from the f64 cost by O(eps_f32 * kappa); 1e-4 relative
+# is conservative for these conditionings and catches any real
+# divergence (a wrong trajectory lands orders of magnitude away).
+REL_TOL = 1e-4
+
+_LINE = re.compile(
+    r"iter (\d+): cost ([0-9.eE+-]+) .*accept (True|False) "
+    r"pcg_iters (\d+)")
+
+
+def run_one(cfg_name: str, scale: float):
+    import jax
+
+    from megba_tpu.common import (
+        AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption)
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+    import bench as B
+
+    c = B.CONFIGS[cfg_name]
+    n_cam = max(8, int(c.cameras * scale))
+    n_pt = max(64, int(c.points * scale))
+    s = make_synthetic_bal(
+        num_cameras=n_cam, num_points=n_pt, obs_per_point=c.obs_per_point,
+        seed=0, param_noise=1e-2, pixel_noise=0.5, dtype=np.float64)
+
+    jac = JacobianMode[c.jacobian]
+    ck = ComputeKind[c.compute]
+    f = make_residual_jacobian_fn(mode=jac)
+
+    out = {"config": cfg_name, "scale": scale, "cameras": n_cam,
+           "points": n_pt, "edges": int(s.obs.shape[0]),
+           "jacobian": c.jacobian, "compute": c.compute, "runs": {}}
+    for dtype in (np.float64, np.float32):
+        option = ProblemOption(
+            dtype=np.dtype(dtype),
+            compute_kind=ck,
+            jacobian_mode=jac,
+            algo_option=AlgoOption(max_iter=20, epsilon1=1e-14,
+                                   epsilon2=1e-16),
+            solver_option=SolverOption(max_iter=50, tol=1e-12,
+                                       refuse_ratio=1e30),
+        )
+        buf = _io.StringIO()
+        t0 = time.perf_counter()
+        with contextlib.redirect_stdout(buf):
+            res = flat_solve(
+                f,
+                s.cameras0.astype(dtype), s.points0.astype(dtype),
+                s.obs.astype(dtype),
+                s.cam_idx, s.pt_idx, option, verbose=True)
+            jax.block_until_ready(res.cost)
+        elapsed = time.perf_counter() - t0
+        curve = []
+        for m in _LINE.finditer(buf.getvalue()):
+            curve.append({"iter": int(m.group(1)),
+                          "cost": float(m.group(2)),
+                          "accept": m.group(3) == "True",
+                          "pcg_iters": int(m.group(4))})
+        out["runs"][np.dtype(dtype).name] = {
+            "initial_cost": float(res.initial_cost),
+            "final_cost": float(res.cost),
+            "iterations": int(res.iterations),
+            "accepted": int(res.accepted),
+            "pcg_iterations": int(res.pcg_iterations),
+            "elapsed_s": round(elapsed, 3),
+            "curve": curve,
+        }
+        print(f"[{cfg_name}] {np.dtype(dtype).name}: "
+              f"{float(res.initial_cost):.6e} -> {float(res.cost):.6e} "
+              f"in {int(res.iterations)} iters ({elapsed:.1f}s)",
+              flush=True)
+
+    r64 = out["runs"]["float64"]
+    r32 = out["runs"]["float32"]
+    rel = abs(r32["final_cost"] - r64["final_cost"]) / max(
+        r64["final_cost"], 1e-300)
+    # Per-iteration relative gaps over the common accepted prefix: the
+    # trajectories should track each other, not merely coincide at the
+    # optimum.
+    gaps = []
+    for a, b in zip(r64["curve"], r32["curve"]):
+        gaps.append(abs(b["cost"] - a["cost"]) / max(abs(a["cost"]), 1e-300))
+    out["final_rel_diff"] = rel
+    out["curve_rel_gaps"] = gaps
+    out["rel_tol"] = REL_TOL
+    out["pass"] = bool(rel <= REL_TOL)
+    print(f"[{cfg_name}] final rel diff {rel:.3e} "
+          f"({'PASS' if out['pass'] else 'FAIL'} at {REL_TOL})", flush=True)
+    return out
+
+
+def main():
+    from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache, respect_jax_platforms)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    respect_jax_platforms()
+    enable_persistent_compile_cache()
+
+    configs = os.environ.get(
+        "MEGBA_PARITY_CONFIGS", "trafalgar,venice").split(",")
+    scale = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
+    results = [run_one(name.strip(), scale) for name in configs if name]
+    payload = {"rel_tol": REL_TOL,
+               "all_pass": all(r["pass"] for r in results),
+               "results": results}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "DOUBLE_PARITY.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(f"wrote {path}; all_pass={payload['all_pass']}", flush=True)
+    return 0 if payload["all_pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

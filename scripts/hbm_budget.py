@@ -1,0 +1,178 @@
+"""HBM budget for the Final-13682 configuration on one v5e chip.
+
+VERDICT r04 item 7 asks: does final-13682 (29.0M observations) fit on a
+single v5e (16 GB HBM), at what dtype/chunking?  Two answers here:
+
+1. **XLA's own number**: lower + compile the production LM program at a
+   chosen scale on the current backend and read
+   `compiled.memory_analysis()` (argument/output/temp/generated-code
+   sizes).  Run at full scale when RAM allows; smaller scales give the
+   per-edge slope for extrapolation (edge-proportional buffers dominate
+   past venice scale).
+2. **Analytic live-set model** from the implicit path's own shapes
+   (linear_system/builder.py, solver/pcg.py): per-edge residuals r
+   [od=2], Jacobians Jc [od*cd=18] and Jp [od*pd=6], obs [2], indices
+   [2 int32], mask [1] — feature-major rows over nE — plus
+   parameter-sized blocks (Hpp, Hll rows, PCG vectors) that stay
+   sub-GB at any BAL scale.
+
+Writes HBM_BUDGET.json and prints a table.  Usage:
+  [MEGBA_BENCH_CONFIG=final] [MEGBA_BENCH_SCALE=0.1] \
+      [MEGBA_MP=0|1] python scripts/hbm_budget.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_HBM = 16 * 1024**3  # bytes
+
+
+def analytic_rows(n_cam, n_pt, n_edge, dtype_bytes, mixed):
+    """Live-set bytes by buffer family for one implicit-path LM solve."""
+    B = dtype_bytes
+    cB = 2 if mixed else B  # bf16 coupling operands under mixed precision
+    rows = {
+        # Persistent per-edge operands (held across the whole solve):
+        "obs [od=2, nE]": 2 * B * n_edge,
+        "cam_idx+pt_idx [int32, nE]": 8 * n_edge,
+        "mask [nE]": B * n_edge,
+        # Linearization products (rebuilt each LM iteration, live
+        # through every PCG iteration of that step):
+        "r [2, nE]": 2 * B * n_edge,
+        "Jc [18, nE]": 18 * cB * n_edge,
+        "Jp [6, nE]": 6 * cB * n_edge,
+        # Trial step keeps a second copy of r while rho is evaluated:
+        "r_trial [2, nE]": 2 * B * n_edge,
+        # Parameter-sized state (params + g + diag blocks + ~6 PCG
+        # vectors on the reduced camera system + point-side rows):
+        "params cam+pt (x2: current+trial)": 2 * (9 * n_cam + 3 * n_pt) * B,
+        "Hpp [Nc,9,9] + Minv": 2 * 81 * n_cam * B,
+        "Hll rows [9, Np] + inverse": 2 * 9 * n_pt * B,
+        "g + PCG vectors (~8 param-sized)": 8 * (9 * n_cam + 3 * n_pt) * B,
+    }
+    return rows
+
+
+def main():
+    from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache, ensure_usable_backend,
+        install_graceful_term)
+
+    install_graceful_term()
+    enable_persistent_compile_cache()
+    fell_back = ensure_usable_backend()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench as B
+    from megba_tpu.common import (
+        AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption)
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import (
+        _build_single_solve, EDGE_QUANTUM)
+    from megba_tpu.core.types import pad_edges
+    from megba_tpu.algo.lm import _next_verbose_token
+
+    cfg_name = os.environ.get("MEGBA_BENCH_CONFIG", "final")
+    scale = float(os.environ.get("MEGBA_BENCH_SCALE", "0.1"))
+    mixed = os.environ.get("MEGBA_MP", "0") == "1"
+    c = B.CONFIGS[cfg_name]
+    n_cam = max(8, int(c.cameras * scale))
+    n_pt = max(64, int(c.points * scale))
+    s = make_synthetic_bal(
+        num_cameras=n_cam, num_points=n_pt, obs_per_point=c.obs_per_point,
+        seed=0, param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+    n_edge = int(s.obs.shape[0])
+
+    option = ProblemOption(
+        dtype=np.float32, compute_kind=ComputeKind.IMPLICIT,
+        jacobian_mode=JacobianMode.ANALYTICAL, mixed_precision_pcg=mixed,
+        algo_option=AlgoOption(max_iter=8),
+        solver_option=SolverOption(max_iter=30, tol=1e-10,
+                                   refuse_ratio=1e30))
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+    from megba_tpu.native import sort_edges_by_camera
+
+    perm = sort_edges_by_camera(s.cam_idx, n_cam)
+    obs, ci, pi = s.obs[perm], s.cam_idx[perm], s.pt_idx[perm]
+    obs, ci, pi, mask = pad_edges(obs, ci, pi, EDGE_QUANTUM,
+                                  dtype=np.float32)
+    n_padded = obs.shape[0]
+
+    jitted = _build_single_solve(f, option, (), False, True)
+    dtype = np.float32
+    args = (
+        jnp.asarray(np.ascontiguousarray(s.cameras0.T)),
+        jnp.asarray(np.ascontiguousarray(s.points0.T)),
+        jnp.asarray(np.ascontiguousarray(obs.T)),
+        jnp.asarray(ci), jnp.asarray(pi), jnp.asarray(mask),
+        jnp.asarray(1e3, dtype), jnp.asarray(2.0, dtype),
+        jnp.asarray(_next_verbose_token(), jnp.int32), None)
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    xla = {}
+    if ma is not None:
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                xla[k] = int(v)
+        xla["peak_estimate_bytes"] = (
+            xla.get("argument_size_in_bytes", 0)
+            + xla.get("output_size_in_bytes", 0)
+            + xla.get("temp_size_in_bytes", 0)
+            - xla.get("alias_size_in_bytes", 0))
+
+    rows = analytic_rows(n_cam, n_pt, n_padded, 4, mixed)
+    total = sum(rows.values())
+    # Full-scale extrapolation: per-edge bytes hold; parameter-sized
+    # rows scale with the full counts.
+    full_edges = 28_987_644
+    fc, fp = c.cameras, c.points
+    full_rows = analytic_rows(fc, fp, full_edges, 4, mixed)
+    full_total = sum(full_rows.values())
+
+    backend = jax.devices()[0].platform
+    print(f"config {cfg_name} scale {scale} ({n_cam} cams, {n_pt} pts, "
+          f"{n_padded} padded edges), mixed={mixed}, backend={backend}"
+          + (" [CPU fallback]" if fell_back else ""))
+    print(f"{'buffer family':44s} {'bytes':>14s} {'@full scale':>14s}")
+    for k in rows:
+        print(f"{k:44s} {rows[k]:>14,} {full_rows[k]:>14,}")
+    print(f"{'TOTAL analytic live set':44s} {total:>14,} {full_total:>14,}")
+    print(f"full-scale analytic vs v5e 16 GB: "
+          f"{full_total / V5E_HBM:.1%} of HBM")
+    if xla:
+        print("XLA memory_analysis at this scale:", json.dumps(xla))
+
+    payload = {
+        "config": cfg_name, "scale": scale, "mixed": mixed,
+        "backend": backend, "cpu_fallback": bool(fell_back),
+        "cameras": n_cam, "points": n_pt, "edges_padded": n_padded,
+        "analytic_rows_bytes": rows, "analytic_total_bytes": total,
+        "full_scale": {"cameras": fc, "points": fp, "edges": full_edges,
+                       "analytic_rows_bytes": full_rows,
+                       "analytic_total_bytes": full_total,
+                       "fraction_of_v5e_hbm": full_total / V5E_HBM},
+        "xla_memory_analysis": xla or None,
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "HBM_BUDGET.json")
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Mixed-precision PCG validation at scale (BASELINE.md config 5).
+
+The final_mixed bench config runs f32 residuals with bf16-equilibrated
+PCG coupling operands (solver/pcg.py).  SCALING.md knob 2 claims the
+trade is "~20% more PCG iterations for ~half the coupling bandwidth";
+VERDICT r04 item 5 asks for that claim to be measured at venice scale
+on the CPU backend so config 5 becomes a pure bench run when hardware
+answers.
+
+Protocol: identical venice-shaped synthetic problem, identical LM
+configuration, bounded iterations; one solve with mixed_precision_pcg
+off, one with it on.  Records per-iteration cost curves + PCG iteration
+counts, quantifies the PCG-iteration penalty and the convergence gap,
+writes MIXED_PRECISION.json.  Nonzero exit when convergence parity
+fails (final costs differ beyond REL_TOL) so a small-scale version can
+run in CI.
+
+Usage:
+  [MEGBA_BENCH_SCALE=1.0] [MEGBA_MP_CONFIG=venice] \
+      python scripts/mixed_precision_validation.py
+"""
+from __future__ import annotations
+
+import contextlib
+import io as _io
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# bf16 coupling perturbs the Krylov directions, so the accepted-step
+# sequence can differ late in the solve; the optimum itself must agree
+# to f32-floor-ish precision.  1e-3 relative on the final cost is the
+# parity bar (a busted mixed path misses by orders of magnitude).
+REL_TOL = 1e-3
+
+_LINE = re.compile(
+    r"iter (\d+): cost ([0-9.eE+-]+) .*accept (True|False) "
+    r"pcg_iters (\d+)")
+
+
+def main():
+    from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache, respect_jax_platforms)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    respect_jax_platforms()
+    enable_persistent_compile_cache()
+
+    from megba_tpu.common import (
+        AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption)
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+    import bench as B
+
+    cfg_name = os.environ.get("MEGBA_MP_CONFIG", "venice")
+    scale = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
+    c = B.CONFIGS[cfg_name]
+    n_cam = max(8, int(c.cameras * scale))
+    n_pt = max(64, int(c.points * scale))
+    s = make_synthetic_bal(
+        num_cameras=n_cam, num_points=n_pt, obs_per_point=c.obs_per_point,
+        seed=0, param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    out = {"config": cfg_name, "scale": scale, "cameras": n_cam,
+           "points": n_pt, "edges": int(s.obs.shape[0]), "runs": {}}
+    for mixed in (False, True):
+        option = ProblemOption(
+            dtype=np.float32,
+            compute_kind=ComputeKind.IMPLICIT,
+            jacobian_mode=JacobianMode.ANALYTICAL,
+            mixed_precision_pcg=mixed,
+            algo_option=AlgoOption(max_iter=15, epsilon1=1e-12,
+                                   epsilon2=1e-15),
+            solver_option=SolverOption(max_iter=60, tol=1e-9,
+                                       refuse_ratio=1e30),
+        )
+        buf = _io.StringIO()
+        t0 = time.perf_counter()
+        with contextlib.redirect_stdout(buf):
+            res = flat_solve(
+                f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                option, verbose=True)
+            jax.block_until_ready(res.cost)
+        elapsed = time.perf_counter() - t0
+        curve = [
+            {"iter": int(m.group(1)), "cost": float(m.group(2)),
+             "accept": m.group(3) == "True", "pcg_iters": int(m.group(4))}
+            for m in _LINE.finditer(buf.getvalue())]
+        key = "bf16_coupling" if mixed else "f32"
+        out["runs"][key] = {
+            "initial_cost": float(res.initial_cost),
+            "final_cost": float(res.cost),
+            "iterations": int(res.iterations),
+            "accepted": int(res.accepted),
+            "pcg_iterations": int(res.pcg_iterations),
+            "pcg_iters_per_lm": round(
+                int(res.pcg_iterations) / max(int(res.iterations), 1), 2),
+            "elapsed_s": round(elapsed, 3),
+            "curve": curve,
+        }
+        print(f"[{cfg_name}] {key}: {float(res.initial_cost):.6e} -> "
+              f"{float(res.cost):.6e}, {int(res.pcg_iterations)} PCG iters "
+              f"over {int(res.iterations)} LM iters ({elapsed:.1f}s)",
+              flush=True)
+
+    rf, rm = out["runs"]["f32"], out["runs"]["bf16_coupling"]
+    rel = abs(rm["final_cost"] - rf["final_cost"]) / max(
+        rf["final_cost"], 1e-300)
+    # PCG-iteration penalty per LM iteration: the bandwidth trade's cost.
+    penalty = (rm["pcg_iters_per_lm"] / max(rf["pcg_iters_per_lm"], 1e-9)
+               ) - 1.0
+    out["final_rel_diff"] = rel
+    out["pcg_iter_penalty"] = round(penalty, 4)
+    out["rel_tol"] = REL_TOL
+    out["pass"] = bool(rel <= REL_TOL)
+    print(f"[{cfg_name}] final rel diff {rel:.3e} "
+          f"({'PASS' if out['pass'] else 'FAIL'} at {REL_TOL}); "
+          f"PCG iteration penalty {penalty:+.1%}", flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MIXED_PRECISION.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {path}", flush=True)
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
