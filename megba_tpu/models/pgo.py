@@ -270,23 +270,10 @@ def solve_pgo(
             jnp.asarray(region0, dtype), jnp.asarray(v0, dtype),
             jnp.asarray(_next_verbose_token(), jnp.int32), *extras]
     if mesh is not None:
-        from megba_tpu.parallel.multihost import (
-            globalize_for_mesh, mesh_is_multiprocess)
+        from megba_tpu.parallel.multihost import dispatch_on_mesh
 
-        if mesh_is_multiprocess(mesh):
-            # Multi-host: lift every operand into a global array (each
-            # process contributes its devices' shards) — same contract
-            # as distributed_lm_solve.
-            specs = _pgo_in_specs(tuple(extra_keys))
-            args = [globalize_for_mesh(mesh, a, s)
-                    for a, s in zip(args, specs)]
-            local0 = next(d for d in mesh.devices.flat
-                          if d.process_index == jax.process_index())
-            with jax.default_device(local0):
-                out = prog(*args)
-        else:
-            with jax.default_device(mesh.devices.flat[0]):
-                out = prog(*args)
+        out = dispatch_on_mesh(prog, mesh, args,
+                               _pgo_in_specs(tuple(extra_keys)))
     else:
         out = prog(*args)
 

@@ -115,6 +115,14 @@ def distributed_lm_solve(
     its shard.  The entire LM loop, PCG included, is ONE jitted SPMD
     program; per-iteration synchronisation is the psum set documented in
     builder.py/pcg.py.
+
+    DONATION CONTRACT: `cameras` and `points` are donated — the result's
+    parameter arrays alias their buffers, and device arrays passed here
+    are DELETED by the call.  Pass host numpy (uploaded once, nothing
+    retained) or hand over arrays you will not reuse; flat_solve does
+    the former.  Under a multi-process mesh every operand is lifted into
+    a global array first (parallel/multihost.globalize_for_mesh), so
+    host values are required there anyway.
     """
     n_edge = obs.shape[-1]
     if n_edge % mesh.devices.size != 0:
@@ -157,23 +165,9 @@ def distributed_lm_solve(
         residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
         cam_sorted)
 
-    from megba_tpu.parallel.multihost import (
-        globalize_for_mesh, mesh_is_multiprocess)
+    from megba_tpu.parallel.multihost import dispatch_on_mesh
 
-    if mesh_is_multiprocess(mesh):
-        # Multi-host: the jitted program only accepts global arrays —
-        # each process contributes the shards its devices own.  Host
-        # prep ran identically on every host (flat_solve's multi-host
-        # contract), so each arg is lifted from the full local value.
-        args = [globalize_for_mesh(mesh, a, s)
-                for a, s in zip(args, in_specs)]
-        local0 = next(d for d in mesh.devices.flat
-                      if d.process_index == jax.process_index())
-        with jax.default_device(local0):
-            return jitted(*args)
-
-    with jax.default_device(mesh.devices.flat[0]):
-        return jitted(*args)
+    return dispatch_on_mesh(jitted, mesh, args, in_specs)
 
 
 def get_or_build_program(jit_cache, cached_fn, build_fn, engine, *cfg):

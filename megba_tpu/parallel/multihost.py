@@ -106,3 +106,22 @@ def globalize_for_mesh(mesh, x, spec):
             arr.shape, sharding, lambda idx: arr[idx])
 
     return jax.tree_util.tree_map(lift, x)
+
+
+def dispatch_on_mesh(prog, mesh, args, specs):
+    """Run a jitted mesh program with the right operand form.
+
+    Single source of the multi-process dispatch sequence for BOTH solver
+    families (parallel/mesh.distributed_lm_solve and models/pgo):
+    under a multi-process mesh every operand is lifted into a global
+    array per its partition spec, and the default device is pinned to a
+    device THIS process owns (the mesh's first device may be remote).
+    """
+    if mesh_is_multiprocess(mesh):
+        args = [globalize_for_mesh(mesh, a, s) for a, s in zip(args, specs)]
+        dev0 = next(d for d in mesh.devices.flat
+                    if d.process_index == jax.process_index())
+    else:
+        dev0 = mesh.devices.flat[0]
+    with jax.default_device(dev0):
+        return prog(*args)

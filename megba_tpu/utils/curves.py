@@ -1,0 +1,54 @@
+"""Capture and parse the LM solver's verbose per-iteration lines.
+
+The per-iteration `iter k: cost ...` line (algo/lm.py:_emit_verbose_line
+— the reference's observable, lm_algo.cu:149-162) is the source of the
+cost-curve evidence artifacts (DOUBLE_PARITY.json, MIXED_PRECISION.json).
+One shared parser keeps those scripts in lockstep with the emit format:
+a format drift raises here instead of silently producing empty curves
+in the committed artifacts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import re
+from typing import Callable, Optional
+
+_LINE = re.compile(
+    r"iter (\d+): cost ([0-9.eE+-]+) .*accept (True|False) "
+    r"pcg_iters (\d+)")
+
+
+def parse_verbose_curve(text: str, require: bool = True) -> list[dict]:
+    """Verbose solver stdout -> [{iter, cost, accept, pcg_iters}, ...]."""
+    curve = [
+        {"iter": int(m.group(1)), "cost": float(m.group(2)),
+         "accept": m.group(3) == "True", "pcg_iters": int(m.group(4))}
+        for m in _LINE.finditer(text)]
+    if require and not curve:
+        raise ValueError(
+            "no verbose iteration lines matched — did the solver's "
+            "verbose format (algo/lm.py:_emit_verbose_line) change "
+            "without updating utils/curves._LINE?")
+    return curve
+
+
+def run_with_curve(fn: Callable[[], object],
+                   block_on: Optional[Callable[[object], object]] = None):
+    """Run `fn` capturing stdout; return (result, curve).
+
+    `block_on(result)` (default: jax.block_until_ready on the result)
+    runs INSIDE the capture so asynchronously-emitted verbose callbacks
+    have flushed before parsing.
+    """
+    import jax
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        result = fn()
+        if block_on is None:
+            jax.block_until_ready(result)
+        else:
+            block_on(result)
+    return result, parse_verbose_curve(buf.getvalue())

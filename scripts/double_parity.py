@@ -22,11 +22,8 @@ Usage:
 """
 from __future__ import annotations
 
-import contextlib
-import io as _io
 import json
 import os
-import re
 import sys
 import time
 
@@ -39,10 +36,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # is conservative for these conditionings and catches any real
 # divergence (a wrong trajectory lands orders of magnitude away).
 REL_TOL = 1e-4
-
-_LINE = re.compile(
-    r"iter (\d+): cost ([0-9.eE+-]+) .*accept (True|False) "
-    r"pcg_iters (\d+)")
 
 
 def run_one(cfg_name: str, scale: float):
@@ -79,22 +72,17 @@ def run_one(cfg_name: str, scale: float):
             solver_option=SolverOption(max_iter=50, tol=1e-12,
                                        refuse_ratio=1e30),
         )
-        buf = _io.StringIO()
+        from megba_tpu.utils.curves import run_with_curve
+
         t0 = time.perf_counter()
-        with contextlib.redirect_stdout(buf):
-            res = flat_solve(
+        res, curve = run_with_curve(
+            lambda: flat_solve(
                 f,
                 s.cameras0.astype(dtype), s.points0.astype(dtype),
                 s.obs.astype(dtype),
-                s.cam_idx, s.pt_idx, option, verbose=True)
-            jax.block_until_ready(res.cost)
+                s.cam_idx, s.pt_idx, option, verbose=True),
+            block_on=lambda r: jax.block_until_ready(r.cost))
         elapsed = time.perf_counter() - t0
-        curve = []
-        for m in _LINE.finditer(buf.getvalue()):
-            curve.append({"iter": int(m.group(1)),
-                          "cost": float(m.group(2)),
-                          "accept": m.group(3) == "True",
-                          "pcg_iters": int(m.group(4))})
         out["runs"][np.dtype(dtype).name] = {
             "initial_cost": float(res.initial_cost),
             "final_cost": float(res.cost),
@@ -146,8 +134,9 @@ def main():
     payload = {"rel_tol": REL_TOL,
                "all_pass": all(r["pass"] for r in results),
                "results": results}
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "DOUBLE_PARITY.json")
+    path = os.environ.get("MEGBA_PARITY_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "DOUBLE_PARITY.json")
     with open(path, "w") as f:
         json.dump(payload, f, indent=1)
     print(f"wrote {path}; all_pass={payload['all_pass']}", flush=True)

@@ -21,11 +21,8 @@ Usage:
 """
 from __future__ import annotations
 
-import contextlib
-import io as _io
 import json
 import os
-import re
 import sys
 import time
 
@@ -38,10 +35,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # to f32-floor-ish precision.  1e-3 relative on the final cost is the
 # parity bar (a busted mixed path misses by orders of magnitude).
 REL_TOL = 1e-3
-
-_LINE = re.compile(
-    r"iter (\d+): cost ([0-9.eE+-]+) .*accept (True|False) "
-    r"pcg_iters (\d+)")
 
 
 def main():
@@ -84,18 +77,15 @@ def main():
             solver_option=SolverOption(max_iter=60, tol=1e-9,
                                        refuse_ratio=1e30),
         )
-        buf = _io.StringIO()
+        from megba_tpu.utils.curves import run_with_curve
+
         t0 = time.perf_counter()
-        with contextlib.redirect_stdout(buf):
-            res = flat_solve(
+        res, curve = run_with_curve(
+            lambda: flat_solve(
                 f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
-                option, verbose=True)
-            jax.block_until_ready(res.cost)
+                option, verbose=True),
+            block_on=lambda r: jax.block_until_ready(r.cost))
         elapsed = time.perf_counter() - t0
-        curve = [
-            {"iter": int(m.group(1)), "cost": float(m.group(2)),
-             "accept": m.group(3) == "True", "pcg_iters": int(m.group(4))}
-            for m in _LINE.finditer(buf.getvalue())]
         key = "bf16_coupling" if mixed else "f32"
         out["runs"][key] = {
             "initial_cost": float(res.initial_cost),
@@ -127,8 +117,9 @@ def main():
           f"({'PASS' if out['pass'] else 'FAIL'} at {REL_TOL}); "
           f"PCG iteration penalty {penalty:+.1%}", flush=True)
 
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "MIXED_PRECISION.json")
+    path = os.environ.get("MEGBA_MP_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MIXED_PRECISION.json")
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print(f"wrote {path}", flush=True)

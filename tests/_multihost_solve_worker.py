@@ -6,7 +6,7 @@ Run as `python tests/_multihost_solve_worker.py <process_id> <port>
 problem, and run ONE sharded LM solve through the real pipeline
 (solve.flat_solve -> distributed_lm_solve -> shard_map over the global
 mesh), with edge arrays entering via
-jax.make_array_from_process_local_data (parallel/multihost.
+jax.make_array_from_callback (parallel/multihost.
 globalize_for_mesh).  Prints the final cost for the orchestrating test
 to compare against a single-process world-2N solve — the end-to-end
 parity VERDICT r04 item 6 asks for, and the capability the reference's

@@ -8,6 +8,8 @@ tests/_multihost_worker.py; this test only orchestrates them so the
 pytest process itself never initialises a second distributed runtime.
 """
 
+import pytest
+
 import os
 import socket
 import subprocess
@@ -49,10 +51,11 @@ def test_two_process_localhost_cluster():
         assert f"worker {pid} OK" in out, out
 
 
+@pytest.mark.slow
 def test_two_process_sharded_solve_matches_single_process():
     """Two processes x 2 virtual CPU devices run ONE sharded LM solve
     through the real pipeline (flat_solve -> shard_map over the global
-    4-device mesh, inputs via make_array_from_process_local_data) and
+    4-device mesh, inputs lifted via make_array_from_callback) and
     must match the single-process world-4 solve bit-for-bit-ish (f64).
 
     This is the end-to-end upgrade of the psum smoke above: it
