@@ -34,21 +34,44 @@ def parse_verbose_curve(text: str, require: bool = True) -> list[dict]:
     return curve
 
 
+class _Tee(io.TextIOBase):
+    """Buffer that also passes writes through to a live stream."""
+
+    def __init__(self, passthrough):
+        self.buf = io.StringIO()
+        self._live = passthrough
+
+    def write(self, s):
+        self.buf.write(s)
+        self._live.write(s)
+        return len(s)
+
+    def flush(self):
+        self._live.flush()
+
+
 def run_with_curve(fn: Callable[[], object],
-                   block_on: Optional[Callable[[object], object]] = None):
+                   block_on: Optional[Callable[[object], object]] = None,
+                   tee: bool = False):
     """Run `fn` capturing stdout; return (result, curve).
 
     `block_on(result)` (default: jax.block_until_ready on the result)
     runs INSIDE the capture so asynchronously-emitted verbose callbacks
-    have flushed before parsing.
+    have flushed before parsing.  `tee=True` additionally passes every
+    line through to the real stdout as it is emitted — use it for
+    long runs so a crash mid-solve still leaves the per-iteration
+    forensics in the log instead of dying inside the buffer.
     """
+    import sys
+
     import jax
 
-    buf = io.StringIO()
+    buf = _Tee(sys.stdout) if tee else io.StringIO()
     with contextlib.redirect_stdout(buf):
         result = fn()
         if block_on is None:
             jax.block_until_ready(result)
         else:
             block_on(result)
-    return result, parse_verbose_curve(buf.getvalue())
+    text = buf.buf.getvalue() if tee else buf.getvalue()
+    return result, parse_verbose_curve(text)

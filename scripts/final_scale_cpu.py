@@ -55,19 +55,32 @@ def main():
     print(f"synth: {nc} cams / {npts} pts / {nE} edges in {t_synth:.1f}s "
           f"(rss {rss_gb():.1f} GB)", flush=True)
 
+    # Env knobs so the same runner covers the 2-iteration capability
+    # proof AND a convergence run (MEGBA_FINAL_ITERS=10 ... -> plateau
+    # at the synthetic noise floor; VERDICT r04 weak-spot 6).
+    max_iter = int(os.environ.get("MEGBA_FINAL_ITERS", "2"))
+    pcg_iter = int(os.environ.get("MEGBA_FINAL_PCG", "8"))
+    out_path = os.environ.get("MEGBA_FINAL_OUT", "FINAL_CPU.json")
     option = ProblemOption(
         dtype=np.float32,
         compute_kind=ComputeKind.IMPLICIT,
         jacobian_mode=JacobianMode.ANALYTICAL,
-        algo_option=AlgoOption(max_iter=2, epsilon1=1e-12, epsilon2=1e-15),
-        solver_option=SolverOption(max_iter=8, tol=1e-10, refuse_ratio=1e30),
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-12,
+                               epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=pcg_iter, tol=1e-10,
+                                   refuse_ratio=1e30),
     )
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
 
+    from megba_tpu.utils.curves import run_with_curve
+
     t0 = time.perf_counter()
-    res = flat_solve(
-        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option)
-    jax.block_until_ready(res.cost)
+    res, curve = run_with_curve(
+        lambda: flat_solve(
+            f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+            verbose=True),
+        block_on=lambda r: jax.block_until_ready(r.cost),
+        tee=True)  # 200s+/iter at this scale: keep live crash forensics
     t_solve = time.perf_counter() - t0
     iters = int(res.iterations)
     out = dict(
@@ -83,6 +96,14 @@ def main():
         cost=float(res.cost),
         accepted=int(res.accepted),
         peak_rss_gb=round(rss_gb(), 2),
+        # Statistical floor of the synthetic: E[min Sum e^2] for least
+        # squares with Gaussian pixel noise sigma is
+        # (n_residuals - n_fitted_params) * sigma^2 — the fitted DOF
+        # absorb their share of the noise.  sigma=0.5 and od=2 match
+        # the make_synthetic_bal call above.
+        noise_floor_cost=round(
+            (nE * 2 - (9 * nc + 3 * npts)) * 0.5**2, 1),
+        curve=curve,
         note=("end-to-end Final-13682 scale on the CPU backend "
               "(includes compile in solve_s; 1 host core). Capability "
               "evidence only — chip perf comes from bench config "
@@ -90,9 +111,9 @@ def main():
     )
     print(json.dumps(out), flush=True)
     assert np.isfinite(out["cost"]) and out["cost"] < out["initial_cost"]
-    with open("FINAL_CPU.json", "w") as fh:
+    with open(out_path, "w") as fh:
         json.dump(out, fh, indent=1)
-    print("wrote FINAL_CPU.json", flush=True)
+    print(f"wrote {out_path}", flush=True)
 
 
 if __name__ == "__main__":

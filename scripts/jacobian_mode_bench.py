@@ -1,0 +1,150 @@
+"""Analytical vs autodiff Jacobian: the measured differential.
+
+The reference advertises its analytical-derivatives mode as ~30% faster
+and ~40% lighter than its autodiff mode (reference README.md:16).  Both
+modes exist here and agree numerically (tests/test_residuals.py); this
+script MEASURES the differential on the current backend — per-LM-
+iteration wall time under a fixed iteration budget plus XLA's
+memory_analysis of both programs — and writes JACOBIAN_MODES.json.
+
+On CPU this is clearly-labelled stand-in evidence (the fusion/layout
+trade on the MXU differs); the same script runs unchanged on the chip
+when the tunnel answers.
+
+Usage:
+  [MEGBA_BENCH_CONFIG=venice] [MEGBA_BENCH_SCALE=0.2] \
+      python scripts/jacobian_mode_bench.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache, ensure_usable_backend,
+        install_graceful_term)
+
+    install_graceful_term()
+    enable_persistent_compile_cache()
+    fell_back = ensure_usable_backend()
+
+    import jax
+
+    import bench as B
+    import jax.numpy as jnp
+
+    from megba_tpu.algo.lm import _next_verbose_token
+    from megba_tpu.common import (
+        AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption)
+    from megba_tpu.core.types import pad_edges
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.native import sort_edges_by_camera
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import EDGE_QUANTUM, _build_single_solve, flat_solve
+
+    cfg_name = os.environ.get("MEGBA_BENCH_CONFIG", "venice")
+    scale = float(os.environ.get("MEGBA_BENCH_SCALE", "0.2"))
+    c = B.CONFIGS[cfg_name]
+    n_cam = max(8, int(c.cameras * scale))
+    n_pt = max(64, int(c.points * scale))
+    s = make_synthetic_bal(
+        num_cameras=n_cam, num_points=n_pt, obs_per_point=c.obs_per_point,
+        seed=0, param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+
+    LM_ITERS, PCG_ITERS = 6, 30
+    out = {"config": cfg_name, "scale": scale, "cameras": n_cam,
+           "points": n_pt, "edges": int(s.obs.shape[0]),
+           "backend": jax.devices()[0].platform,
+           "cpu_fallback": bool(fell_back),
+           "lm_iters": LM_ITERS, "pcg_iters": PCG_ITERS, "runs": {}}
+    for mode in (JacobianMode.ANALYTICAL, JacobianMode.AUTODIFF):
+        option = ProblemOption(
+            dtype=np.float32,
+            compute_kind=ComputeKind.IMPLICIT,
+            jacobian_mode=mode,
+            # Timing protocol (same as bench.py): huge refuse_ratio +
+            # loose stops force exactly LM_ITERS full iterations of
+            # linearize+build+PCG, so both modes do identical work.
+            algo_option=AlgoOption(max_iter=LM_ITERS, epsilon1=1e-14,
+                                   epsilon2=1e-16),
+            solver_option=SolverOption(max_iter=PCG_ITERS, tol=1e-12,
+                                       refuse_ratio=1e30),
+        )
+        f = make_residual_jacobian_fn(mode=mode)
+
+        def run():
+            r = flat_solve(
+                f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                option)
+            jax.block_until_ready(r.cost)
+            return r
+
+        res = run()  # compile + warm
+        t0 = time.perf_counter()
+        res = run()
+        elapsed = time.perf_counter() - t0
+
+        # XLA's memory analysis of this mode's program (the reference
+        # claims analytical is ~40% lighter; in implicit mode both
+        # store the same Jc/Jp, so the honest expectation is ~0).
+        perm = sort_edges_by_camera(s.cam_idx, n_cam)
+        obs_s, ci, pi, mask = pad_edges(
+            s.obs[perm], s.cam_idx[perm], s.pt_idx[perm], EDGE_QUANTUM,
+            dtype=np.float32)
+        jitted = _build_single_solve(f, option, (), False, True)
+        ma = jitted.lower(
+            jnp.asarray(np.ascontiguousarray(s.cameras0.T)),
+            jnp.asarray(np.ascontiguousarray(s.points0.T)),
+            jnp.asarray(np.ascontiguousarray(obs_s.T)),
+            jnp.asarray(ci), jnp.asarray(pi), jnp.asarray(mask),
+            jnp.asarray(1e3, np.float32), jnp.asarray(2.0, np.float32),
+            jnp.asarray(_next_verbose_token(), jnp.int32), None,
+        ).compile().memory_analysis()
+        mem = None
+        if ma is not None:
+            mem = {
+                "temp_size_bytes": int(ma.temp_size_in_bytes),
+                "argument_size_bytes": int(ma.argument_size_in_bytes),
+            }
+        out["runs"][mode.name.lower()] = {
+            "lm_iter_ms": round(elapsed / LM_ITERS * 1e3, 2),
+            "final_cost": float(res.cost),
+            "iterations": int(res.iterations),
+            "memory_analysis": mem,
+        }
+        print(f"[{cfg_name} x{scale}] {mode.name}: "
+              f"{elapsed / LM_ITERS * 1e3:.1f} ms/LM-iter "
+              f"(cost {float(res.cost):.6e})", flush=True)
+
+    a = out["runs"]["analytical"]["lm_iter_ms"]
+    d = out["runs"]["autodiff"]["lm_iter_ms"]
+    out["analytical_time_vs_autodiff"] = round(a / d - 1.0, 4)
+    print(f"analytical vs autodiff time: {a / d - 1.0:+.1%} "
+          f"(reference claims ~-30% on CUDA)", flush=True)
+    ma_a = out["runs"]["analytical"]["memory_analysis"]
+    ma_d = out["runs"]["autodiff"]["memory_analysis"]
+    if ma_a and ma_d:
+        out["analytical_temp_vs_autodiff"] = round(
+            ma_a["temp_size_bytes"] / ma_d["temp_size_bytes"] - 1.0, 4)
+        print(f"analytical vs autodiff temp memory: "
+              f"{out['analytical_temp_vs_autodiff']:+.1%} "
+              f"(reference claims ~-40%)", flush=True)
+
+    path = os.environ.get("MEGBA_JM_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "JACOBIAN_MODES.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

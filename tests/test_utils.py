@@ -87,3 +87,82 @@ def test_checkpoint_roundtrip(tmp_path):
     # Overwrite is atomic (no stray tmp files).
     save_state(p, np.zeros((2, 9)), np.ones((3, 3)))
     assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_parse_verbose_curve_matches_emit_format():
+    """The curve parser must track algo/lm.py's emit format — a drift
+    raises instead of silently returning empty curves (the committed
+    evidence artifacts depend on this)."""
+    import pytest
+
+    from megba_tpu.utils.curves import parse_verbose_curve
+
+    text = (
+        "iter 0: cost 1.234560e+05 log10 5.092 accept True pcg_iters 12 "
+        "elapsed 103.2 ms\n"
+        "iter 1: cost 9.900000e+03 log10 3.996 accept False pcg_iters 7 "
+        "elapsed 201.9 ms\n")
+    curve = parse_verbose_curve(text)
+    assert curve == [
+        {"iter": 0, "cost": 123456.0, "accept": True, "pcg_iters": 12},
+        {"iter": 1, "cost": 9900.0, "accept": False, "pcg_iters": 7},
+    ]
+    with pytest.raises(ValueError, match="verbose format"):
+        parse_verbose_curve("no lines here")
+    assert parse_verbose_curve("", require=False) == []
+
+
+def test_run_with_curve_captures_real_solver_lines():
+    """End-to-end: a real verbose solve through run_with_curve yields a
+    non-empty curve whose first entry is iteration 0."""
+    import numpy as np
+
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.solve import flat_solve
+    from megba_tpu.utils.curves import run_with_curve
+
+    s = make_synthetic_bal(num_cameras=4, num_points=40, obs_per_point=4,
+                           seed=0, dtype=np.float64)
+    option = ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=3),
+        solver_option=SolverOption(max_iter=8, tol=1e-10))
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    res, curve = run_with_curve(lambda: flat_solve(
+        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+        verbose=True))
+    assert curve and curve[0]["iter"] == 0
+    assert curve[-1]["cost"] <= curve[0]["cost"] * 1.0000001
+    assert len(curve) == int(res.iterations)
+
+
+def test_compile_cache_dir_resolution(tmp_path, monkeypatch):
+    """enable_persistent_compile_cache resolves the cache dir with the
+    documented precedence: explicit arg > MEGBA_COMPILE_CACHE_DIR >
+    JAX_COMPILATION_CACHE_DIR > repo-local .jax_cache."""
+    import jax
+
+    from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+    orig = jax.config.jax_compilation_cache_dir
+    try:
+        explicit = tmp_path / "explicit"
+        assert enable_persistent_compile_cache(str(explicit)) == str(explicit)
+        assert explicit.is_dir()
+
+        monkeypatch.setenv("MEGBA_COMPILE_CACHE_DIR",
+                           str(tmp_path / "megba_env"))
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                           str(tmp_path / "jax_env"))
+        assert enable_persistent_compile_cache().endswith("megba_env")
+
+        monkeypatch.delenv("MEGBA_COMPILE_CACHE_DIR")
+        assert enable_persistent_compile_cache().endswith("jax_env")
+
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+        assert enable_persistent_compile_cache().endswith(".jax_cache")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", orig)
