@@ -75,3 +75,56 @@ def run_with_curve(fn: Callable[[], object],
             block_on(result)
     text = buf.buf.getvalue() if tee else buf.getvalue()
     return result, parse_verbose_curve(text)
+
+
+def dtype_parity_payload(solve_for, rel_tol, label="", block_on=None):
+    """The f64-vs-f32 parity protocol, defined once for every family.
+
+    `solve_for(np_dtype)` runs one verbose solve and returns a result
+    with cost/initial_cost/iterations/accepted/pcg_iterations fields
+    (LMResult and PGOResult both qualify).  Runs f64 then f32, captures
+    both curves, and returns the payload dict with the two runs, the
+    final-cost relative difference, the PER-ITERATION relative gaps
+    (the trajectories must track each other, not merely coincide at the
+    optimum), and pass/fail at `rel_tol`.
+    """
+    import time
+
+    import numpy as np
+
+    runs = {}
+    for dtype in (np.float64, np.float32):
+        t0 = time.perf_counter()
+        res, curve = run_with_curve(lambda: solve_for(dtype),
+                                    block_on=block_on)
+        elapsed = time.perf_counter() - t0
+        runs[np.dtype(dtype).name] = {
+            "initial_cost": float(res.initial_cost),
+            "final_cost": float(res.cost),
+            "iterations": int(res.iterations),
+            "accepted": int(res.accepted),
+            "pcg_iterations": int(res.pcg_iterations),
+            "elapsed_s": round(elapsed, 3),
+            "curve": curve,
+        }
+        print(f"[{label}] {np.dtype(dtype).name}: "
+              f"{float(res.initial_cost):.6e} -> {float(res.cost):.6e} "
+              f"in {int(res.iterations)} iters ({elapsed:.1f}s)",
+              flush=True)
+    r64, r32 = runs["float64"], runs["float32"]
+    rel = abs(r32["final_cost"] - r64["final_cost"]) / max(
+        r64["final_cost"], 1e-300)
+    gaps = [
+        abs(b["cost"] - a["cost"]) / max(abs(a["cost"]), 1e-300)
+        for a, b in zip(r64["curve"], r32["curve"])]
+    payload = {
+        "runs": runs,
+        "final_rel_diff": rel,
+        "curve_rel_gaps": gaps,
+        "rel_tol": rel_tol,
+        "pass": bool(rel <= rel_tol),
+    }
+    print(f"[{label}] final rel diff {rel:.3e} "
+          f"({'PASS' if payload['pass'] else 'FAIL'} at {rel_tol})",
+          flush=True)
+    return payload

@@ -25,7 +25,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -59,10 +58,9 @@ def run_one(cfg_name: str, scale: float):
     ck = ComputeKind[c.compute]
     f = make_residual_jacobian_fn(mode=jac)
 
-    out = {"config": cfg_name, "scale": scale, "cameras": n_cam,
-           "points": n_pt, "edges": int(s.obs.shape[0]),
-           "jacobian": c.jacobian, "compute": c.compute, "runs": {}}
-    for dtype in (np.float64, np.float32):
+    from megba_tpu.utils.curves import dtype_parity_payload
+
+    def solve_for(dtype):
         option = ProblemOption(
             dtype=np.dtype(dtype),
             compute_kind=ck,
@@ -72,47 +70,18 @@ def run_one(cfg_name: str, scale: float):
             solver_option=SolverOption(max_iter=50, tol=1e-12,
                                        refuse_ratio=1e30),
         )
-        from megba_tpu.utils.curves import run_with_curve
+        return flat_solve(
+            f,
+            s.cameras0.astype(dtype), s.points0.astype(dtype),
+            s.obs.astype(dtype),
+            s.cam_idx, s.pt_idx, option, verbose=True)
 
-        t0 = time.perf_counter()
-        res, curve = run_with_curve(
-            lambda: flat_solve(
-                f,
-                s.cameras0.astype(dtype), s.points0.astype(dtype),
-                s.obs.astype(dtype),
-                s.cam_idx, s.pt_idx, option, verbose=True),
-            block_on=lambda r: jax.block_until_ready(r.cost))
-        elapsed = time.perf_counter() - t0
-        out["runs"][np.dtype(dtype).name] = {
-            "initial_cost": float(res.initial_cost),
-            "final_cost": float(res.cost),
-            "iterations": int(res.iterations),
-            "accepted": int(res.accepted),
-            "pcg_iterations": int(res.pcg_iterations),
-            "elapsed_s": round(elapsed, 3),
-            "curve": curve,
-        }
-        print(f"[{cfg_name}] {np.dtype(dtype).name}: "
-              f"{float(res.initial_cost):.6e} -> {float(res.cost):.6e} "
-              f"in {int(res.iterations)} iters ({elapsed:.1f}s)",
-              flush=True)
-
-    r64 = out["runs"]["float64"]
-    r32 = out["runs"]["float32"]
-    rel = abs(r32["final_cost"] - r64["final_cost"]) / max(
-        r64["final_cost"], 1e-300)
-    # Per-iteration relative gaps over the common accepted prefix: the
-    # trajectories should track each other, not merely coincide at the
-    # optimum.
-    gaps = []
-    for a, b in zip(r64["curve"], r32["curve"]):
-        gaps.append(abs(b["cost"] - a["cost"]) / max(abs(a["cost"]), 1e-300))
-    out["final_rel_diff"] = rel
-    out["curve_rel_gaps"] = gaps
-    out["rel_tol"] = REL_TOL
-    out["pass"] = bool(rel <= REL_TOL)
-    print(f"[{cfg_name}] final rel diff {rel:.3e} "
-          f"({'PASS' if out['pass'] else 'FAIL'} at {REL_TOL})", flush=True)
+    out = {"config": cfg_name, "scale": scale, "cameras": n_cam,
+           "points": n_pt, "edges": int(s.obs.shape[0]),
+           "jacobian": c.jacobian, "compute": c.compute}
+    out.update(dtype_parity_payload(
+        solve_for, REL_TOL, label=cfg_name,
+        block_on=lambda r: jax.block_until_ready(r.cost)))
     return out
 
 

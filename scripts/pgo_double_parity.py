@@ -1,0 +1,91 @@
+"""Double-semantics parity for the POSE-GRAPH family (f64 vs f32).
+
+DOUBLE_PARITY.json covers the flagship BA family; this is the same
+protocol for the second family: an identical city-scale pose graph
+(generated once in f64, cast for the f32 run) solved by solve_pgo in
+both dtypes with identical flags, per-iteration curves captured from
+the shared verbose emitter, final costs compared.  With measurement
+noise on, the optimum is a nonzero cost both dtypes must agree on
+(noise-free graphs drive the cost to the dtype floor, where a relative
+comparison is meaningless).
+
+Writes PGO_DOUBLE_PARITY.json; nonzero exit on parity failure.
+
+Usage:
+  [MEGBA_PGO_POSES=20000] [MEGBA_PGO_CLOSURES=4000] \
+      python scripts/pgo_double_parity.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REL_TOL = 1e-4
+
+
+def main():
+    from megba_tpu.utils.backend import (
+        enable_persistent_compile_cache, respect_jax_platforms)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    respect_jax_platforms()
+    enable_persistent_compile_cache()
+
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.models.pgo import (
+        make_synthetic_pose_graph, solve_pgo, spanning_tree_init)
+
+    n_poses = int(os.environ.get("MEGBA_PGO_POSES", "20000"))
+    closures = int(os.environ.get("MEGBA_PGO_CLOSURES", "4000"))
+    g = make_synthetic_pose_graph(
+        num_poses=n_poses, loop_closures=closures, meas_noise=0.01,
+        drift_noise=0.05, seed=11)
+    # Spanning-tree bootstrap (the standard PGO practice, and what the
+    # examples use for drifted inits): without it a 20k-pose circle's
+    # long-wavelength modes make LM+PCG converge too slowly for a
+    # within-budget dtype comparison — the question here is the dtype
+    # floor at the optimum, not large-graph preconditioning.
+    poses0 = spanning_tree_init(
+        g.poses0, g.edge_i, g.edge_j, g.meas)
+
+    from megba_tpu.utils.curves import dtype_parity_payload
+
+    def solve_for(dtype):
+        option = ProblemOption(
+            dtype=np.dtype(dtype),
+            algo_option=AlgoOption(
+                max_iter=int(os.environ.get("MEGBA_PGO_ITERS", "120")),
+                epsilon1=1e-14, epsilon2=1e-16),
+            solver_option=SolverOption(max_iter=100, tol=1e-12,
+                                       refuse_ratio=1e30),
+        )
+        return solve_pgo(
+            poses0.astype(dtype), g.edge_i, g.edge_j,
+            g.meas.astype(dtype), option, verbose=True)
+
+    out = {"poses": n_poses,
+           "edges": int(g.edge_i.shape[0]),
+           "meas_noise": 0.01}
+    out.update(dtype_parity_payload(
+        solve_for, REL_TOL, label=f"pgo {n_poses}",
+        block_on=lambda r: jax.block_until_ready(r.cost)))
+
+    path = os.environ.get("MEGBA_PGO_PARITY_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "PGO_DOUBLE_PARITY.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {path}", flush=True)
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
