@@ -240,7 +240,6 @@ def solve_pgo(
     # jitted program uploads once, and the multi-process path builds
     # global arrays straight from host memory.
     poses_fm = np.ascontiguousarray(poses0.T).astype(dtype, copy=False)
-    fixed_j = fixed_np
     ei = np.asarray(edge_i)
     ej = np.asarray(edge_j)
     meas_fm = np.ascontiguousarray(meas_np.T).astype(dtype, copy=False)
@@ -266,7 +265,7 @@ def solve_pgo(
     v0 = 2.0 if initial_v is None else initial_v
     from megba_tpu.algo.lm import _next_verbose_token
 
-    args = [poses_fm, fixed_j, ei, ej, meas_fm,
+    args = [poses_fm, fixed_np, ei, ej, meas_fm,
             jnp.asarray(region0, dtype), jnp.asarray(v0, dtype),
             jnp.asarray(_next_verbose_token(), jnp.int32), *extras]
     if mesh is not None:

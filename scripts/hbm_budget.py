@@ -69,17 +69,12 @@ def main():
     fell_back = ensure_usable_backend()
 
     import jax
-    import jax.numpy as jnp
 
     import bench as B
     from megba_tpu.common import (
         AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption)
     from megba_tpu.io.synthetic import make_synthetic_bal
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
-    from megba_tpu.solve import (
-        _build_single_solve, EDGE_QUANTUM)
-    from megba_tpu.core.types import pad_edges
-    from megba_tpu.algo.lm import _next_verbose_token
 
     cfg_name = os.environ.get("MEGBA_BENCH_CONFIG", "final")
     scale = float(os.environ.get("MEGBA_BENCH_SCALE", "0.1"))
@@ -100,39 +95,10 @@ def main():
                                    refuse_ratio=1e30))
     f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
 
-    from megba_tpu.native import sort_edges_by_camera
+    from megba_tpu.utils.meminfo import single_solve_memory_analysis
 
-    perm = sort_edges_by_camera(s.cam_idx, n_cam)
-    obs, ci, pi = s.obs[perm], s.cam_idx[perm], s.pt_idx[perm]
-    obs, ci, pi, mask = pad_edges(obs, ci, pi, EDGE_QUANTUM,
-                                  dtype=np.float32)
-    n_padded = obs.shape[0]
-
-    jitted = _build_single_solve(f, option, (), False, True)
-    dtype = np.float32
-    args = (
-        jnp.asarray(np.ascontiguousarray(s.cameras0.T)),
-        jnp.asarray(np.ascontiguousarray(s.points0.T)),
-        jnp.asarray(np.ascontiguousarray(obs.T)),
-        jnp.asarray(ci), jnp.asarray(pi), jnp.asarray(mask),
-        jnp.asarray(1e3, dtype), jnp.asarray(2.0, dtype),
-        jnp.asarray(_next_verbose_token(), jnp.int32), None)
-    lowered = jitted.lower(*args)
-    compiled = lowered.compile()
-    ma = compiled.memory_analysis()
-    xla = {}
-    if ma is not None:
-        for k in ("argument_size_in_bytes", "output_size_in_bytes",
-                  "temp_size_in_bytes", "alias_size_in_bytes",
-                  "generated_code_size_in_bytes"):
-            v = getattr(ma, k, None)
-            if v is not None:
-                xla[k] = int(v)
-        xla["peak_estimate_bytes"] = (
-            xla.get("argument_size_in_bytes", 0)
-            + xla.get("output_size_in_bytes", 0)
-            + xla.get("temp_size_in_bytes", 0)
-            - xla.get("alias_size_in_bytes", 0))
+    xla = single_solve_memory_analysis(s, option, f)
+    n_padded = xla.pop("n_edges_padded")
 
     rows = analytic_rows(n_cam, n_pt, n_padded, 4, mixed)
     total = sum(rows.values())

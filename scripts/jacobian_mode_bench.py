@@ -39,16 +39,11 @@ def main():
     import jax
 
     import bench as B
-    import jax.numpy as jnp
-
-    from megba_tpu.algo.lm import _next_verbose_token
     from megba_tpu.common import (
         AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption)
-    from megba_tpu.core.types import pad_edges
     from megba_tpu.io.synthetic import make_synthetic_bal
-    from megba_tpu.native import sort_edges_by_camera
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
-    from megba_tpu.solve import EDGE_QUANTUM, _build_single_solve, flat_solve
+    from megba_tpu.solve import flat_solve
 
     cfg_name = os.environ.get("MEGBA_BENCH_CONFIG", "venice")
     scale = float(os.environ.get("MEGBA_BENCH_SCALE", "0.2"))
@@ -93,26 +88,15 @@ def main():
         elapsed = time.perf_counter() - t0
 
         # XLA's memory analysis of this mode's program (the reference
-        # claims analytical is ~40% lighter; in implicit mode both
-        # store the same Jc/Jp, so the honest expectation is ~0).
-        perm = sort_edges_by_camera(s.cam_idx, n_cam)
-        obs_s, ci, pi, mask = pad_edges(
-            s.obs[perm], s.cam_idx[perm], s.pt_idx[perm], EDGE_QUANTUM,
-            dtype=np.float32)
-        jitted = _build_single_solve(f, option, (), False, True)
-        ma = jitted.lower(
-            jnp.asarray(np.ascontiguousarray(s.cameras0.T)),
-            jnp.asarray(np.ascontiguousarray(s.points0.T)),
-            jnp.asarray(np.ascontiguousarray(obs_s.T)),
-            jnp.asarray(ci), jnp.asarray(pi), jnp.asarray(mask),
-            jnp.asarray(1e3, np.float32), jnp.asarray(2.0, np.float32),
-            jnp.asarray(_next_verbose_token(), jnp.int32), None,
-        ).compile().memory_analysis()
+        # claims analytical is ~40% lighter).
+        from megba_tpu.utils.meminfo import single_solve_memory_analysis
+
+        ma = single_solve_memory_analysis(s, option, f)
         mem = None
-        if ma is not None:
+        if "temp_size_in_bytes" in ma:
             mem = {
-                "temp_size_bytes": int(ma.temp_size_in_bytes),
-                "argument_size_bytes": int(ma.argument_size_in_bytes),
+                "temp_size_bytes": ma["temp_size_in_bytes"],
+                "argument_size_bytes": ma["argument_size_in_bytes"],
             }
         out["runs"][mode.name.lower()] = {
             "lm_iter_ms": round(elapsed / LM_ITERS * 1e3, 2),
