@@ -481,6 +481,89 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
     return jax.jit(run, donate_argnums=(0,)), None
 
 
+def with_priors(
+    poses0: np.ndarray,
+    edge_i: np.ndarray,
+    edge_j: np.ndarray,
+    meas: np.ndarray,
+    prior_idx: np.ndarray,
+    prior_poses: np.ndarray,
+    prior_sqrt_info: Optional[np.ndarray] = None,
+    fixed: Optional[np.ndarray] = None,
+    sqrt_info: Optional[np.ndarray] = None,
+):
+    """Augment a pose graph with unary PRIOR factors.
+
+    The reference lists "prior factor" as an unimplemented TODO
+    (reference README.md:20); here it costs no new machinery at all: a
+    prior anchoring pose i to T_prior with information Omega is EXACTLY
+    a between-factor edge from a virtual FIXED pose holding T_prior to
+    pose i with identity measurement — between_residual then evaluates
+    [log(R_prior^T R_i); R_prior^T (t_i - t_prior)], the standard prior
+    residual, and the virtual pose (fixed) contributes no columns.
+
+    Returns (poses0', edge_i', edge_j', meas', fixed', sqrt_info')
+    ready for solve_pgo / solve_pgo_checkpointed.  `prior_sqrt_info`
+    [P, 6, 6] weights each prior (W^T W = Omega); when either weight
+    input is present the other side is padded with identities so the
+    combined sqrt_info stays well-formed.
+
+    Note the returned pose array gains P trailing virtual poses; the
+    solver result's `poses[:N]` are the real ones (the virtual anchors
+    are fixed, so they come back unchanged).
+    """
+    poses0 = np.asarray(poses0, np.float64)
+    prior_idx = np.asarray(prior_idx, np.int32)
+    prior_poses = np.asarray(prior_poses, np.float64)
+    n, p = poses0.shape[0], prior_idx.shape[0]
+    if prior_poses.shape != (p, POSE_DIM):
+        raise ValueError(
+            f"prior_poses must be [{p}, {POSE_DIM}], got {prior_poses.shape}")
+    if p and (prior_idx.min() < 0 or prior_idx.max() >= n):
+        raise ValueError("prior_idx out of range")
+
+    poses_aug = np.concatenate([poses0, prior_poses])
+    ei_aug = np.concatenate(
+        [np.asarray(edge_i, np.int32),
+         np.arange(n, n + p, dtype=np.int32)])
+    ej_aug = np.concatenate([np.asarray(edge_j, np.int32), prior_idx])
+    meas_aug = np.concatenate(
+        [np.asarray(meas, np.float64), np.zeros((p, POSE_DIM))])
+
+    if fixed is None:
+        fixed_aug = np.zeros(n + p, bool)
+        # Priors ARE gauge information: only default-anchor pose 0 when
+        # nothing else constrains the gauge.
+        if p == 0:
+            fixed_aug[0] = True
+    else:
+        fixed_aug = np.concatenate([np.asarray(fixed, bool),
+                                    np.ones(p, bool)])
+    fixed_aug[n:] = True  # virtual anchor poses never move
+
+    n_e = np.asarray(edge_i).shape[0]
+    if sqrt_info is None and prior_sqrt_info is None:
+        si_aug = None
+    else:
+        base = (np.asarray(sqrt_info, np.float64) if sqrt_info is not None
+                else np.broadcast_to(np.eye(POSE_DIM),
+                                     (n_e, POSE_DIM, POSE_DIM)))
+        pri = (np.asarray(prior_sqrt_info, np.float64)
+               if prior_sqrt_info is not None
+               else np.broadcast_to(np.eye(POSE_DIM),
+                                    (p, POSE_DIM, POSE_DIM)))
+        if base.shape != (n_e, POSE_DIM, POSE_DIM):
+            raise ValueError(
+                f"sqrt_info must be [{n_e}, {POSE_DIM}, {POSE_DIM}], "
+                f"got {base.shape}")
+        if pri.shape != (p, POSE_DIM, POSE_DIM):
+            raise ValueError(
+                f"prior_sqrt_info must be [{p}, {POSE_DIM}, {POSE_DIM}], "
+                f"got {pri.shape}")
+        si_aug = np.concatenate([base, pri])
+    return poses_aug, ei_aug, ej_aug, meas_aug, fixed_aug, si_aug
+
+
 @dataclasses.dataclass
 class SyntheticPoseGraph:
     """Ground truth + drifted odometry init for a loop-closed graph."""
