@@ -359,3 +359,34 @@ def test_prior_factor_weighting_trades_off():
     # Weak prior: the (noise-free, anchored) odometry wins; pose 5 stays
     # ~1m away from the conflicting prior.
     assert weak > 0.9
+
+
+def test_prior_factors_compose_with_sharding():
+    """Priors are ordinary edges, so they must shard: world-2 solve of a
+    prior-augmented graph matches world-1 exactly (f64)."""
+    import dataclasses as dc
+
+    from megba_tpu.models.pgo import (
+        make_synthetic_pose_graph, solve_pgo, with_priors)
+
+    g = make_synthetic_pose_graph(num_poses=14, loop_closures=4, seed=6)
+    target = g.poses_gt[2]
+    poses0, ei, ej, meas, fixed, si = with_priors(
+        g.poses0, g.edge_i, g.edge_j, g.meas,
+        prior_idx=[2], prior_poses=[target],
+        prior_sqrt_info=[np.eye(6) * 10.0])
+    base = ProblemOption(
+        dtype=np.float64,
+        algo_option=AlgoOption(max_iter=8),
+        solver_option=SolverOption(max_iter=30, tol=1e-12),
+    )
+    res1 = solve_pgo(poses0, ei, ej, meas, base,
+                     sqrt_info=si, fixed=fixed)
+    res2 = solve_pgo(poses0, ei, ej, meas,
+                     dc.replace(base, world_size=2),
+                     sqrt_info=si, fixed=fixed)
+    np.testing.assert_allclose(float(res2.cost), float(res1.cost),
+                               rtol=1e-10, atol=1e-18)
+    assert int(res2.iterations) == int(res1.iterations)
+    np.testing.assert_allclose(np.asarray(res2.poses),
+                               np.asarray(res1.poses), atol=1e-9)
