@@ -366,8 +366,7 @@ def test_prior_factors_compose_with_sharding():
     prior-augmented graph matches world-1 exactly (f64)."""
     import dataclasses as dc
 
-    from megba_tpu.models.pgo import (
-        make_synthetic_pose_graph, solve_pgo, with_priors)
+    from megba_tpu.models.pgo import with_priors
 
     g = make_synthetic_pose_graph(num_poses=14, loop_closures=4, seed=6)
     target = g.poses_gt[2]
