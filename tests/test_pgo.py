@@ -389,3 +389,42 @@ def test_prior_factors_compose_with_sharding():
     assert int(res2.iterations) == int(res1.iterations)
     np.testing.assert_allclose(np.asarray(res2.poses),
                                np.asarray(res1.poses), atol=1e-9)
+
+
+def test_with_priors_edge_cases():
+    """Degenerate inputs: no priors (identity transform + default
+    gauge), prior on an already-fixed pose (harmless), bad indices and
+    bad weight shapes raise up front with clear messages."""
+    import pytest
+
+    from megba_tpu.models.pgo import with_priors
+
+    g = make_synthetic_pose_graph(num_poses=6, loop_closures=2, seed=1)
+    n = g.poses0.shape[0]
+
+    # p = 0: passthrough with the default gauge anchor.
+    poses0, ei, ej, meas, fixed, si = with_priors(
+        g.poses0, g.edge_i, g.edge_j, g.meas,
+        prior_idx=np.zeros(0, np.int32), prior_poses=np.zeros((0, 6)))
+    assert poses0.shape[0] == n and fixed[0] and fixed.sum() == 1
+    assert si is None and ei.shape == g.edge_i.shape
+
+    # Prior on a pose the caller also fixed: both constraints coexist
+    # (the fixed pose just never moves; the prior edge costs a constant).
+    caller_fixed = np.zeros(n, bool)
+    caller_fixed[2] = True
+    poses0, ei, ej, meas, fixed, si = with_priors(
+        g.poses0, g.edge_i, g.edge_j, g.meas,
+        prior_idx=[2], prior_poses=[g.poses_gt[2]], fixed=caller_fixed)
+    assert fixed[2] and fixed[n] and fixed.sum() == 2
+
+    with pytest.raises(ValueError, match="prior_idx out of range"):
+        with_priors(g.poses0, g.edge_i, g.edge_j, g.meas,
+                    prior_idx=[n], prior_poses=[np.zeros(6)])
+    with pytest.raises(ValueError, match="prior_poses must be"):
+        with_priors(g.poses0, g.edge_i, g.edge_j, g.meas,
+                    prior_idx=[0], prior_poses=[np.zeros(5)])
+    with pytest.raises(ValueError, match="prior_sqrt_info must be"):
+        with_priors(g.poses0, g.edge_i, g.edge_j, g.meas,
+                    prior_idx=[0], prior_poses=[np.zeros(6)],
+                    prior_sqrt_info=np.broadcast_to(np.eye(6), (2, 6, 6)))
