@@ -57,6 +57,12 @@ def main(argv=None) -> float:
                     default="file",
                     help="spanning_tree: bootstrap poses from the "
                          "measurements instead of the file's estimates")
+    ap.add_argument("--prior_ids", type=str, default="",
+                    help="comma-separated g2o vertex ids to anchor at "
+                         "their file estimates via unary prior factors "
+                         "(soft anchors; see --prior_weight)")
+    ap.add_argument("--prior_weight", type=float, default=1e4,
+                    help="sqrt-information scale of each prior (W = w*I)")
     args = ap.parse_args(argv)
 
     path = args.path
@@ -102,9 +108,12 @@ def main(argv=None) -> float:
                                        tol=args.solver_tol,
                                        refuse_ratio=1e30),
         )
+        prior_ids = ([int(v) for v in args.prior_ids.split(",") if v]
+                     if args.prior_ids else None)
         t0 = time.perf_counter()
         graph, res = solve_g2o(graph, option, verbose=True,
-                               init=args.init)
+                               init=args.init, prior_ids=prior_ids,
+                               prior_weight=args.prior_weight)
         print(f"solve: {time.perf_counter() - t0:.2f}s")
 
         if args.out:

@@ -353,7 +353,8 @@ def sqrt_info_of(graph: G2OGraph) -> Optional[np.ndarray]:
 
 
 def solve_g2o(source, option=None, verbose: bool = False,
-              init: str = "file"):
+              init: str = "file",
+              prior_ids=None, prior_weight: float = 1e4):
     """Read (path / file / G2OGraph), solve, return (graph, PGOResult).
 
     `init="spanning_tree"` re-initializes poses by composing
@@ -361,19 +362,71 @@ def solve_g2o(source, option=None, verbose: bool = False,
     (models/pgo.spanning_tree_init) instead of trusting the file's
     VERTEX estimates — the standard bootstrap for exports with garbage
     or missing initial guesses.
+
+    `prior_ids` (g2o VERTEX ids) anchors those poses at their FILE
+    estimates via unary prior factors weighted `prior_weight * I`
+    (models/pgo.with_priors) — the surveying workflow of holding known
+    stations softly instead of hard-FIXing them.  The returned result's
+    poses are sliced back to the graph's own poses (the virtual anchor
+    poses are internal).
     """
-    from megba_tpu.models.pgo import solve_pgo, spanning_tree_init
+    from megba_tpu.models.pgo import (
+        solve_pgo, spanning_tree_init, with_priors)
 
     graph = source if isinstance(source, G2OGraph) else read_g2o(source)
-    poses = graph.poses
+    n = graph.poses.shape[0]
+    poses0 = graph.poses
+    edge_i, edge_j, meas = graph.edge_i, graph.edge_j, graph.meas
+    fixed = graph.fixed
+    sqrt_info = sqrt_info_of(graph)
+    if prior_ids is not None and len(prior_ids) > 0:
+        index = {int(vid): k for k, vid in enumerate(graph.ids)}
+        try:
+            idx = np.array([index[int(v)] for v in prior_ids], np.int32)
+        except KeyError as exc:
+            raise ValueError(
+                f"prior id {exc.args[0]} is not a vertex of this graph"
+            ) from None
+        p = idx.shape[0]
+        # Priors carry the gauge; the parser's defaulted anchor (a FIX
+        # the file never declared) would fight them.  File-declared FIX
+        # records are kept — and so is the default anchor when the
+        # graph has a connected component no prior reaches (clearing it
+        # would leave that component with a free 6-DOF gauge and a
+        # singular system).
+        if not graph.had_fix:
+            from collections import deque
+
+            adj: list[list[int]] = [[] for _ in range(n)]
+            for a, b in zip(np.asarray(edge_i), np.asarray(edge_j)):
+                adj[int(a)].append(int(b))
+                adj[int(b)].append(int(a))
+            seen = np.zeros(n, bool)
+            seen[idx] = True
+            queue = deque(int(v) for v in idx)
+            while queue:
+                a = queue.popleft()
+                for b in adj[a]:
+                    if not seen[b]:
+                        seen[b] = True
+                        queue.append(b)
+            if seen.all():
+                fixed = np.zeros(n, bool)
+        poses0, edge_i, edge_j, meas, fixed, sqrt_info = with_priors(
+            poses0, edge_i, edge_j, meas,
+            prior_idx=idx, prior_poses=graph.poses[idx],
+            prior_sqrt_info=np.broadcast_to(
+                np.eye(6) * float(prior_weight), (p, 6, 6)),
+            fixed=fixed, sqrt_info=sqrt_info)
     if init == "spanning_tree":
-        poses = spanning_tree_init(poses, graph.edge_i, graph.edge_j,
-                                   graph.meas, graph.fixed)
+        poses0 = spanning_tree_init(poses0, edge_i, edge_j, meas, fixed)
     elif init != "file":
         raise ValueError(f"init must be 'file' or 'spanning_tree', "
                          f"got {init!r}")
     result = solve_pgo(
-        poses, graph.edge_i, graph.edge_j, graph.meas,
-        option, sqrt_info=sqrt_info_of(graph), fixed=graph.fixed,
+        poses0, edge_i, edge_j, meas,
+        option, sqrt_info=sqrt_info, fixed=fixed,
         verbose=verbose)
+    if result.poses.shape[0] != n:  # drop internal virtual anchors
+        result = result._replace(poses=result.poses[:n])
     return graph, result
