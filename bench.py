@@ -243,21 +243,29 @@ def main() -> None:
         jnp.asarray(mask),
     )
 
-    def timed_solve(opt):
+    # Phase breakdown (utils/timing.PhaseTimer) rides the JSON line so
+    # committed BENCH_*.json artifacts carry per-phase wall clocks, and
+    # feeds the optional SolveReport below.
+    from megba_tpu.utils.timing import PhaseTimer
+
+    timer = PhaseTimer()
+
+    def timed_solve(opt, label):
         solve = jax.jit(
             lambda cams, pts, obs, ci, pi, m, pl: lm_solve(
                 f, cams, pts, obs, ci, pi, m, opt, cam_sorted=cam_sorted,
                 plans=pl)
         )
-        # Warmup (compile) — not timed.
-        res = solve(*args, plans)
-        jax.block_until_ready(res.cost)
+        # Warmup (compile) — not part of the metric, but recorded as a
+        # phase so the compile cost is visible in the artifact.
+        with timer.phase(f"compile_{label}") as ph:
+            ph.sync(solve(*args, plans).cost)
         t0 = time.perf_counter()
-        res = solve(*args, plans)
-        jax.block_until_ready(res.cost)
+        with timer.phase(f"solve_{label}") as ph:
+            res = ph.sync(solve(*args, plans))
         return res, time.perf_counter() - t0
 
-    res, elapsed = timed_solve(option)
+    res, elapsed = timed_solve(option, "throughput")
     iters = int(res.iterations)
     lm_iters_per_sec = iters / elapsed
 
@@ -273,7 +281,7 @@ def main() -> None:
         import dataclasses as _dc
 
         conv_option = _dc.replace(option, solver_option=SolverOption())
-        conv_res, conv_elapsed = timed_solve(conv_option)
+        conv_res, conv_elapsed = timed_solve(conv_option, "convergence")
         conv_iters = int(conv_res.iterations)
         conv = {
             "lm_iters_per_sec": round(conv_iters / conv_elapsed, 3),
@@ -336,10 +344,33 @@ def main() -> None:
                     # Reference-default flags (tol=1e-1, refuse_ratio=1):
                     # the time-to-quality regime of BASELINE.md's metric.
                     "convergence_mode": conv,
+                    # Per-phase wall clocks (compile vs solve, per pass)
+                    # so BENCH_*.json artifacts carry phase timings.
+                    "phases": {
+                        name: {"total_s": round(d["total_s"], 4),
+                               "calls": d["calls"]}
+                        for name, d in timer.as_dict().items()
+                    },
                 },
             }
         )
     )
+
+    # Same opt-in sink as solve.flat_solve: one structured SolveReport
+    # per bench run when MEGBA_TELEMETRY is set (off: nothing imported).
+    telemetry = os.environ.get("MEGBA_TELEMETRY")
+    if telemetry:
+        from megba_tpu.observability.report import append_report, build_report
+
+        append_report(
+            build_report(option, res, timer.as_dict(), {
+                "num_cameras": NUM_CAMERAS,
+                "num_points": NUM_POINTS,
+                "num_edges": int(n_edge),
+                "num_edges_padded": int(args[2].shape[-1]),
+                "world_size": 1,
+                "bench_config": CONFIG,
+            }), telemetry)
 
 
 if __name__ == "__main__":

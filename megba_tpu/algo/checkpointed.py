@@ -26,6 +26,13 @@ import numpy as np
 
 from megba_tpu.algo.lm import LMResult
 from megba_tpu.common import ProblemOption
+from megba_tpu.observability.trace import (
+    TRACE_FIELDS,
+    SolveTrace,
+    trace_concat,
+    trace_filler,
+    trace_slice,
+)
 from megba_tpu.utils.checkpoint import load_state, save_state
 
 
@@ -73,6 +80,10 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     pcg_total = 0
     first_cost = None
     already_stopped = False
+    # Per-chunk trace slices (host numpy), stitched into one whole-solve
+    # SolveTrace at the end — and persisted in the snapshot so a resumed
+    # solve reports the same history a straight run would.
+    trace_parts = []
 
     # Problem identity guard: a stale/foreign snapshot with mismatched
     # shapes would otherwise be resumed silently (jnp.take clamps
@@ -99,6 +110,15 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         if "extra_first_cost" in st:
             first_cost = jnp.asarray(st["extra_first_cost"])
         already_stopped = bool(st.get("extra_stopped", False))
+        if "extra_trace_cost" in st:
+            trace_parts.append(SolveTrace(**{
+                f: np.asarray(st[f"extra_trace_{f}"])
+                for f in TRACE_FIELDS}))
+        elif done:
+            # Snapshot predates the trace: pad the unknowable pre-resume
+            # iterations with inert NaN history so the stitched trace
+            # still aligns index-for-index with `iterations`.
+            trace_parts.append(trace_filler(done))
 
     result = None
     while not already_stopped and done < total:
@@ -114,15 +134,25 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         done += ran
         stopped = bool(result.stopped) or ran < chunk
         arr_a, arr_b = dump_params(params)
+        extra = {"v": np.asarray(v),
+                 "accepted": np.asarray(accepted_total),
+                 "pcg": np.asarray(pcg_total),
+                 "first_cost": np.asarray(float(first_cost)),
+                 "stopped": np.asarray(stopped),
+                 "topology": topo}
+        chunk_trace = getattr(result, "trace", None)
+        if chunk_trace is not None:
+            # Keep only the iterations this chunk actually ran, and
+            # snapshot the accumulated history (tiny: a few scalars per
+            # LM iteration) so resume preserves the full trace.
+            trace_parts.append(trace_slice(chunk_trace, ran))
+            acc = trace_concat(trace_parts)
+            extra.update({f"trace_{f}": getattr(acc, f)
+                          for f in TRACE_FIELDS})
         save_state(
             checkpoint_path, arr_a, arr_b,
             region=region, cost=float(result.cost), iteration=done,
-            extra={"v": np.asarray(v),
-                   "accepted": np.asarray(accepted_total),
-                   "pcg": np.asarray(pcg_total),
-                   "first_cost": np.asarray(float(first_cost)),
-                   "stopped": np.asarray(stopped),
-                   "topology": topo})
+            extra=extra)
         if stopped:
             break  # converged (possibly exactly on the chunk boundary)
 
@@ -134,13 +164,18 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
             result = _replace(result, stopped=jnp.bool_(True))
 
     # Report whole-solve aggregates, not last-chunk ones.
-    return _replace(
-        result,
+    fields = dict(
         initial_cost=first_cost,
         iterations=jnp.asarray(done, jnp.int32),
         accepted=jnp.asarray(accepted_total, jnp.int32),
         pcg_iterations=jnp.asarray(pcg_total, jnp.int32),
     )
+    if getattr(result, "trace", None) is not None:
+        # The whole-solve history (chunks stitched back together); the
+        # last chunk's raw [chunk] buffers alone would misreport a
+        # resumed/chunked solve.
+        fields["trace"] = trace_concat(trace_parts)
+    return _replace(result, **fields)
 
 
 def solve_checkpointed(

@@ -20,13 +20,10 @@ on-device: no host-blocking residual-norm or dot reductions
 from __future__ import annotations
 
 import dataclasses
-import itertools
-import time
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from megba_tpu.common import ComputeKind, ProblemOption
 from megba_tpu.linear_system.builder import (
@@ -34,64 +31,21 @@ from megba_tpu.linear_system.builder import (
     build_schur_system,
     weight_system_inputs,
 )
+from megba_tpu.observability.emit import (
+    emit_verbose_iteration,
+    next_verbose_token,
+)
+from megba_tpu.observability.trace import SolveTrace
 from megba_tpu.ops.accum import comp_sum, comp_sum_sq
 from megba_tpu.ops.robust import RobustKind, robustify
 from megba_tpu.solver.pcg import HI, plain_pcg_solve, schur_pcg_solve
 
 _TINY = 1e-30
 
-# Host-side clocks for verbose per-iteration lines, keyed by a per-solve
-# token (a dynamic operand, so jitted programs stay cached across solves
-# while concurrent/chunked solves each get their own t0).  Iteration 0's
-# callback starts that solve's clock; the dict is pruned so abandoned
-# solves (e.g. an interrupted run that never reached its later
-# callbacks) can't grow it without bound.
-_VERBOSE_CLOCKS: dict = {}
-
-
-def _emit_verbose_line(token, k, c, a, p):
-    now = time.perf_counter()
-    token = int(token)
-    if int(k) == 0 or token not in _VERBOSE_CLOCKS:
-        while len(_VERBOSE_CLOCKS) > 64:
-            # Evict oldest-started first (dict preserves insertion order);
-            # never clear() — that would wipe live solves' clocks.
-            _VERBOSE_CLOCKS.pop(next(iter(_VERBOSE_CLOCKS)))
-        _VERBOSE_CLOCKS[token] = now
-    dt = (now - _VERBOSE_CLOCKS[token]) * 1e3
-    print(
-        f"iter {int(k)}: cost {float(c):.6e} "
-        f"log10 {np.log10(max(float(c), 1e-300)):.3f} "
-        f"accept {bool(a)} pcg_iters {int(p)} "
-        f"elapsed {dt:.1f} ms", flush=True)
-
-
-# Monotonic per-solve token source for the verbose clock.  count().__next__
-# is atomic under the GIL, so concurrent solves can never share a token.
-_next_verbose_token = itertools.count(1).__next__
-
-
-def emit_verbose_iteration(token, k, cost, accept, pcg_iters,
-                           axis_name=None):
-    """Emit one per-iteration line from inside a jitted LM body.
-
-    Host callback printing the reference's observable (cost, log10 cost,
-    elapsed ms — lm_algo.cu:149-162); elapsed is measured host-side from
-    this solve's first callback (iteration 0 starts the clock keyed by
-    the per-solve token — jitted programs are cached across solves, so a
-    trace-time baseline would be frozen at the FIRST solve's start).
-    With `axis_name` set, only shard 0 emits — one line per iteration,
-    not one per shard.  Shared by the BA and PGO loops.
-    """
-    def _print(args):
-        jax.debug.callback(_emit_verbose_line, *args)
-
-    args = (token, k, cost, accept, pcg_iters)
-    if axis_name is None:
-        _print(args)
-    else:
-        jax.lax.cond(jax.lax.axis_index(axis_name) == 0, _print,
-                     lambda _: None, args)
+# Verbose-line emission moved to observability/emit.py (the single home
+# of human-readable solver output); this alias keeps the historical
+# import path working.
+_next_verbose_token = next_verbose_token
 
 
 @jax.tree_util.register_dataclass
@@ -109,6 +63,11 @@ class LMResult:
     region: jax.Array  # final trust region
     v: jax.Array  # final reject back-off factor (resume state)
     stopped: jax.Array  # True when a convergence criterion fired
+    # Per-iteration convergence history ([max_iter] arrays masked by
+    # `iterations`), recorded on-device inside the while_loop — see
+    # observability/trace.py.  None only for results built by legacy
+    # constructors that predate the trace.
+    trace: Optional[SolveTrace] = None
 
 
 def lm_solve(
@@ -162,10 +121,15 @@ def lm_solve(
     robust_delta = option.robust_delta
 
     def linearize(cams, pts):
-        r, Jc, Jp = residual_jac_fn(jnp.take(cams, cam_idx, axis=1),
-                                    jnp.take(pts, pt_idx, axis=1), obs)
-        r, Jc, Jp = weight_system_inputs(
-            r, Jc, Jp, cam_idx, pt_idx, mask, sqrt_info, cam_fixed, pt_fixed)
+        # named_scope: zero runtime cost, but the residual+Jacobian ops
+        # carry a navigable label in trace_profile output
+        # (TensorBoard/Perfetto) instead of dissolving into fused soup.
+        with jax.named_scope("megba.residual_jacobian"):
+            r, Jc, Jp = residual_jac_fn(jnp.take(cams, cam_idx, axis=1),
+                                        jnp.take(pts, pt_idx, axis=1), obs)
+            r, Jc, Jp = weight_system_inputs(
+                r, Jc, Jp, cam_idx, pt_idx, mask, sqrt_info, cam_fixed,
+                pt_fixed)
         # Costs use compensated f32 sums (ops/accum.py): at BAL-Final
         # scale (~58M terms) a plain f32 sum's O(n*eps) error would flip
         # accept/reject decisions near convergence; the reference gets
@@ -212,6 +176,9 @@ def lm_solve(
             dtype),
         v=jnp.asarray(2.0 if initial_v is None else initial_v, dtype),
         stop=jnp.bool_(False),
+        # Fixed-size on-device history; one .at[k].set per field per
+        # iteration, no host traffic (observability/trace.py).
+        trace=SolveTrace.empty(algo_opt.max_iter, dtype),
     )
 
     def cond(s):
@@ -220,14 +187,16 @@ def lm_solve(
     pcg_solve = schur_pcg_solve if option.use_schur else plain_pcg_solve
 
     def body(s):
-        pcg = pcg_solve(
-            s["system"], s["Jc"], s["Jp"], cam_idx, pt_idx, s["region"],
-            max_iter=solver_opt.max_iter, tol=solver_opt.tol,
-            refuse_ratio=solver_opt.refuse_ratio,
-            tol_relative=solver_opt.tol_relative,
-            compute_kind=compute_kind, axis_name=axis_name,
-            mixed_precision=option.mixed_precision_pcg, cam_sorted=cam_sorted,
-            preconditioner=solver_opt.preconditioner, plans=plans)
+        with jax.named_scope("megba.pcg"):
+            pcg = pcg_solve(
+                s["system"], s["Jc"], s["Jp"], cam_idx, pt_idx, s["region"],
+                max_iter=solver_opt.max_iter, tol=solver_opt.tol,
+                refuse_ratio=solver_opt.refuse_ratio,
+                tol_relative=solver_opt.tol_relative,
+                compute_kind=compute_kind, axis_name=axis_name,
+                mixed_precision=option.mixed_precision_pcg,
+                cam_sorted=cam_sorted,
+                preconditioner=solver_opt.preconditioner, plans=plans)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply
@@ -298,8 +267,9 @@ def lm_solve(
         def _keep_old(_):
             return s["r"], s["Jc"], s["Jp"], s["system"]
 
-        r_n, Jc_n, Jp_n, system_n = jax.lax.cond(
-            accept, _relinearize, _keep_old, None)
+        with jax.named_scope("megba.lm_accept_reject"):
+            r_n, Jc_n, Jp_n, system_n = jax.lax.cond(
+                accept, _relinearize, _keep_old, None)
 
         g_inf = jnp.maximum(jnp.max(jnp.abs(system_n.g_cam)),
                             jnp.max(jnp.abs(system_n.g_pt)))
@@ -331,6 +301,16 @@ def lm_solve(
             region=jnp.where(accept, region_accept, region_reject),
             v=jnp.where(accept, jnp.asarray(2.0, dtype), v_reject),
             stop=converged | (accept & stop_accept),
+            # Every recorded value is replicated across shards (costs,
+            # g_inf and rho come out of psum-reduced quantities; the
+            # trust-region state is carried replicated), so the trace
+            # rides shard_map's out_specs=P() unchanged.  `cost` records
+            # the TRIAL cost — the same observable the verbose line
+            # prints, which the telemetry parity tests pin.
+            trace=s["trace"].record(
+                s["k"], cost=cost_new, grad_inf_norm=g_inf,
+                trust_region=s["region"], rho=rho, accept=accept,
+                pcg_iters=pcg.iterations),
         )
         if verbose:
             token = (jnp.int32(0) if verbose_token is None
@@ -351,6 +331,7 @@ def lm_solve(
         region=out["region"],
         v=out["v"],
         stopped=out["stop"],
+        trace=out["trace"],
     )
 
 

@@ -157,6 +157,12 @@ class ProblemOption:
     # Robust loss (capability beyond the reference; Ceres-style kernels).
     robust_kind: "RobustKind" = None  # resolved to RobustKind.NONE below
     robust_delta: float = 1.0
+    # Opt-in telemetry sink: a JSONL path each solve appends a structured
+    # SolveReport to (observability/report.py).  Equivalent to setting
+    # MEGBA_TELEMETRY; the knob wins when both are set.  Purely host-side:
+    # solve.flat_solve strips it before program build, so it never
+    # fragments the jit caches or changes the compiled program.
+    telemetry: Optional[str] = None
 
     def __post_init__(self) -> None:
         from megba_tpu.ops.robust import RobustKind

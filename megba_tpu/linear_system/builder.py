@@ -123,6 +123,10 @@ def _grad_rows(J: jax.Array, r: jax.Array, od: int, d: int) -> jax.Array:
     ])
 
 
+# named_scope: labels every assembly op in profiler traces
+# (TensorBoard/Perfetto via utils.timing.trace_profile) at zero runtime
+# cost — the Schur build is a hot phase worth finding at a glance.
+@jax.named_scope("megba.schur_build")
 def build_schur_system(
     r: jax.Array,
     Jc: jax.Array,

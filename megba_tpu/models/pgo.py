@@ -41,7 +41,12 @@ from megba_tpu.common import ProblemOption
 from megba_tpu.core.fm import segsum_fm
 from megba_tpu.core.host_se3 import compose, relative
 from megba_tpu.core.types import pad_edges
-from megba_tpu.parallel.mesh import EDGE_AXIS, make_mesh
+from megba_tpu.parallel.mesh import (
+    EDGE_AXIS,
+    SHARD_MAP_NATIVE,
+    make_mesh,
+    shard_map,
+)
 from megba_tpu.ops import geo
 from megba_tpu.ops.accum import comp_sum, comp_sum_sq
 from megba_tpu.ops.robust import RobustKind, robustify
@@ -202,6 +207,11 @@ def solve_pgo(
     loop closures; `result.cost` is then Sum rho.
     """
     option = option or ProblemOption()
+    if option.telemetry is not None:
+        # The PGO family records no SolveReport yet (README "Telemetry &
+        # profiling" scopes the sink to the BA pipeline); strip the
+        # host-only knob so it cannot fragment _pgo_program's lru cache.
+        option = dataclasses.replace(option, telemetry=None)
     # f64 only when actually available (x64 enabled) — otherwise warn
     # loudly, same precision contract as flat_solve.
     warn_if_x64_unavailable(option.dtype)
@@ -263,11 +273,11 @@ def solve_pgo(
     region0 = (option.algo_option.initial_region if initial_region is None
                else initial_region)
     v0 = 2.0 if initial_v is None else initial_v
-    from megba_tpu.algo.lm import _next_verbose_token
+    from megba_tpu.observability.emit import next_verbose_token
 
     args = [poses_fm, fixed_np, ei, ej, meas_fm,
             jnp.asarray(region0, dtype), jnp.asarray(v0, dtype),
-            jnp.asarray(_next_verbose_token(), jnp.int32), *extras]
+            jnp.asarray(next_verbose_token(), jnp.int32), *extras]
     if mesh is not None:
         from megba_tpu.parallel.multihost import dispatch_on_mesh
 
@@ -321,7 +331,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
     solver_opt = option.solver_option
     axis_name = EDGE_AXIS if world > 1 else None
 
-    from megba_tpu.algo.lm import emit_verbose_iteration
+    from megba_tpu.observability.emit import emit_verbose_iteration
     from megba_tpu.solver.pcg import _pcg_core, block_inv
 
     def run(poses_fm, fixed_j, ei, ej, meas_fm, region0, v0,
@@ -475,9 +485,11 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
         # (solve_pgo hands over a fresh feature-major copy per call, and
         # the checkpointed chunk driver feeds each chunk's output into
         # the next call without other readers).
-        return jax.jit(jax.shard_map(
+        # Donation is skipped under the experimental shard_map fallback
+        # (freed-buffer aliasing hazard — see parallel/mesh.py).
+        return jax.jit(shard_map(
             run, mesh=mesh, in_specs=tuple(in_specs), out_specs=P()),
-            donate_argnums=(0,)), mesh
+            donate_argnums=(0,) if SHARD_MAP_NATIVE else ()), mesh
     return jax.jit(run, donate_argnums=(0,)), None
 
 

@@ -1,0 +1,54 @@
+"""On-device convergence telemetry, structured solve reports, profiling.
+
+Three pillars (none of which the reference has — its only observability
+is a wall-clock print per LM iteration, lm_algo.cu:141-162):
+
+- `trace.SolveTrace`: fixed-size per-iteration history arrays carried
+  THROUGH the jitted `lax.while_loop` (algo/lm.py) and returned as
+  `LMResult.trace` — captured entirely on-device, zero extra host
+  round trips, identical under `shard_map` and multi-process meshes.
+- `report.SolveReport`: a structured, JSON-round-trippable record of one
+  solve (problem shape, config, backend topology, per-phase wall clock,
+  memory stats, the materialized trace) with an opt-in JSONL sink
+  (`MEGBA_TELEMETRY=<path>` or `ProblemOption.telemetry`).
+- `summarize`: a CLI (`python -m megba_tpu.observability.summarize`)
+  rendering recorded reports as convergence tables + phase breakdowns.
+
+`emit` is the single home of all human-readable solver output (the
+verbose per-iteration line and the problem-stats block), so stdout and
+telemetry can never drift apart.
+
+This `__init__` stays import-light on purpose: `report` and `summarize`
+load lazily, so a telemetry-off solve never imports the sink machinery
+(tested by tests/test_observability.py).
+"""
+
+from megba_tpu.observability.emit import (
+    emit_problem_stats,
+    emit_verbose_iteration,
+    next_verbose_token,
+)
+from megba_tpu.observability.trace import SolveTrace, trace_to_dict
+
+__all__ = [
+    "SolveReport",
+    "SolveTrace",
+    "append_report",
+    "build_report",
+    "emit_problem_stats",
+    "emit_verbose_iteration",
+    "next_verbose_token",
+    "trace_to_dict",
+]
+
+_LAZY = {"SolveReport", "append_report", "build_report"}
+
+
+def __getattr__(name):
+    # Sink machinery loads on first use, not at package import: the
+    # telemetry-off hot path must not pay for (or even import) it.
+    if name in _LAZY:
+        from megba_tpu.observability import report
+
+        return getattr(report, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

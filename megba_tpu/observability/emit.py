@@ -1,0 +1,90 @@
+"""Single home of the solver's human-readable output.
+
+Both stdout surfaces — the per-iteration verbose line (the reference's
+observable, lm_algo.cu:149-162; parsed back by utils/curves.py for the
+committed evidence artifacts) and the problem-stats block `solve_bal`
+prints — are formatted HERE, so verbose output and telemetry can never
+drift apart, and the curve parser tracks exactly one format definition.
+
+The per-solve verbose clocks live here too: host-side start times keyed
+by a per-solve token (a dynamic operand, so jitted programs stay cached
+across solves while concurrent/chunked solves each get their own t0).
+Iteration 0's callback starts that solve's clock; the table is pruned by
+LAST-TOUCH time so a long-running solve that keeps emitting lines can
+never lose its clock to a burst of short solves (evicting by insertion
+order could drop the oldest STILL-LIVE solve under >_MAX_CLOCKS
+concurrent solves — the regression tests/test_observability.py pins).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+import jax
+import numpy as np
+
+# token -> [t0, last_touch] (host perf_counter seconds).
+_VERBOSE_CLOCKS: dict = {}
+_MAX_CLOCKS = 64
+
+# Monotonic per-solve token source.  count().__next__ is atomic under
+# the GIL, so concurrent solves can never share a token.
+next_verbose_token = itertools.count(1).__next__
+
+
+def _emit_verbose_line(token, k, c, a, p):
+    now = time.perf_counter()
+    token = int(token)
+    entry = _VERBOSE_CLOCKS.get(token)
+    if int(k) == 0 or entry is None:
+        while len(_VERBOSE_CLOCKS) >= _MAX_CLOCKS:
+            # Evict the least-recently-touched clock; never clear() —
+            # that would wipe live solves' clocks.
+            stalest = min(_VERBOSE_CLOCKS,
+                          key=lambda t: _VERBOSE_CLOCKS[t][1])
+            _VERBOSE_CLOCKS.pop(stalest)
+        entry = _VERBOSE_CLOCKS[token] = [now, now]
+    else:
+        entry[1] = now
+    dt = (now - entry[0]) * 1e3
+    # Format contract: utils/curves._LINE parses this line.
+    print(
+        f"iter {int(k)}: cost {float(c):.6e} "
+        f"log10 {np.log10(max(float(c), 1e-300)):.3f} "
+        f"accept {bool(a)} pcg_iters {int(p)} "
+        f"elapsed {dt:.1f} ms", flush=True)
+
+
+def emit_verbose_iteration(token, k, cost, accept, pcg_iters,
+                           axis_name=None):
+    """Emit one per-iteration line from inside a jitted LM body.
+
+    Host callback printing the reference's observable (cost, log10 cost,
+    elapsed ms — lm_algo.cu:149-162); elapsed is measured host-side from
+    this solve's first callback (iteration 0 starts the clock keyed by
+    the per-solve token — jitted programs are cached across solves, so a
+    trace-time baseline would be frozen at the FIRST solve's start).
+    With `axis_name` set, only shard 0 emits — one line per iteration,
+    not one per shard.  Shared by the BA and PGO loops.
+    """
+    def _print(args):
+        jax.debug.callback(_emit_verbose_line, *args)
+
+    args = (token, k, cost, accept, pcg_iters)
+    if axis_name is None:
+        _print(args)
+    else:
+        jax.lax.cond(jax.lax.axis_index(axis_name) == 0, _print,
+                     lambda _: None, args)
+
+
+def emit_problem_stats(num_cameras, num_points, num_observations,
+                       max_cam_degree, max_pt_degree, hpl_blocks):
+    """The verbose problem-stats block (solve_bal's pre-solve summary)."""
+    print(
+        f"problem: {num_cameras} cameras, {num_points} points, "
+        f"{num_observations} observations | max camera degree "
+        f"{max_cam_degree}, max point degree {max_pt_degree}, Hpl blocks "
+        f"{hpl_blocks if hpl_blocks >= 0 else 'n/a (edges unsorted)'}",
+        flush=True)

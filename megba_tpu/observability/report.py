@@ -1,0 +1,135 @@
+"""SolveReport: a structured, machine-readable record of one solve.
+
+One JSON-round-trippable object per solve: problem shape, the full
+`ProblemOption` configuration, backend/device topology, per-phase wall
+clock (utils/timing.PhaseTimer), device memory stats when the backend
+exposes them (utils/meminfo), the final result scalars, and the
+materialized on-device convergence trace (trace.SolveTrace).
+
+The sink is opt-in JSONL: `MEGBA_TELEMETRY=<path>` (or the `telemetry`
+knob on `ProblemOption`) appends one line per `flat_solve` call — for a
+checkpointed solve that is one line per CHUNK, each carrying that
+chunk's own iterations/costs/trace (preemption forensics; the stitched
+whole-solve trace lives on the returned `LMResult.trace`).  `python -m
+megba_tpu.observability.summarize <path>` renders them.  When telemetry
+is off this module is never imported (the package `__init__` loads it
+lazily and solve.py gates the import on the knob) — the hot path pays
+nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+SCHEMA = "megba_tpu.solve_report/v1"
+
+
+def config_to_dict(option) -> Dict[str, Any]:
+    """Serialize an option dataclass tree to plain JSON types.
+
+    Enums become their names, dtypes their numpy names, nested option
+    dataclasses nested dicts — generic over the option structs so a new
+    field can never silently vanish from reports.
+    """
+    def conv(v):
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            return {f.name: conv(getattr(v, f.name))
+                    for f in dataclasses.fields(v)}
+        if isinstance(v, enum.Enum):
+            return v.name
+        if isinstance(v, (np.integer, np.floating, np.bool_)):
+            return v.item()
+        if isinstance(v, (np.dtype, type)):
+            return np.dtype(v).name
+        return v
+
+    return conv(option)
+
+
+def backend_topology() -> Dict[str, Any]:
+    """Backend + device/process topology of THIS run."""
+    import jax
+
+    devices = jax.devices()
+    kinds = sorted({d.device_kind for d in devices})
+    return {
+        "backend": jax.default_backend(),
+        "device_count": len(devices),
+        "local_device_count": len(jax.local_devices()),
+        "device_kinds": kinds,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+    }
+
+
+@dataclasses.dataclass
+class SolveReport:
+    """One solve's telemetry record; `to_json`/`from_json` round-trip."""
+
+    problem: Dict[str, Any]  # num_cameras / num_points / num_edges / ...
+    config: Dict[str, Any]  # serialized ProblemOption
+    backend: Dict[str, Any]  # platform + device/process topology
+    phases: Dict[str, Any]  # PhaseTimer.as_dict(): name -> {total_s, calls}
+    result: Dict[str, Any]  # final scalars (costs, iterations, ...)
+    trace: Optional[Dict[str, list]] = None  # trace.trace_to_dict output
+    memory: Optional[Dict[str, Any]] = None  # utils.meminfo.device_memory_stats
+    schema: str = SCHEMA
+    created_unix: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "SolveReport":
+        d = json.loads(line)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def build_report(option, result, phases: Dict[str, Any],
+                 problem: Dict[str, Any]) -> SolveReport:
+    """Assemble a SolveReport from a finished solve.
+
+    `result` is an LMResult (trace included when the solve populated
+    one); this call materializes the trace and result scalars, so the
+    caller must be prepared for the implied device sync — telemetry-off
+    paths never get here.
+    """
+    from megba_tpu.observability.trace import trace_to_dict
+    from megba_tpu.utils.meminfo import device_memory_stats
+
+    iterations = int(result.iterations)
+    trace = getattr(result, "trace", None)
+    return SolveReport(
+        problem=problem,
+        config=config_to_dict(option),
+        backend=backend_topology(),
+        phases=phases,
+        result={
+            "initial_cost": float(result.initial_cost),
+            "final_cost": float(result.cost),
+            "iterations": iterations,
+            "accepted": int(result.accepted),
+            "pcg_iterations": int(result.pcg_iterations),
+            "region": float(result.region),
+            "stopped": bool(result.stopped),
+        },
+        trace=None if trace is None else trace_to_dict(trace, iterations),
+        memory=device_memory_stats(),
+        created_unix=time.time(),
+    )
+
+
+def append_report(report: SolveReport, path: str) -> None:
+    """Append one report as a JSONL line (creates parent dirs)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(report.to_json() + "\n")

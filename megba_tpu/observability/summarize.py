@@ -1,0 +1,109 @@
+"""Render recorded SolveReports as convergence tables + phase breakdowns.
+
+Usage: python -m megba_tpu.observability.summarize <report.jsonl> [...]
+
+Reads JSONL files written by the `MEGBA_TELEMETRY` sink (one SolveReport
+per line) and prints, per report: a header (problem shape, backend,
+config essentials), the result summary, the per-iteration convergence
+table, the phase wall-clock breakdown, and memory stats when present.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Iterable, List
+
+from megba_tpu.observability.report import SolveReport
+
+
+def load_reports(path: str) -> List[SolveReport]:
+    with open(path) as fh:
+        return [SolveReport.from_json(line)
+                for line in fh if line.strip()]
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TiB"
+
+
+def format_report(rep: SolveReport, index: int = 0) -> str:
+    lines = []
+    p, b, r = rep.problem, rep.backend, rep.result
+    cfg = rep.config or {}
+    lines.append(
+        f"== report {index}: {p.get('num_cameras', '?')} cams / "
+        f"{p.get('num_points', '?')} pts / {p.get('num_edges', '?')} edges "
+        f"| {b.get('backend', '?')} x{b.get('device_count', '?')} "
+        f"(process {b.get('process_index', 0)}/{b.get('process_count', 1)})")
+    algo = cfg.get("algo_option", {}) or {}
+    lines.append(
+        f"   config: dtype={cfg.get('dtype')} "
+        f"compute={cfg.get('compute_kind')} "
+        f"jacobian={cfg.get('jacobian_mode')} "
+        f"world_size={cfg.get('world_size')} "
+        f"max_iter={algo.get('max_iter')}")
+    lines.append(
+        f"   result: cost {r.get('initial_cost', float('nan')):.6e} -> "
+        f"{r.get('final_cost', float('nan')):.6e} in "
+        f"{r.get('iterations')} LM iters ({r.get('accepted')} accepted, "
+        f"{r.get('pcg_iterations')} PCG), stopped={r.get('stopped')}")
+
+    if rep.trace and rep.trace.get("cost"):
+        t = rep.trace
+        lines.append("   iter  cost          log10    region     rho"
+                     "        accept  pcg")
+        for k, cost in enumerate(t["cost"]):
+            log10 = math.log10(max(cost, 1e-300))
+            lines.append(
+                f"   {k:4d}  {cost:.6e}  {log10:7.3f}  "
+                f"{t['trust_region'][k]:.3e}  {t['rho'][k]:9.3e}  "
+                f"{'yes' if t['accept'][k] else ' no':>6}  "
+                f"{t['pcg_iters'][k]:4d}")
+
+    if rep.phases:
+        lines.append("   phases:")
+        total = 0.0
+        for name in sorted(rep.phases,
+                           key=lambda n: rep.phases[n]["total_s"],
+                           reverse=True):
+            ph = rep.phases[name]
+            t_ms, c = ph["total_s"] * 1e3, ph["calls"]
+            total += ph["total_s"]
+            lines.append(f"     {name}: {t_ms:.1f} ms / {c} calls "
+                         f"= {t_ms / c:.2f} ms")
+        lines.append(f"     total: {total * 1e3:.1f} ms")
+
+    if rep.memory:
+        peak = rep.memory.get("peak_bytes_in_use")
+        if peak is not None:
+            lines.append(f"   memory: peak {_fmt_bytes(peak)} in use")
+        else:
+            lines.append(f"   memory: {rep.memory}")
+    return "\n".join(lines)
+
+
+def summarize_paths(paths: Iterable[str]) -> str:
+    blocks = []
+    for path in paths:
+        reports = load_reports(path)
+        blocks.append(f"{path}: {len(reports)} report(s)")
+        blocks.extend(format_report(rep, i) for i, rep in enumerate(reports))
+    return "\n".join(blocks)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 2
+    print(summarize_paths(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

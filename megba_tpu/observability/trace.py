@@ -1,0 +1,138 @@
+"""SolveTrace: on-device per-iteration convergence history.
+
+Fixed-size `[max_iter]` arrays carried through the jitted LM
+`lax.while_loop` (algo/lm.py) and written with one `.at[k].set` per
+field per iteration — a handful of scalar dynamic-update-slices, so the
+trace adds no host callbacks, no extra dispatches, and works unchanged
+under `shard_map` (every recorded value is already replicated: costs and
+gradients are psum-reduced, the trust-region state is carried
+replicated).  Entries at indices >= `LMResult.iterations` are the unused
+tail of the fixed-size buffers; `trace_to_dict` masks them off when the
+trace is materialized for a report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Field order is the serialization order everywhere (reports, snapshots).
+TRACE_FIELDS = (
+    "cost",
+    "grad_inf_norm",
+    "trust_region",
+    "rho",
+    "accept",
+    "pcg_iters",
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SolveTrace:
+    """Per-iteration LM history, shaped [max_iter] and masked by k.
+
+    `cost` is the TRIAL cost of each iteration (the value the verbose
+    line prints — on reject the carried cost stays put, but the trial is
+    the convergence observable); `grad_inf_norm` is ||g||_inf of the
+    system the iteration ends with; `trust_region` is the region the
+    step was computed with; `rho` the gain ratio; `accept` the
+    accept/reject decision; `pcg_iters` the inner-solver iterations.
+    """
+
+    cost: jax.Array  # [max_iter] float
+    grad_inf_norm: jax.Array  # [max_iter] float
+    trust_region: jax.Array  # [max_iter] float
+    rho: jax.Array  # [max_iter] float
+    accept: jax.Array  # [max_iter] bool
+    pcg_iters: jax.Array  # [max_iter] int32
+
+    @classmethod
+    def empty(cls, max_iter: int, dtype) -> "SolveTrace":
+        """Zero-initialised buffers for a solve of <= max_iter iterations."""
+        return cls(
+            cost=jnp.zeros((max_iter,), dtype),
+            grad_inf_norm=jnp.zeros((max_iter,), dtype),
+            trust_region=jnp.zeros((max_iter,), dtype),
+            rho=jnp.zeros((max_iter,), dtype),
+            accept=jnp.zeros((max_iter,), jnp.bool_),
+            pcg_iters=jnp.zeros((max_iter,), jnp.int32),
+        )
+
+    def record(self, k, *, cost, grad_inf_norm, trust_region, rho, accept,
+               pcg_iters) -> "SolveTrace":
+        """Write iteration k's observables; returns the updated trace."""
+        if self.cost.shape[0] == 0:
+            # max_iter=0 programs (the checkpointed driver's evaluate-only
+            # chunk) still TRACE the loop body; indexing a size-0 buffer
+            # would raise at trace time even though the body never runs.
+            return self
+        return SolveTrace(
+            cost=self.cost.at[k].set(cost),
+            grad_inf_norm=self.grad_inf_norm.at[k].set(grad_inf_norm),
+            trust_region=self.trust_region.at[k].set(trust_region),
+            rho=self.rho.at[k].set(rho),
+            accept=self.accept.at[k].set(accept),
+            pcg_iters=self.pcg_iters.at[k].set(pcg_iters),
+        )
+
+
+# Host-side dtypes of the non-float fields (empty concats and fillers
+# must not silently degrade accept/pcg_iters to float64).
+_FIELD_DTYPES = {"accept": np.bool_, "pcg_iters": np.int32}
+
+
+def trace_slice(trace: SolveTrace, n: int) -> SolveTrace:
+    """First n iterations as host numpy (drops the unused tail)."""
+    return SolveTrace(**{
+        f: np.asarray(getattr(trace, f))[:n] for f in TRACE_FIELDS})
+
+
+def trace_filler(n: int) -> SolveTrace:
+    """n iterations of inert history (NaN costs, no accepts, 0 PCG).
+
+    Used when a checkpointed solve resumes a snapshot written before
+    traces existed: the pre-resume iterations are unknowable, but the
+    stitched trace must still line up index-for-index with
+    `LMResult.iterations` so the `[:iterations]` masking contract holds.
+    """
+    return SolveTrace(
+        cost=np.full((n,), np.nan),
+        grad_inf_norm=np.full((n,), np.nan),
+        trust_region=np.full((n,), np.nan),
+        rho=np.full((n,), np.nan),
+        accept=np.zeros((n,), np.bool_),
+        pcg_iters=np.zeros((n,), np.int32),
+    )
+
+
+def trace_concat(parts: Sequence[SolveTrace]) -> SolveTrace:
+    """Concatenate per-chunk traces (host numpy) into one solve history.
+
+    The chunked/checkpointed drivers slice each chunk's trace to the
+    iterations it actually ran and stitch the chunks back together so a
+    resumed solve reports the SAME trace a straight run would.
+    """
+    return SolveTrace(**{
+        f: np.concatenate([np.asarray(getattr(p, f)) for p in parts])
+        if parts else np.zeros((0,), _FIELD_DTYPES.get(f, np.float64))
+        for f in TRACE_FIELDS})
+
+
+def trace_to_dict(trace: SolveTrace, iterations: int) -> Dict[str, List]:
+    """Materialize the first `iterations` entries as plain Python lists.
+
+    This is the ONLY host transfer in the trace pipeline; it runs in
+    telemetry/report code, never inside the solve.
+    """
+    out: Dict[str, List] = {}
+    for f in TRACE_FIELDS:
+        a = np.asarray(getattr(trace, f))[:iterations]
+        out[f] = [bool(x) if a.dtype == np.bool_ else
+                  int(x) if np.issubdtype(a.dtype, np.integer) else float(x)
+                  for x in a]
+    return out

@@ -32,6 +32,25 @@ from megba_tpu.algo.lm import LMResult, lm_solve
 from megba_tpu.common import ProblemOption
 from megba_tpu.core.types import pad_edges
 
+# jax.shard_map graduated from jax.experimental between jax releases;
+# resolve it once here so every solver family rides the same symbol on
+# either side of the move (jaxlib in this image still ships the
+# experimental spelling).
+try:
+    shard_map = jax.shard_map
+    SHARD_MAP_NATIVE = True
+except AttributeError:
+    SHARD_MAP_NATIVE = False
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, **kwargs):
+        # The 0.4.x experimental shard_map has no replication rule for
+        # while_loop, so its check_rep pass rejects the LM solvers
+        # outright; the solvers' outputs are psum-derived replicated
+        # values under out_specs=P() by construction (tested by the
+        # world-1/2/8 parity suite), so the check adds nothing here.
+        return _shard_map_exp(f, check_rep=False, **kwargs)
+
 EDGE_AXIS = "edges"
 
 
@@ -142,11 +161,11 @@ def distributed_lm_solve(
     dtype = cameras.dtype
     ir = option.algo_option.initial_region if initial_region is None else initial_region
     iv = 2.0 if initial_v is None else initial_v
-    from megba_tpu.algo.lm import _next_verbose_token
+    from megba_tpu.observability.emit import next_verbose_token
 
     args = [cameras, points, obs, cam_idx, pt_idx, mask,
             jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
-            jnp.asarray(_next_verbose_token(), jnp.int32)]
+            jnp.asarray(next_verbose_token(), jnp.int32)]
     in_specs = [rep, rep, edge, edge1d, edge1d, edge1d, rep, rep, rep]
     optional = [
         ("sqrt_info", sqrt_info, edge),
@@ -211,10 +230,16 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
             initial_v=init_v, verbose_token=verbose_token,
             **kwargs)
 
-    sharded = jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
+    sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
     # Donate the replicated parameter blocks (same contract as
     # solve._build_single_solve: flat_solve hands over fresh operands).
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    # NOT under the experimental fallback: there, donated inputs aliased
+    # by replicated (out_specs=P()) outputs intermittently surface
+    # freed-buffer garbage in the result (observed as ~1e-310 denormals
+    # in the world>1 parity tests); parameters are the small arrays, so
+    # forgoing donation costs little off the native path.
+    return jax.jit(
+        sharded, donate_argnums=(0, 1) if SHARD_MAP_NATIVE else ())
 
 
 # Global program cache for long-lived engines.  jax.jit caches by callable

@@ -11,6 +11,7 @@ to the solver without building per-edge Python objects.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 from typing import Optional, Tuple, Union
@@ -24,6 +25,7 @@ from megba_tpu.common import ProblemOption, validate_options
 from megba_tpu.core.fm import EDGE_QUANTUM
 from megba_tpu.core.types import is_cam_sorted, pad_edges
 from megba_tpu.io.bal import BALFile, load_bal
+from megba_tpu.observability.emit import next_verbose_token
 from megba_tpu.ops.residuals import make_residual_jacobian_fn
 from megba_tpu.parallel.mesh import (
     distributed_lm_solve,
@@ -31,6 +33,7 @@ from megba_tpu.parallel.mesh import (
     make_mesh,
 )
 from megba_tpu.utils.backend import warn_if_x64_unavailable
+from megba_tpu.utils.timing import PhaseTimer
 
 
 def default_use_tiled(dtype) -> bool:
@@ -98,6 +101,7 @@ def flat_solve(
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
     jit_cache: Optional[dict] = None,
+    timer: Optional[PhaseTimer] = None,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
 
@@ -123,16 +127,36 @@ def flat_solve(
     OFF otherwise (float64 verification and CPU runs keep the chunked
     scatter-add build, whose transient memory is bounded).
     MEGBA_TILED=1/0 force-enables/disables.
+
+    `timer` (utils.timing.PhaseTimer, fresh one by default) accumulates
+    the host-side phase wall clocks (lowering / sort / plan / program /
+    dispatch — "dispatch" includes jit tracing+compilation on the first
+    call of a configuration).  With telemetry enabled
+    (MEGBA_TELEMETRY=<path> or `option.telemetry`) an extra blocking
+    "execute" phase is timed and a SolveReport JSONL line is appended;
+    with it disabled the solve stays fully asynchronous and the sink
+    module is never even imported.
     """
+    # Resolve the telemetry target here (knob wins over env), then strip
+    # the knob: program caches are keyed on `option` and must stay
+    # telemetry-agnostic — turning telemetry on can never recompile.
+    telemetry = option.telemetry or os.environ.get("MEGBA_TELEMETRY") or None
+    report_option = option
+    if option.telemetry is not None:
+        option = dataclasses.replace(option, telemetry=None)
+    timer = PhaseTimer() if timer is None else timer
+
     dtype = np.dtype(option.dtype)
     warn_if_x64_unavailable(dtype)
-    # copy=False: at Final-13682 scale obs alone is ~70MB; don't duplicate
-    # arrays that are already the right dtype.
-    cameras = np.asarray(cameras).astype(dtype, copy=False)
-    points = np.asarray(points).astype(dtype, copy=False)
-    obs = np.asarray(obs).astype(dtype, copy=False)
-    cam_idx = np.asarray(cam_idx)
-    pt_idx = np.asarray(pt_idx)
+    with timer.phase("lowering"):
+        # copy=False: at Final-13682 scale obs alone is ~70MB; don't
+        # duplicate arrays that are already the right dtype.
+        cameras = np.asarray(cameras).astype(dtype, copy=False)
+        points = np.asarray(points).astype(dtype, copy=False)
+        obs = np.asarray(obs).astype(dtype, copy=False)
+        cam_idx = np.asarray(cam_idx)
+        pt_idx = np.asarray(pt_idx)
+    n_edges_raw = int(cam_idx.shape[0])
 
     ws = option.world_size
     if use_tiled is None:
@@ -145,53 +169,57 @@ def flat_solve(
         # streams form the edge axis (equal shard sizes by construction).
         from megba_tpu.ops.segtiles import make_sharded_dual_plans
 
-        perms, masks, cam_segs, plans = make_sharded_dual_plans(
-            cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws)
-        obs = np.concatenate([
-            obs[perms[k]] * masks[k][:, None].astype(dtype)
-            for k in range(ws)])
-        # cam_segs keeps each shard's cam stream non-decreasing (padding
-        # carries the block's running-max camera) so the sorted-scatter
-        # promise downstream stays honest; masked slots contribute zeros.
-        cam_idx_sh = cam_segs.reshape(-1).astype(np.int32)
-        pt_idx_sh = np.concatenate([
-            np.where(masks[k] > 0, pt_idx[perms[k]], 0)
-            for k in range(ws)]).astype(np.int32)
-        if sqrt_info is not None:
-            sqrt_info = np.concatenate(
-                [np.asarray(sqrt_info)[perms[k]] for k in range(ws)])
-        cam_idx, pt_idx = cam_idx_sh, pt_idx_sh
-        mask = masks.reshape(-1).astype(dtype)
-        n_padded = obs.shape[0]
+        with timer.phase("plan"):
+            perms, masks, cam_segs, plans = make_sharded_dual_plans(
+                cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws)
+            obs = np.concatenate([
+                obs[perms[k]] * masks[k][:, None].astype(dtype)
+                for k in range(ws)])
+            # cam_segs keeps each shard's cam stream non-decreasing
+            # (padding carries the block's running-max camera) so the
+            # sorted-scatter promise downstream stays honest; masked
+            # slots contribute zeros.
+            cam_idx_sh = cam_segs.reshape(-1).astype(np.int32)
+            pt_idx_sh = np.concatenate([
+                np.where(masks[k] > 0, pt_idx[perms[k]], 0)
+                for k in range(ws)]).astype(np.int32)
+            if sqrt_info is not None:
+                sqrt_info = np.concatenate(
+                    [np.asarray(sqrt_info)[perms[k]] for k in range(ws)])
+            cam_idx, pt_idx = cam_idx_sh, pt_idx_sh
+            mask = masks.reshape(-1).astype(dtype)
+            n_padded = obs.shape[0]
     elif use_tiled:
         # Tiled lowering: the cam plan's slot order IS the edge axis from
         # here on (it subsumes the camera sort and quantum padding).
         from megba_tpu.ops.segtiles import make_dual_plans
 
-        plan_c, plans = make_dual_plans(
-            cam_idx, pt_idx, cameras.shape[0], points.shape[0])
-        perm, pmask = plan_c.perm, plan_c.mask
-        obs = obs[perm] * pmask[:, None].astype(dtype)
-        cam_idx = plan_c.seg
-        pt_idx = np.where(pmask > 0, pt_idx[perm], 0).astype(np.int32)
-        mask = pmask.astype(dtype)
-        if sqrt_info is not None:
-            sqrt_info = np.asarray(sqrt_info)[perm]
-        n_padded = obs.shape[0]
-    else:
-        if not is_cam_sorted(cam_idx):
-            from megba_tpu.native import sort_edges_by_camera
-
-            perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
-            cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+        with timer.phase("plan"):
+            plan_c, plans = make_dual_plans(
+                cam_idx, pt_idx, cameras.shape[0], points.shape[0])
+            perm, pmask = plan_c.perm, plan_c.mask
+            obs = obs[perm] * pmask[:, None].astype(dtype)
+            cam_idx = plan_c.seg
+            pt_idx = np.where(pmask > 0, pt_idx[perm], 0).astype(np.int32)
+            mask = pmask.astype(dtype)
             if sqrt_info is not None:
                 sqrt_info = np.asarray(sqrt_info)[perm]
+            n_padded = obs.shape[0]
+    else:
+        with timer.phase("sort"):
+            if not is_cam_sorted(cam_idx):
+                from megba_tpu.native import sort_edges_by_camera
 
-        # Pad the edge axis: every shard must be a multiple of
-        # EDGE_QUANTUM so chunk slices and shards are static-shape.
-        obs, cam_idx, pt_idx, mask = pad_edges(
-            obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
-        n_padded = obs.shape[0]
+                perm = sort_edges_by_camera(cam_idx, cameras.shape[0])
+                cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+                if sqrt_info is not None:
+                    sqrt_info = np.asarray(sqrt_info)[perm]
+
+            # Pad the edge axis: every shard must be a multiple of
+            # EDGE_QUANTUM so chunk slices and shards are static-shape.
+            obs, cam_idx, pt_idx, mask = pad_edges(
+                obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
+            n_padded = obs.shape[0]
     if sqrt_info is not None:
         si = np.asarray(sqrt_info).astype(dtype, copy=False)
         if si.shape[0] != n_padded:
@@ -211,39 +239,74 @@ def flat_solve(
     # once on call — and the multi-process path builds global arrays
     # straight from host memory (a premature jnp.asarray would cost a
     # device->host->device round trip per operand there).
-    cameras_fm = np.ascontiguousarray(cameras.T)
-    points_fm = np.ascontiguousarray(points.T)
-    obs_fm = np.ascontiguousarray(obs.T)
+    with timer.phase("lowering"):
+        cameras_fm = np.ascontiguousarray(cameras.T)
+        points_fm = np.ascontiguousarray(points.T)
+        obs_fm = np.ascontiguousarray(obs.T)
+
+    problem_shape = {
+        "num_cameras": int(cameras.shape[0]),
+        "num_points": int(points.shape[0]),
+        "num_edges": n_edges_raw,
+        "num_edges_padded": int(n_padded),
+        "world_size": ws,
+    }
 
     if ws > 1:
         mesh = make_mesh(ws)
-        result = distributed_lm_solve(
-            residual_jac_fn, cameras_fm, points_fm,
-            obs_fm, np.asarray(cam_idx), np.asarray(pt_idx),
-            np.asarray(mask), option, mesh,
-            sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j, pt_fixed=pt_fixed_j,
-            verbose=verbose, cam_sorted=True, plans=plans,
-            initial_region=initial_region, initial_v=initial_v,
-            jit_cache=jit_cache)
-        return _result_to_edge_major(result)
+        with timer.phase("dispatch"):
+            result = distributed_lm_solve(
+                residual_jac_fn, cameras_fm, points_fm,
+                obs_fm, np.asarray(cam_idx), np.asarray(pt_idx),
+                np.asarray(mask), option, mesh,
+                sqrt_info=sqrt_info_j, cam_fixed=cam_fixed_j,
+                pt_fixed=pt_fixed_j,
+                verbose=verbose, cam_sorted=True, plans=plans,
+                initial_region=initial_region, initial_v=initial_v,
+                jit_cache=jit_cache)
+        result = _result_to_edge_major(result)
+        _maybe_emit_report(telemetry, report_option, result, timer,
+                           problem_shape)
+        return result
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
                 ("pt_fixed", pt_fixed_j)]
     keys = tuple(k for k, v in optional if v is not None)
     extras = [v for _, v in optional if v is not None]
-    jitted = get_or_build_program(
-        jit_cache, _cached_single_solve, _build_single_solve,
-        residual_jac_fn, option, keys, verbose, True)
+    with timer.phase("program"):
+        jitted = get_or_build_program(
+            jit_cache, _cached_single_solve, _build_single_solve,
+            residual_jac_fn, option, keys, verbose, True)
     ir = option.algo_option.initial_region if initial_region is None else initial_region
     iv = 2.0 if initial_v is None else initial_v
-    from megba_tpu.algo.lm import _next_verbose_token
 
-    result = jitted(
-        cameras_fm, points_fm, obs_fm,
-        jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
-        jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
-        jnp.asarray(_next_verbose_token(), jnp.int32), plans, *extras)
-    return _result_to_edge_major(result)
+    with timer.phase("dispatch"):
+        result = jitted(
+            cameras_fm, points_fm, obs_fm,
+            jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
+            jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
+            jnp.asarray(next_verbose_token(), jnp.int32), plans, *extras)
+    result = _result_to_edge_major(result)
+    _maybe_emit_report(telemetry, report_option, result, timer,
+                       problem_shape)
+    return result
+
+
+def _maybe_emit_report(telemetry, option, result, timer, problem) -> None:
+    """Append a SolveReport JSONL line when telemetry is on; no-op (no
+    sink import, no device sync) when it is off."""
+    if not telemetry:
+        return
+    # The report wants final scalars + the trace anyway, so the blocking
+    # "execute" phase is honest accounting, not added overhead.
+    with timer.phase("execute") as ph:
+        ph.sync(result)
+    if jax.process_index() != 0:
+        return  # one report line per solve, not one per host
+    from megba_tpu.observability.report import append_report, build_report
+
+    append_report(
+        build_report(option, result, timer.as_dict(), problem), telemetry)
 
 
 def _result_to_edge_major(result: LMResult) -> LMResult:
@@ -275,15 +338,14 @@ def solve_bal(
 
     if verbose:
         from megba_tpu.native import degree_stats
+        from megba_tpu.observability.emit import emit_problem_stats
 
         _, _, (max_cd, max_pd, nnz) = degree_stats(
             bal.cam_idx, bal.pt_idx, bal.num_cameras, bal.num_points)
-        print(
-            f"problem: {bal.num_cameras} cameras, {bal.num_points} points, "
-            f"{bal.num_observations} observations | max camera degree "
-            f"{max_cd}, max point degree {max_pd}, Hpl blocks "
-            f"{nnz if nnz >= 0 else 'n/a (edges unsorted)'}",
-            flush=True)
+        # Shared emitter (observability/emit.py): the same formatter the
+        # telemetry pipeline documents, so stdout and reports can't drift.
+        emit_problem_stats(bal.num_cameras, bal.num_points,
+                           bal.num_observations, max_cd, max_pd, nnz)
 
     f = make_residual_jacobian_fn(mode=option.jacobian_mode)
     result = flat_solve(

@@ -253,6 +253,9 @@ def make_coupling_matvecs(
     return hpl, hlp
 
 
+# named_scope: the PCG while_loop (body traced inside this call) carries
+# a navigable label in profiler traces — see observability/__init__.py.
+@jax.named_scope("megba.pcg_core")
 def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative):
     """Preconditioned CG over an arbitrary pytree "vector".
 
@@ -379,6 +382,7 @@ def plain_pcg_solve(
     return PCGResult(dx_cam=xc, dx_pt=xp, iterations=k, rho=rho)
 
 
+@jax.named_scope("megba.schur_diag_precond")
 def _schur_diag_precond(
     Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
     compute_kind, axis_name, cam_sorted, plans=None,

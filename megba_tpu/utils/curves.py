@@ -1,7 +1,8 @@
 """Capture and parse the LM solver's verbose per-iteration lines.
 
-The per-iteration `iter k: cost ...` line (algo/lm.py:_emit_verbose_line
-— the reference's observable, lm_algo.cu:149-162) is the source of the
+The per-iteration `iter k: cost ...` line
+(observability/emit.py:_emit_verbose_line — the reference's observable,
+lm_algo.cu:149-162) is the source of the
 cost-curve evidence artifacts (DOUBLE_PARITY.json, MIXED_PRECISION.json).
 One shared parser keeps those scripts in lockstep with the emit format:
 a format drift raises here instead of silently producing empty curves
@@ -29,8 +30,8 @@ def parse_verbose_curve(text: str, require: bool = True) -> list[dict]:
     if require and not curve:
         raise ValueError(
             "no verbose iteration lines matched — did the solver's "
-            "verbose format (algo/lm.py:_emit_verbose_line) change "
-            "without updating utils/curves._LINE?")
+            "verbose format (observability/emit.py:_emit_verbose_line) "
+            "change without updating utils/curves._LINE?")
     return curve
 
 

@@ -28,9 +28,9 @@ def single_solve_memory_analysis(s, option, residual_jac_fn,
     """
     import jax.numpy as jnp
 
-    from megba_tpu.algo.lm import _next_verbose_token
     from megba_tpu.core.types import pad_edges
     from megba_tpu.native import sort_edges_by_camera
+    from megba_tpu.observability.emit import next_verbose_token
     from megba_tpu.solve import EDGE_QUANTUM, _build_single_solve
 
     dtype = np.dtype(option.dtype)
@@ -46,7 +46,7 @@ def single_solve_memory_analysis(s, option, residual_jac_fn,
         jnp.asarray(np.ascontiguousarray(obs.T)),
         jnp.asarray(ci), jnp.asarray(pi), jnp.asarray(mask),
         jnp.asarray(1e3, dtype), jnp.asarray(2.0, dtype),
-        jnp.asarray(_next_verbose_token(), jnp.int32), None)
+        jnp.asarray(next_verbose_token(), jnp.int32), None)
     ma = jitted.lower(*args).compile().memory_analysis()
     out: dict = {"n_edges_padded": int(obs.shape[0])}
     if ma is None:
@@ -63,3 +63,30 @@ def single_solve_memory_analysis(s, option, residual_jac_fn,
         + out.get("temp_size_in_bytes", 0)
         - out.get("alias_size_in_bytes", 0))
     return out
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """Live allocator stats of one device, or None when unavailable.
+
+    TPU/GPU backends expose `Device.memory_stats()` (bytes_in_use,
+    peak_bytes_in_use, bytes_limit, ...); XLA:CPU does not — telemetry
+    (observability/report.py) records whatever the backend offers and
+    omits the section otherwise, so reports stay backend-portable.
+    Unlike `single_solve_memory_analysis` this costs no compilation: it
+    reads counters, so it is cheap enough for the per-solve report path.
+    """
+    import jax
+
+    if device is None:
+        local = jax.local_devices()
+        if not local:
+            return None
+        device = local[0]
+    try:
+        stats = device.memory_stats()
+    except (AttributeError, RuntimeError, NotImplementedError):
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, np.integer))}

@@ -46,21 +46,41 @@ class PhaseTimer:
     @contextlib.contextmanager
     def phase(self, name: str):
         handle = _Phase()
-        t0 = time.perf_counter()
-        try:
-            yield handle
-        finally:
-            for t in handle._targets:
-                jax.block_until_ready(t)
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+        # TraceAnnotation: each phase shows up as a named host-side span
+        # in jax.profiler traces (trace_profile -> TensorBoard/Perfetto),
+        # so the phase breakdown and the profiler timeline line up.
+        with jax.profiler.TraceAnnotation(f"megba.phase.{name}"):
+            t0 = time.perf_counter()
+            try:
+                yield handle
+            finally:
+                for t in handle._targets:
+                    jax.block_until_ready(t)
+                dt = time.perf_counter() - t0
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+    def as_dict(self) -> Dict[str, Dict[str, float]]:
+        """{name: {total_s, calls}} — the SolveReport `phases` payload."""
+        return {name: {"total_s": self.totals[name],
+                       "calls": self.counts[name]}
+                for name in self.totals}
+
+    def reset(self) -> None:
+        """Drop all accumulated phases (reuse one timer across solves)."""
+        self.totals.clear()
+        self.counts.clear()
 
     def report(self) -> str:
+        if not self.totals:
+            return "no phases recorded"
         lines = []
         for name in sorted(self.totals, key=self.totals.get, reverse=True):
             t, c = self.totals[name], self.counts[name]
             lines.append(f"{name}: {t * 1e3:.1f} ms total / {c} calls = {t / c * 1e3:.2f} ms")
+        total = sum(self.totals.values())
+        lines.append(
+            f"total: {total * 1e3:.1f} ms over {len(self.totals)} phases")
         return "\n".join(lines)
 
 

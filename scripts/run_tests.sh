@@ -15,3 +15,29 @@ SECOND=$(ls tests/test_[p-z]*.py)
 
 python -m pytest $FIRST -q -p no:cacheprovider "$@"
 python -m pytest $SECOND -q -p no:cacheprovider "$@"
+
+# Observability smoke: a tiny telemetry-on solve must produce a JSONL
+# SolveReport that the summarize CLI can render (the end-to-end contract
+# of megba_tpu/observability/, beyond what the unit tests pin).
+SMOKE=$(mktemp /tmp/megba_obs_smoke.XXXXXX.jsonl)
+trap 'rm -f "$SMOKE"' EXIT
+JAX_PLATFORMS=cpu MEGBA_TELEMETRY="$SMOKE" python - <<'PY'
+import numpy as np
+
+from megba_tpu.common import AlgoOption, JacobianMode, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.solve import flat_solve
+
+s = make_synthetic_bal(num_cameras=4, num_points=24, obs_per_point=3,
+                       seed=0, param_noise=4e-2, pixel_noise=0.3,
+                       dtype=np.float32)
+option = ProblemOption(dtype=np.float32,
+                       algo_option=AlgoOption(max_iter=3),
+                       solver_option=SolverOption(max_iter=8, tol=1e-8))
+res = flat_solve(make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF),
+                 s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option)
+assert res.trace is not None and int(res.iterations) > 0
+PY
+JAX_PLATFORMS=cpu python -m megba_tpu.observability.summarize "$SMOKE" | grep -q "phases:"
+echo "observability smoke OK"

@@ -1,0 +1,389 @@
+"""Observability subsystem tests (megba_tpu/observability/).
+
+Pins the contracts ISSUE 1 introduces: the on-device SolveTrace agrees
+with the verbose-callback observables (single-device, sharded, and
+checkpointed), SolveReport JSON round-trips, the telemetry sink is a
+strict no-op when disabled, the summarize CLI renders recorded reports,
+and the verbose-clock table evicts by last touch (not insertion order).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.common import (
+    AlgoOption,
+    JacobianMode,
+    ProblemOption,
+    SolverOption,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.solve import flat_solve
+from megba_tpu.utils.curves import run_with_curve
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(seed=0, max_iter=6):
+    s = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                           seed=seed, param_noise=4e-2, pixel_noise=0.3)
+    option = ProblemOption(
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-9,
+                               epsilon2=1e-12),
+        solver_option=SolverOption(max_iter=40, tol=1e-12,
+                                   refuse_ratio=1e30))
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    return s, option, f
+
+
+def _solve(s, option, f, verbose=False):
+    return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                      s.pt_idx, option, verbose=verbose)
+
+
+def _assert_trace_matches_curve(res, curve):
+    k = int(res.iterations)
+    assert len(curve) == k
+    cost = np.asarray(res.trace.cost)
+    accept = np.asarray(res.trace.accept)
+    pcg = np.asarray(res.trace.pcg_iters)
+    assert cost.shape[0] >= k  # fixed-size buffer, masked by k
+    for entry in curve:
+        i = entry["iter"]
+        # The verbose line prints %.6e — compare at that precision.
+        np.testing.assert_allclose(cost[i], entry["cost"], rtol=2e-6)
+        assert bool(accept[i]) == entry["accept"]
+        assert int(pcg[i]) == entry["pcg_iters"]
+
+
+def test_trace_matches_verbose_single_device():
+    s, option, f = _setup()
+    res, curve = run_with_curve(lambda: _solve(s, option, f, verbose=True))
+    assert int(res.iterations) > 0
+    _assert_trace_matches_curve(res, curve)
+
+
+@pytest.mark.slow
+def test_trace_matches_verbose_world2():
+    # Same contract through shard_map on a 2-device CPU mesh: every
+    # recorded value is replicated, so the trace rides out_specs=P().
+    # slow: compiles a dedicated sharded verbose program; the fast lane
+    # covers sharded solves via test_sharding and trace parity via the
+    # single-device test above.
+    s, option, f = _setup(seed=1)
+    import dataclasses
+
+    option2 = dataclasses.replace(option, world_size=2)
+    res, curve = run_with_curve(lambda: _solve(s, option2, f, verbose=True))
+    assert int(res.iterations) > 0
+    _assert_trace_matches_curve(res, curve)
+
+
+def test_trace_checkpointed_matches_straight_run(tmp_path):
+    from megba_tpu.algo import solve_checkpointed
+
+    s, option, f = _setup(seed=2, max_iter=9)
+    straight = _solve(s, option, f)
+    chunked = solve_checkpointed(
+        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+        checkpoint_path=str(tmp_path / "ck.npz"), checkpoint_every=3)
+    k = int(chunked.iterations)
+    assert k == int(straight.iterations)
+    # Chunks stitched back together must reproduce the straight-run
+    # trajectory (trust-region state carries exactly across chunks).
+    np.testing.assert_allclose(
+        np.asarray(chunked.trace.cost)[:k],
+        np.asarray(straight.trace.cost)[:k], rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(chunked.trace.accept)[:k],
+        np.asarray(straight.trace.accept)[:k])
+
+
+@pytest.mark.slow
+def test_trace_survives_checkpoint_resume(tmp_path):
+    # slow: compiles two extra chunk-length program variants on top of
+    # the chunked-stitching test above.
+    from megba_tpu.algo import solve_checkpointed
+
+    s, option, f = _setup(seed=3, max_iter=8)
+    ck = str(tmp_path / "ck.npz")
+    import dataclasses
+
+    short = dataclasses.replace(
+        option, algo_option=dataclasses.replace(option.algo_option,
+                                                max_iter=4))
+    solve_checkpointed(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                       s.pt_idx, short, checkpoint_path=ck,
+                       checkpoint_every=2)
+    resumed = solve_checkpointed(f, s.cameras0, s.points0, s.obs,
+                                 s.cam_idx, s.pt_idx, option,
+                                 checkpoint_path=ck, checkpoint_every=2)
+    k = int(resumed.iterations)
+    # The resumed result's trace covers the WHOLE solve, including the
+    # iterations that ran before the (simulated) preemption.
+    assert np.asarray(resumed.trace.cost).shape[0] == k
+    straight = _solve(s, option, f)
+    np.testing.assert_allclose(
+        np.asarray(resumed.trace.cost)[:k],
+        np.asarray(straight.trace.cost)[:k], rtol=1e-6)
+
+
+def test_trace_aligned_after_pretrace_snapshot_resume(tmp_path):
+    # A snapshot written BEFORE traces existed has no extra_trace_* keys;
+    # resume must pad the unknowable pre-resume iterations with inert NaN
+    # history so the [:iterations] masking contract still holds.
+    from megba_tpu.algo import solve_checkpointed
+    from megba_tpu.utils.checkpoint import load_state, save_state
+
+    s, option, f = _setup(seed=7, max_iter=8)
+    ck = str(tmp_path / "ck.npz")
+    import dataclasses
+
+    short = dataclasses.replace(
+        option, algo_option=dataclasses.replace(option.algo_option,
+                                                max_iter=4))
+    solve_checkpointed(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                       s.pt_idx, short, checkpoint_path=ck,
+                       checkpoint_every=4)
+    # Rewrite the snapshot as a pre-trace version would have.
+    st = load_state(ck)
+    save_state(ck, st["cameras"], st["points"], region=float(st["region"]),
+               cost=float(st["cost"]), iteration=int(st["iteration"]),
+               extra={k[len("extra_"):]: v for k, v in st.items()
+                      if k.startswith("extra_")
+                      and not k.startswith("extra_trace_")})
+    resumed = solve_checkpointed(f, s.cameras0, s.points0, s.obs,
+                                 s.cam_idx, s.pt_idx, option,
+                                 checkpoint_path=ck, checkpoint_every=4)
+    k = int(resumed.iterations)
+    cost = np.asarray(resumed.trace.cost)
+    assert cost.shape[0] == k  # aligned, not short
+    assert np.all(np.isnan(cost[:4]))  # pre-resume filler
+    assert np.all(np.isfinite(cost[4:k]))  # post-resume history is real
+    assert np.asarray(resumed.trace.accept).dtype == np.bool_
+    assert np.asarray(resumed.trace.pcg_iters).dtype == np.int32
+
+
+def test_pgo_telemetry_knob_is_inert(tmp_path, monkeypatch):
+    # The PGO family emits no reports yet; the host-only knob must
+    # neither crash nor fragment _pgo_program's lru cache (the stripped
+    # option is what reaches the cached program builder).
+    monkeypatch.delenv("MEGBA_TELEMETRY", raising=False)
+    from megba_tpu.models.pgo import (
+        _pgo_program,
+        make_synthetic_pose_graph,
+        solve_pgo,
+    )
+
+    g = make_synthetic_pose_graph(num_poses=8, loop_closures=2, seed=0)
+    option = ProblemOption(
+        algo_option=AlgoOption(max_iter=3),
+        solver_option=SolverOption(max_iter=10))
+    import dataclasses
+
+    res_plain = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option)
+    misses0 = _pgo_program.cache_info().misses
+    res_knob = solve_pgo(
+        g.poses0, g.edge_i, g.edge_j, g.meas,
+        dataclasses.replace(option, telemetry=str(tmp_path / "x.jsonl")))
+    assert _pgo_program.cache_info().misses == misses0  # no recompile
+    np.testing.assert_allclose(float(res_knob.cost), float(res_plain.cost),
+                               rtol=1e-12)
+    assert not (tmp_path / "x.jsonl").exists()
+
+
+def test_trace_adds_no_host_callbacks():
+    # Acceptance guard: verbose-off programs must stay callback-free —
+    # the trace is pure on-device ops, no debug.callback smuggled in.
+    from megba_tpu.solve import _build_single_solve
+
+    s, option, f = _setup()
+    from megba_tpu.core.fm import EDGE_QUANTUM
+    from megba_tpu.core.types import pad_edges
+
+    obs, ci, pi, mask = pad_edges(s.obs, s.cam_idx, s.pt_idx, EDGE_QUANTUM,
+                                  dtype=np.float64)
+    jitted = _build_single_solve(f, option, (), False, True)
+    txt = jitted.lower(
+        jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T),
+        jnp.asarray(np.ascontiguousarray(obs.T)), jnp.asarray(ci),
+        jnp.asarray(pi), jnp.asarray(mask), jnp.asarray(1e3, jnp.float64),
+        jnp.asarray(2.0, jnp.float64), jnp.asarray(1, jnp.int32),
+        None).as_text()
+    assert "callback" not in txt.lower()
+
+
+def test_report_json_roundtrip():
+    from megba_tpu.observability.report import SolveReport
+
+    rep = SolveReport(
+        problem={"num_cameras": 6, "num_points": 40, "num_edges": 160},
+        config={"dtype": "float64", "world_size": 1},
+        backend={"backend": "cpu", "device_count": 8},
+        phases={"dispatch": {"total_s": 1.25, "calls": 1}},
+        result={"initial_cost": 10.0, "final_cost": 1.0, "iterations": 3},
+        trace={"cost": [5.0, 2.0, 1.0], "accept": [True, True, True]},
+        memory=None,
+        created_unix=123.5,
+    )
+    rep2 = SolveReport.from_json(rep.to_json())
+    assert rep2 == rep
+    # JSONL framing: one line, valid JSON.
+    assert "\n" not in rep.to_json()
+    assert json.loads(rep.to_json())["schema"] == rep.schema
+
+
+def test_config_to_dict_serializes_options():
+    from megba_tpu.observability.report import config_to_dict
+
+    cfg = config_to_dict(ProblemOption())
+    assert cfg["dtype"] == "float64"
+    assert cfg["compute_kind"] == "IMPLICIT"
+    assert cfg["jacobian_mode"] == "AUTODIFF"
+    assert cfg["robust_kind"] == "NONE"
+    assert cfg["solver_option"]["max_iter"] == 100
+    assert cfg["algo_option"]["initial_region"] == 1e3
+    json.dumps(cfg)  # must be plain JSON types all the way down
+
+
+def test_telemetry_emits_report_matching_trace(tmp_path, monkeypatch):
+    sink = tmp_path / "telemetry.jsonl"
+    monkeypatch.setenv("MEGBA_TELEMETRY", str(sink))
+    s, option, f = _setup(seed=4)
+    res = _solve(s, option, f)
+    assert sink.exists()
+    lines = [ln for ln in sink.read_text().splitlines() if ln.strip()]
+    assert len(lines) == 1
+    from megba_tpu.observability.report import SolveReport
+
+    rep = SolveReport.from_json(lines[0])
+    k = int(res.iterations)
+    assert rep.result["iterations"] == k
+    np.testing.assert_allclose(
+        rep.trace["cost"], np.asarray(res.trace.cost)[:k], rtol=1e-12)
+    assert rep.trace["accept"] == [
+        bool(a) for a in np.asarray(res.trace.accept)[:k]]
+    assert rep.problem["num_cameras"] == 6
+    assert rep.config["dtype"] == "float64"
+    # The wired flat_solve phases are all present.
+    assert "dispatch" in rep.phases and "execute" in rep.phases
+    assert rep.phases["dispatch"]["total_s"] > 0
+
+
+def test_telemetry_knob_on_problem_option(tmp_path, monkeypatch):
+    monkeypatch.delenv("MEGBA_TELEMETRY", raising=False)
+    sink = tmp_path / "knob.jsonl"
+    s, option, f = _setup(seed=5)
+    import dataclasses
+
+    res = _solve(s, dataclasses.replace(option, telemetry=str(sink)), f)
+    assert sink.exists() and int(res.iterations) > 0
+
+
+def test_summarize_cli_renders_report(tmp_path, monkeypatch, capsys):
+    sink = tmp_path / "telemetry.jsonl"
+    monkeypatch.setenv("MEGBA_TELEMETRY", str(sink))
+    s, option, f = _setup(seed=6)
+    _solve(s, option, f)
+    from megba_tpu.observability import summarize
+
+    assert summarize.main([str(sink)]) == 0
+    out = capsys.readouterr().out
+    assert "1 report(s)" in out
+    assert "iter  cost" in out  # convergence table
+    assert "phases:" in out and "dispatch" in out
+    assert "result: cost" in out
+
+
+@pytest.mark.slow
+def test_telemetry_off_is_strict_noop(tmp_path):
+    # slow: cold-interpreter subprocess (full jax import + compile).
+    # Subprocess: a fresh interpreter proves the sink module is never
+    # imported (and no file is written) on the telemetry-off path —
+    # in-process the other tests here would have imported it already.
+    code = """
+import os, sys
+import numpy as np
+from megba_tpu.common import AlgoOption, JacobianMode, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.solve import flat_solve
+s = make_synthetic_bal(num_cameras=4, num_points=20, obs_per_point=3,
+                       seed=0, dtype=np.float32)
+option = ProblemOption(dtype=np.float32,
+                       algo_option=AlgoOption(max_iter=2),
+                       solver_option=SolverOption(max_iter=5))
+res = flat_solve(make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF),
+                 s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option)
+assert res.trace is not None
+assert "megba_tpu.observability.report" not in sys.modules, "sink imported"
+assert "megba_tpu.observability.summarize" not in sys.modules, "CLI imported"
+print("NOOP_OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("MEGBA_TELEMETRY", None)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env,
+                          cwd=str(tmp_path), timeout=600)
+    assert proc.returncode == 0, proc.stderr
+    assert "NOOP_OK" in proc.stdout
+    assert list(tmp_path.glob("*.jsonl")) == []  # nothing written
+
+
+def test_verbose_clock_evicts_by_last_touch(capsys):
+    # Regression (ISSUE 1 satellite): a long-running solve that keeps
+    # emitting lines must never lose its clock to a burst of >64 new
+    # solves.  The old oldest-INSERTED eviction dropped exactly the
+    # longest-lived (first-inserted) clock; last-touch keeps it.
+    from megba_tpu.observability import emit
+
+    saved = dict(emit._VERBOSE_CLOCKS)
+    try:
+        emit._VERBOSE_CLOCKS.clear()
+        emit._emit_verbose_line(1, 0, 1.0, True, 3)  # long solve starts
+        t0 = emit._VERBOSE_CLOCKS[1][0]
+        for i in range(2 * emit._MAX_CLOCKS):
+            emit._emit_verbose_line(1000 + i, 0, 1.0, True, 1)  # burst
+            emit._emit_verbose_line(1, i + 1, 0.5, True, 1)  # still live
+        assert 1 in emit._VERBOSE_CLOCKS, "live solve's clock evicted"
+        assert emit._VERBOSE_CLOCKS[1][0] == t0, "clock restarted"
+        assert len(emit._VERBOSE_CLOCKS) <= emit._MAX_CLOCKS + 1
+    finally:
+        emit._VERBOSE_CLOCKS.clear()
+        emit._VERBOSE_CLOCKS.update(saved)
+        capsys.readouterr()
+
+
+def test_emit_problem_stats_format(capsys):
+    from megba_tpu.observability.emit import emit_problem_stats
+
+    emit_problem_stats(49, 7776, 31843, 12, 9, 1234)
+    out = capsys.readouterr().out
+    assert "problem: 49 cameras, 7776 points, 31843 observations" in out
+    assert "Hpl blocks 1234" in out
+    emit_problem_stats(1, 2, 3, 4, 5, -1)
+    assert "n/a (edges unsorted)" in capsys.readouterr().out
+
+
+def test_trace_to_dict_masks_tail():
+    from megba_tpu.observability.trace import SolveTrace, trace_to_dict
+
+    tr = SolveTrace.empty(5, jnp.float64)
+    tr = tr.record(0, cost=2.0, grad_inf_norm=1.0, trust_region=1e3,
+                   rho=0.5, accept=True, pcg_iters=7)
+    tr = tr.record(1, cost=1.0, grad_inf_norm=0.5, trust_region=3e3,
+                   rho=0.9, accept=False, pcg_iters=3)
+    d = trace_to_dict(tr, 2)
+    assert d["cost"] == [2.0, 1.0]
+    assert d["accept"] == [True, False]
+    assert d["pcg_iters"] == [7, 3]
+    assert all(len(v) == 2 for v in d.values())
+    json.dumps(d)  # plain Python scalars only

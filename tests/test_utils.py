@@ -70,6 +70,24 @@ def test_phase_timer():
     assert "a:" in t.report()
 
 
+def test_phase_timer_as_dict_reset_and_totals():
+    t = PhaseTimer()
+    assert t.report() == "no phases recorded"  # sensible empty report
+    assert t.as_dict() == {}
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    d = t.as_dict()
+    assert d["a"]["calls"] == 2 and d["a"]["total_s"] >= 0.0
+    import json
+
+    json.dumps(d)  # the SolveReport `phases` payload must be plain JSON
+    assert "total:" in t.report()  # total line present
+    t.reset()
+    assert t.as_dict() == {} and t.report() == "no phases recorded"
+
+
 def test_trace_profile_noop():
     with trace_profile(None):
         pass
