@@ -25,6 +25,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from megba_tpu.analysis.retrace import note_trace, static_key
 from megba_tpu.common import ComputeKind, ProblemOption
 from megba_tpu.linear_system.builder import (
     SchurSystem,
@@ -108,6 +109,13 @@ def lm_solve(
     order so both Hessian sides and both coupling products reduce over
     sorted block-aligned segments.
     """
+    # Retrace sentinel (analysis/retrace.py): note_trace counts only
+    # under an active jax trace (eager lm_solve calls are not
+    # compilations), so the count equals the number of LM-program
+    # compilations for this configuration+signature.
+    note_trace("algo.lm_solve", cameras, points, obs, cam_idx, pt_idx,
+               static=static_key(residual_jac_fn, option, axis_name,
+                                 verbose, cam_sorted))
     num_cameras = cameras.shape[1]
     num_points = points.shape[1]
     algo_opt = option.algo_option

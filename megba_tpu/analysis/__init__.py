@@ -1,0 +1,47 @@
+"""Static + runtime correctness tooling for the jitted solver contract.
+
+MegBA's value proposition is that every hot path stays inside one fused
+device program; nothing about that is enforced by the language.  This
+package is the enforcement layer:
+
+- `analysis.lint` — zero-dependency AST linter with repo-specific rules
+  (host callbacks confined to the observability layer, no host numpy /
+  Python coercions reachable from a jitted entry point, explicit dtypes
+  on jnp constructors, no strongly-typed scalar promotion, donated
+  buffers never reused).  `python -m megba_tpu.analysis.lint megba_tpu/`.
+- `analysis.retrace` — runtime retrace sentinel: counts jit traces per
+  (site, signature) at the solver entry points and fails tests that
+  trigger unexpected recompiles.
+- `analysis.strict_dtype` — the sanitizer lane: a small end-to-end solve
+  under `jax_numpy_dtype_promotion=strict` + `jax_debug_nans`.
+
+Suppress a single lint finding with an inline `# megba: allow-<rule>`
+pragma on the flagged line; mark a function that is only ever called
+from inside a jitted computation (so the call graph cannot see it) with
+`# megba: jit-entry` on its `def` line.  See ARCHITECTURE.md "Analysis
+layer".
+
+Submodules are loaded lazily: `python -m megba_tpu.analysis.lint` must
+not re-import the module it is executing (runpy warns), and the solver's
+retrace hooks must not drag the linter in on the production import path.
+"""
+
+_EXPORTS = {
+    "lint_paths": "lint", "run_lint": "lint",
+    "RetraceError": "retrace", "RetraceSentinel": "retrace",
+    "note_trace": "retrace", "sentinel": "retrace", "traced": "retrace",
+    "strict_promotion": "strict_dtype",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"megba_tpu.analysis.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module 'megba_tpu.analysis' has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,200 @@
+"""Runtime retrace sentinel: count jit traces per (site, signature).
+
+Every `jax.jit` cache miss re-invokes the wrapped Python callable to
+trace it (and each trace is followed by an XLA compile), so counting
+executions of the Python function body counts compilations exactly —
+no private jax APIs, no monitoring hooks, zero cost once compiled.
+
+The solver's jitted entry points (solve.py `_build_single_solve`,
+parallel/mesh.py `_build_sharded_solve`, models/pgo.py `_pgo_program`)
+wrap their to-be-jitted functions with `traced(site, fn, static=...)`;
+the inner hot functions (algo/lm.py `lm_solve`, solver/pcg.py solves)
+call `note_trace(site, args...)` directly — they only ever execute at
+trace time, so the counter increments exactly once per compilation.
+
+`sentinel()` wraps a window (a test, a benchmark phase) and fails it on:
+
+- a *duplicate* trace: the same (site, static config, operand signature)
+  traced a second time — a jit cache bust (typically a program rebuilt
+  around a fresh closure per call, the classic silent-retrace bug);
+- more new compilations than `max_compiles` allows (shape-unstable call
+  patterns: every call a new signature, every call a compile).
+
+The pytest fixture `retrace_sentinel` (tests/conftest.py) exposes this
+per test: request it and the test fails on any unexpected recompile.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+_LOCK = threading.Lock()
+# (site, static, signature) -> trace count, process lifetime
+_COUNTS: Dict[Tuple[str, str, str], int] = {}
+
+
+class RetraceError(AssertionError):
+    """An unexpected jit retrace (cache bust or shape instability)."""
+
+
+def _describe(x) -> str:
+    """Stable abstract-value description of one operand (shape/dtype,
+    never values — tracers have no values at trace time)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None and dtype is None:
+        if x is None or isinstance(x, (bool, int, float, str)):
+            return repr(x)
+        return type(x).__name__
+    return f"{dtype}{list(shape) if shape is not None else ''}"
+
+
+def signature_of(args, kwargs=None) -> str:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs or {}))
+    return ",".join(_describe(leaf) for leaf in leaves)
+
+
+def note_trace(site: str, *args, static: str = "",
+               force: bool = False) -> None:
+    """Record one trace of `site` with the given operands.
+
+    Counts ONLY while jax is actually tracing: the instrumented solver
+    layers (lm_solve, the PCG solves) are also supported as plain eager
+    calls, and an eager execution is not a compilation — without this
+    guard two identical eager calls would read as a duplicate-signature
+    cache bust.  `force=True` bypasses the guard (tests exercising the
+    sentinel machinery without a real trace).
+    """
+    if not force:
+        import jax
+
+        try:
+            if jax.core.trace_state_clean():
+                return  # eager execution, not a compilation
+        except AttributeError:  # API moved; fail open (count anyway)
+            pass
+    key = (site, static, signature_of(args, {}))
+    with _LOCK:
+        _COUNTS[key] = _COUNTS.get(key, 0) + 1
+
+
+def static_key(*parts) -> str:
+    """Compact stable string for a jit program's static configuration.
+
+    Callables contribute their qualname (NOT their identity): two
+    closures of the same factory with identical config and operand
+    signature produce the SAME key, so a program needlessly rebuilt
+    around a fresh closure per call shows up as a duplicate trace —
+    the classic silent-retrace bug this sentinel exists to catch.
+    """
+    out = []
+    for p in parts:
+        if callable(p):
+            out.append(getattr(p, "__qualname__", None)
+                       or type(p).__name__)
+        else:
+            out.append(repr(p))
+    return "|".join(out)
+
+
+def traced(site: str, fn, static: str = ""):
+    """Wrap a to-be-jitted callable so every trace is counted.
+
+    The wrapper is transparent to jit (plain *args/**kwargs passthrough,
+    donate_argnums keeps working positionally) and adds zero runtime
+    cost: it only executes on cache miss.
+    """
+
+    def wrapper(*args, **kwargs):
+        note_trace(site, *args, *kwargs.values(), static=static)
+        return fn(*args, **kwargs)
+
+    wrapper.__name__ = getattr(fn, "__name__", "fn")
+    wrapper.__qualname__ = getattr(fn, "__qualname__", wrapper.__name__)
+    return wrapper
+
+
+def snapshot() -> Dict[Tuple[str, str, str], int]:
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+class RetraceSentinel:
+    """Context manager guarding a window against unexpected recompiles."""
+
+    def __init__(self, max_compiles: Optional[int] = None) -> None:
+        self.max_compiles = max_compiles
+        self._allowed_duplicates = 0
+        self._allowed_extra = 0
+        self._base: Dict[Tuple[str, str, str], int] = {}
+
+    # -- in-window adjustments -----------------------------------------
+    def allow(self, duplicates: int = 0, extra_compiles: int = 0) -> None:
+        """Raise the window's tolerance (e.g. a test that legitimately
+        rebuilds an identical program around a fresh per-problem
+        closure)."""
+        self._allowed_duplicates += duplicates
+        self._allowed_extra += extra_compiles
+
+    # -- observations --------------------------------------------------
+    def new_compiles(self) -> Dict[Tuple[str, str, str], int]:
+        """(site, static, signature) -> traces since the window opened."""
+        now = snapshot()
+        return {k: v - self._base.get(k, 0)
+                for k, v in now.items() if v > self._base.get(k, 0)}
+
+    def total_new(self) -> int:
+        return sum(self.new_compiles().values())
+
+    def duplicates(self):
+        """Signatures traced more than once within the window, or traced
+        in the window after already being compiled before it."""
+        out = []
+        for key, delta in self.new_compiles().items():
+            before = self._base.get(key, 0)
+            if delta + min(before, 1) > 1:
+                out.append((key, delta))
+        return out
+
+    # -- context protocol ----------------------------------------------
+    def __enter__(self) -> "RetraceSentinel":
+        self._base = snapshot()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            return  # don't mask the real failure
+        self.check()
+
+    def check(self) -> None:
+        dups = self.duplicates()
+        if len(dups) > self._allowed_duplicates:
+            lines = "\n".join(
+                f"  {site} [{static or 'no static'}] sig={sig} "
+                f"traced +{delta}x"
+                for (site, static, sig), delta in dups)
+            raise RetraceError(
+                "unexpected jit retrace — identical (site, config, "
+                "signature) compiled more than once (cache bust; is a "
+                "program being rebuilt around a fresh closure per call?):\n"
+                + lines)
+        total = self.total_new()
+        budget = (None if self.max_compiles is None
+                  else self.max_compiles + self._allowed_extra)
+        if budget is not None and total > budget:
+            lines = "\n".join(
+                f"  {site} [{static or 'no static'}] sig={sig} x{delta}"
+                for (site, static, sig), delta in
+                sorted(self.new_compiles().items()))
+            raise RetraceError(
+                f"{total} compilation(s) in a window budgeted for "
+                f"{budget} — shape-unstable call pattern? new traces:\n"
+                + lines)
+
+
+def sentinel(max_compiles: Optional[int] = None) -> RetraceSentinel:
+    """`with sentinel(max_compiles=1): ...` — see RetraceSentinel."""
+    return RetraceSentinel(max_compiles=max_compiles)

@@ -1,0 +1,314 @@
+"""The lint rules.  Each rule is a generator over a PackageIndex.
+
+Rule ids are kebab-case; suppress one finding with an inline
+`# megba: allow-<rule>` pragma on the flagged physical line.
+
+| id | contract it enforces |
+|---|---|
+| host-callback | `jax.debug.callback` / `jax.debug.print` / `io_callback` / `pure_callback` only inside the designated host-interop modules (observability/, utils/debug.py) — anywhere else a callback silently punches a host round-trip into the fused device program |
+| np-in-jit | no `np.*` calls, `float(...)` or `.item()` coercions in functions reachable from a jitted entry point — each is either a trace-time constant bake (silent retrace per value) or a ConcretizationError waiting for the first non-static input |
+| implicit-dtype | `jnp.zeros/ones/empty/full/arange/eye/linspace/identity` must state a dtype (keyword or the documented positional slot); `jnp.array`/`jnp.asarray` of pure Python literals too — the f32 default silently breaks the f64/f32 parity evidence (DOUBLE_PARITY.json) |
+| scalar-promotion | no strongly-typed scalar constructors (`np.float64(x)`, `jnp.int32(k)`, ...) as operands of array arithmetic in jit-reachable code — unlike weak Python scalars they promote the whole expression's dtype |
+| donated-reuse | an argument passed at a `donate_argnums` position of a locally-built `jax.jit` program must not be read after the call — the buffer is deleted by the call |
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from megba_tpu.analysis.callgraph import (
+    FunctionInfo,
+    ModuleInfo,
+    PackageIndex,
+    _dotted,
+)
+
+# Modules allowed to host callbacks / host coercions: the designated
+# host-interop layer.  Matched on dotted module-name suffixes so the
+# linter works from any invocation directory.
+HOST_INTEROP_MODULES = (
+    "observability",
+    "utils.debug",
+)
+
+_CALLBACK_TAILS = {"io_callback", "pure_callback"}
+_CALLBACK_DOTTED_TAILS = ("debug.callback", "debug.print")
+
+_NUMPY_HEADS = {"numpy"}
+_JNP_HEADS = {"jax.numpy"}
+
+# constructor name -> positional index where dtype may legally appear
+# (None: keyword-only in practice for this repo's call shapes)
+_DTYPE_SLOT = {
+    "zeros": 1, "ones": 1, "empty": 1, "array": 1, "asarray": 1,
+    "full": 2, "arange": 3, "eye": 3, "identity": 1, "linspace": None,
+}
+
+_SCALAR_CTORS = {
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_",
+}
+
+ALL_RULES = (
+    "host-callback",
+    "np-in-jit",
+    "implicit-dtype",
+    "scalar-promotion",
+    "donated-reuse",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def _is_host_interop(mod: ModuleInfo) -> bool:
+    parts = mod.name.split(".")
+    return "observability" in parts or mod.name.endswith("utils.debug")
+
+
+def _alias_target(mod: ModuleInfo, dotted: Optional[str]) -> Optional[str]:
+    """Resolve the head alias of a dotted chain through the module's
+    imports: "np.zeros" -> "numpy.zeros", "jnp.array" -> "jax.numpy.array"."""
+    if dotted is None:
+        return None
+    head, *rest = dotted.split(".")
+    target = mod.imports.get(head, head)
+    return ".".join([target] + rest)
+
+
+def _own_nodes(info: FunctionInfo) -> Iterator[ast.AST]:
+    """Walk a function's own body, not descending into nested defs
+    (those are indexed and checked as functions in their own right)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(info.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------- rules
+
+def rule_host_callback(index: PackageIndex) -> Iterator[Finding]:
+    for mod in index.modules.values():
+        if _is_host_interop(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            full = _alias_target(mod, dotted)
+            tail = dotted.split(".")[-1]
+            hit = tail in _CALLBACK_TAILS or any(
+                dotted.endswith(t) or (full or "").endswith(t)
+                for t in _CALLBACK_DOTTED_TAILS)
+            if hit:
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, "host-callback",
+                    f"`{dotted}` outside the host-interop layer "
+                    "(observability/, utils/debug.py): callbacks break the "
+                    "single-fused-program contract; route host output "
+                    "through observability/emit.py")
+
+
+def rule_np_in_jit(index: PackageIndex) -> Iterator[Finding]:
+    for qual in sorted(index.reachable):
+        info = index.functions[qual]
+        mod = index.modules[info.module]
+        if _is_host_interop(mod):
+            continue
+        for node in _own_nodes(info):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            full = _alias_target(mod, dotted)
+            if full is not None and full.split(".")[0] in _NUMPY_HEADS:
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, "np-in-jit",
+                    f"host numpy call `{dotted}` inside jit-reachable "
+                    f"`{qual.split('.')[-1]}`: it runs at trace time and "
+                    "bakes a constant (or retraces per value); use jnp")
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id == "float" and node.args):
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, "np-in-jit",
+                    "`float(...)` inside jit-reachable "
+                    f"`{qual.split('.')[-1]}`: concretizes a traced value "
+                    "(ConcretizationError on non-static input)")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "item" and not node.args):
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, "np-in-jit",
+                    "`.item()` inside jit-reachable "
+                    f"`{qual.split('.')[-1]}`: host sync/concretization in "
+                    "traced code")
+
+
+def _literal_only(node: ast.AST) -> bool:
+    """True when the expression tree is pure Python literals (the cases
+    where jnp.array has no operand dtype to inherit)."""
+    if isinstance(node, ast.Constant):
+        return not isinstance(node.value, (str, bytes, type(None)))
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_literal_only(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        return _literal_only(node.operand)
+    return False
+
+
+def rule_implicit_dtype(index: PackageIndex) -> Iterator[Finding]:
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            full = _alias_target(mod, dotted)
+            if full is None:
+                continue
+            head, _, tail = full.rpartition(".")
+            if head not in _JNP_HEADS or tail not in _DTYPE_SLOT:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            slot = _DTYPE_SLOT[tail]
+            if slot is not None and len(node.args) > slot:
+                continue  # positional dtype present
+            if tail in ("array", "asarray"):
+                if not (node.args and _literal_only(node.args[0])):
+                    continue  # inherits dtype from its operands
+            yield Finding(
+                mod.path, node.lineno, node.col_offset, "implicit-dtype",
+                f"`jnp.{tail}` without an explicit dtype defaults to "
+                "float32/weak: state the dtype (problem dtype, operand "
+                ".dtype, or jnp.int32 for indices) so f64 runs stay f64")
+
+
+def rule_scalar_promotion(index: PackageIndex) -> Iterator[Finding]:
+    for qual in sorted(index.reachable):
+        info = index.functions[qual]
+        mod = index.modules[info.module]
+        for node in _own_nodes(info):
+            if not isinstance(node, ast.BinOp):
+                continue
+            for side in (node.left, node.right):
+                if not isinstance(side, ast.Call):
+                    continue
+                full = _alias_target(mod, _dotted(side.func)) or ""
+                head, _, tail = full.rpartition(".")
+                if (head in _NUMPY_HEADS | _JNP_HEADS
+                        and tail in _SCALAR_CTORS):
+                    yield Finding(
+                        mod.path, node.lineno, node.col_offset,
+                        "scalar-promotion",
+                        f"strongly-typed scalar `{_dotted(side.func)}` in "
+                        "array arithmetic promotes the whole expression's "
+                        "dtype (weak Python scalars would not); cast with "
+                        "jnp.asarray(x, arr.dtype) instead")
+
+
+def rule_donated_reuse(index: PackageIndex) -> Iterator[Finding]:
+    for qual, info in sorted(index.functions.items()):
+        mod = index.modules[info.module]
+        yield from _donated_reuse_in(mod, info)
+
+
+def _donated_reuse_in(mod: ModuleInfo,
+                      info: FunctionInfo) -> Iterator[Finding]:
+    donated_fns: Dict[str, Tuple[int, ...]] = {}
+    # (var name tainted, donating call first line, call last line)
+    taints: List[Tuple[str, int, int]] = []
+
+    nodes = sorted(
+        (n for n in _own_nodes(info)),
+        key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)))
+
+    for node in nodes:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            dotted = _dotted(node.value.func) or ""
+            if dotted.split(".")[-1] == "jit":
+                positions = _donate_positions(node.value)
+                if positions:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            donated_fns[tgt.id] = positions
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            positions = donated_fns.get(node.func.id)
+            if positions:
+                for p in positions:
+                    if p < len(node.args) and isinstance(
+                            node.args[p], ast.Name):
+                        # Taint from the call's LAST line: a wrapped
+                        # call's own arguments on continuation lines are
+                        # not reads-after-donation.
+                        taints.append((
+                            node.args[p].id, node.lineno,
+                            getattr(node, "end_lineno", node.lineno)
+                            or node.lineno))
+
+    if not taints:
+        return
+    # Any Load of a tainted name strictly after its donating call (and
+    # before a rebinding Store) is a use of a deleted buffer.
+    events: Dict[str, List[Tuple[int, int, str, ast.AST]]] = {}
+    for node in _own_nodes(info):
+        if isinstance(node, ast.Name):
+            kind = "load" if isinstance(node.ctx, ast.Load) else "store"
+            events.setdefault(node.id, []).append(
+                (node.lineno, node.col_offset, kind, node))
+    for name, call_line, call_end in taints:
+        for lineno, col, kind, node in sorted(events.get(name, [])):
+            if lineno < call_line:
+                continue
+            if lineno <= call_end:
+                if kind == "store":
+                    break  # `x = prog(x, ...)`: rebound to the result
+                continue  # the donating call's own argument load
+            if kind == "store":
+                break  # rebound: taint ends
+            yield Finding(
+                mod.path, lineno, col, "donated-reuse",
+                f"`{name}` was donated to a jitted call on line "
+                f"{call_line} (its device buffer is deleted by the call); "
+                "reading it afterwards raises 'Array has been deleted'")
+            break  # one finding per taint is enough
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+    return ()
+
+
+RULES = {
+    "host-callback": rule_host_callback,
+    "np-in-jit": rule_np_in_jit,
+    "implicit-dtype": rule_implicit_dtype,
+    "scalar-promotion": rule_scalar_promotion,
+    "donated-reuse": rule_donated_reuse,
+}

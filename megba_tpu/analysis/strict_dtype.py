@@ -1,0 +1,111 @@
+"""Strict-promotion sanitizer lane.
+
+`python -m megba_tpu.analysis.strict_dtype` runs small end-to-end BA and
+PGO solves with `jax_numpy_dtype_promotion='strict'` (every implicit
+dtype promotion between non-weak types becomes a hard TypePromotionError
+at trace time) and `jax_debug_nans=True` (any NaN surfacing from a
+jitted computation raises instead of propagating).  This is the dynamic
+complement of the AST linter: the linter catches the *patterns* that
+cause weak-type/promotion bugs, this lane proves the real solve
+pipelines trace clean under the strictest dtype discipline JAX offers.
+
+Wired into scripts/lint.sh (and through it scripts/run_tests.sh), so
+tier-1 cannot pass with a promotion regression.  Exit 0 on success.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+
+
+@contextlib.contextmanager
+def strict_promotion(debug_nans: bool = True):
+    """Temporarily enable strict dtype promotion (+ NaN checking)."""
+    import jax
+
+    old_promo = jax.config.jax_numpy_dtype_promotion
+    old_nans = jax.config.jax_debug_nans
+    jax.config.update("jax_numpy_dtype_promotion", "strict")
+    jax.config.update("jax_debug_nans", debug_nans)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_numpy_dtype_promotion", old_promo)
+        jax.config.update("jax_debug_nans", old_nans)
+
+
+def run_ba_smoke(dtype=None, world_size: int = 1):
+    """One tiny BA solve under the sanitizer; returns the LMResult."""
+    import numpy as np
+
+    from megba_tpu.common import (
+        AlgoOption, JacobianMode, ProblemOption, SolverOption)
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    dtype = np.float32 if dtype is None else dtype
+    s = make_synthetic_bal(num_cameras=4, num_points=24, obs_per_point=3,
+                           seed=0, param_noise=4e-2, pixel_noise=0.3,
+                           dtype=dtype)
+    option = ProblemOption(
+        dtype=dtype, world_size=world_size,
+        algo_option=AlgoOption(max_iter=4),
+        solver_option=SolverOption(max_iter=10, tol=1e-8))
+    res = flat_solve(
+        make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF),
+        s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option)
+    _check_decrease("ba", res.initial_cost, res.cost, res.iterations)
+    return res
+
+
+def run_pgo_smoke(dtype=None):
+    """One tiny pose-graph solve under the sanitizer."""
+    import numpy as np
+
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.models.pgo import make_synthetic_pose_graph, solve_pgo
+
+    dtype = np.float32 if dtype is None else dtype
+    g = make_synthetic_pose_graph(num_poses=12, seed=0)
+    option = ProblemOption(
+        dtype=dtype,
+        algo_option=AlgoOption(max_iter=4),
+        solver_option=SolverOption(max_iter=10, tol=1e-8))
+    res = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option)
+    _check_decrease("pgo", res.initial_cost, res.cost, res.iterations)
+    return res
+
+
+def _check_decrease(label, cost0, cost, iters) -> None:
+    import numpy as np
+
+    c0, c1 = float(cost0), float(cost)
+    if not (np.isfinite(c0) and np.isfinite(c1)):
+        raise AssertionError(f"[{label}] non-finite cost: {c0} -> {c1}")
+    if not c1 <= c0:
+        raise AssertionError(f"[{label}] cost did not decrease: "
+                             f"{c0:.6e} -> {c1:.6e}")
+    print(f"[strict-dtype] {label}: {c0:.6e} -> {c1:.6e} "
+          f"in {int(iters)} iters OK", flush=True)
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    import jax
+
+    dtypes = [np.float32]
+    if jax.config.jax_enable_x64:
+        dtypes.append(np.float64)
+    with strict_promotion():
+        for dt in dtypes:
+            run_ba_smoke(dtype=dt)
+        run_pgo_smoke()
+    print("strict-dtype sanitizer lane OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

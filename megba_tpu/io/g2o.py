@@ -390,10 +390,14 @@ def solve_g2o(source, option=None, verbose: bool = False,
         p = idx.shape[0]
         # Priors carry the gauge; the parser's defaulted anchor (a FIX
         # the file never declared) would fight them.  File-declared FIX
-        # records are kept — and so is the default anchor when the
-        # graph has a connected component no prior reaches (clearing it
-        # would leave that component with a free 6-DOF gauge and a
-        # singular system).
+        # records are kept.  The default anchor is decided PER CONNECTED
+        # COMPONENT: a component some prior reaches gets its gauge from
+        # that prior (keeping a hard anchor there would bias the solve
+        # toward the file estimate — the exact conflict this path
+        # avoids); a component no prior reaches is anchored at one of
+        # its OWN poses (the parser's fixed[0] only covers pose 0's
+        # component; an unreached component would otherwise keep a free
+        # 6-DOF gauge and a singular system).
         if not graph.had_fix:
             from collections import deque
 
@@ -401,17 +405,30 @@ def solve_g2o(source, option=None, verbose: bool = False,
             for a, b in zip(np.asarray(edge_i), np.asarray(edge_j)):
                 adj[int(a)].append(int(b))
                 adj[int(b)].append(int(a))
-            seen = np.zeros(n, bool)
-            seen[idx] = True
-            queue = deque(int(v) for v in idx)
-            while queue:
-                a = queue.popleft()
-                for b in adj[a]:
-                    if not seen[b]:
-                        seen[b] = True
-                        queue.append(b)
-            if seen.all():
-                fixed = np.zeros(n, bool)
+            comp = np.full(n, -1, np.int64)
+            n_comp = 0
+            for start in range(n):
+                if comp[start] >= 0:
+                    continue
+                comp[start] = n_comp
+                queue = deque([start])
+                while queue:
+                    a = queue.popleft()
+                    for b in adj[a]:
+                        if comp[b] < 0:
+                            comp[b] = n_comp
+                            queue.append(b)
+                n_comp += 1
+            has_prior = np.zeros(n_comp, bool)
+            has_prior[comp[idx]] = True
+            fixed = np.zeros(n, bool)
+            # First member of every component in one pass (labels are
+            # assigned in first-occurrence order, so unique's sorted
+            # values are 0..n_comp-1 and return_index gives the first
+            # pose of each) — a per-component argmax scan would go
+            # quadratic on fragmented FIX-less graphs.
+            _, first = np.unique(comp, return_index=True)
+            fixed[first[~has_prior]] = True
         poses0, edge_i, edge_j, meas, fixed, sqrt_info = with_priors(
             poses0, edge_i, edge_j, meas,
             prior_idx=idx, prior_poses=graph.poses[idx],

@@ -478,6 +478,15 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
             pcg_total=out["pcg_total"], region=out["region"],
             v=out["v"], stop=out["stop"])
 
+    # Retrace sentinel hook (analysis/retrace.py): one count per
+    # compilation of the PGO program; zero cost once compiled.
+    from megba_tpu.analysis.retrace import static_key, traced
+
+    run = traced(
+        "pgo.run", run,
+        static=static_key(option, f"world{world}", n_poses, np_dtype,
+                          extra_keys, verbose))
+
     if world > 1:
         mesh = make_mesh(world)
         in_specs = _pgo_in_specs(extra_keys)

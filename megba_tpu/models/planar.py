@@ -29,7 +29,7 @@ POINT_DIM = 2
 OBS_DIM = 1
 
 
-def residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:
+def residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:  # megba: jit-entry
     """1D reprojection residual for one planar edge."""
     theta = camera[0]
     t = camera[1:3]

@@ -40,7 +40,7 @@ _SMALL_ANGLE = 1e-12
 ResidualFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
-def bal_residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:
+def bal_residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> jnp.ndarray:  # megba: jit-entry
     """The standard BAL reprojection residual, one edge.
 
     camera = [angle_axis(3), translation(3), f, k1, k2]; point = (3,);
@@ -60,7 +60,7 @@ def bal_residual(camera: jnp.ndarray, point: jnp.ndarray, obs: jnp.ndarray) -> j
     return proj - obs
 
 
-def bal_residual_jacobian_analytical_fm(
+def bal_residual_jacobian_analytical_fm(  # megba: jit-entry
     cam: jnp.ndarray, pt: jnp.ndarray, obs: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Hand-derived residual + full Jacobian for the BAL model, row form.
@@ -301,7 +301,7 @@ def build_residual_jacobian_fn(
 
     mapped = jax.vmap(per_edge, in_axes=(-1, -1, -1), out_axes=(-1, -1, -1))
 
-    def fm_fn(cam, pt, obs):
+    def fm_fn(cam, pt, obs):  # megba: jit-entry
         r, Jc, Jp = mapped(cam, pt, obs)
         od, cd, nE = Jc.shape
         pd = Jp.shape[1]

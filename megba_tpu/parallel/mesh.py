@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.analysis.retrace import static_key, traced
 from megba_tpu.common import ProblemOption
 from megba_tpu.core.types import pad_edges
 
@@ -125,6 +126,7 @@ def distributed_lm_solve(
     initial_region=None,
     initial_v=None,
     jit_cache: Optional[dict] = None,
+    donate: bool = False,
 ) -> LMResult:
     """Run the full LM solve SPMD over the mesh's edge axis.
 
@@ -135,13 +137,17 @@ def distributed_lm_solve(
     program; per-iteration synchronisation is the psum set documented in
     builder.py/pcg.py.
 
-    DONATION CONTRACT: `cameras` and `points` are donated — the result's
-    parameter arrays alias their buffers, and device arrays passed here
-    are DELETED by the call.  Pass host numpy (uploaded once, nothing
-    retained) or hand over arrays you will not reuse; flat_solve does
-    the former.  Under a multi-process mesh every operand is lifted into
-    a global array first (parallel/multihost.globalize_for_mesh), so
-    host values are required there anyway.
+    DONATION CONTRACT: with `donate=True`, `cameras` and `points` are
+    donated — the result's parameter arrays alias their buffers, and
+    device arrays passed here are DELETED by the call.  The default is
+    False on this PUBLIC entry point so a caller that reuses its device
+    arrays after the call keeps its previously-valid usage; the internal
+    flat_solve path opts in (it materializes fresh host operands per
+    call and never reads them after the solve, so donation is free
+    parameter-memory savings there).  Under a multi-process mesh every
+    operand is lifted into a global array first
+    (parallel/multihost.globalize_for_mesh), so host values are required
+    there anyway.
     """
     n_edge = obs.shape[-1]
     if n_edge % mesh.devices.size != 0:
@@ -182,7 +188,7 @@ def distributed_lm_solve(
     jitted = get_or_build_program(
         jit_cache, _cached_sharded_solve, _build_sharded_solve,
         residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
-        cam_sorted)
+        cam_sorted, donate)
 
     from megba_tpu.parallel.multihost import dispatch_on_mesh
 
@@ -211,7 +217,7 @@ def get_or_build_program(jit_cache, cached_fn, build_fn, engine, *cfg):
 
 
 def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
-                         cam_sorted=False):
+                         cam_sorted=False, donate=False):
     """Build the jitted shard_map'ed solve (uncached)."""
 
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
@@ -230,16 +236,24 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
             initial_v=init_v, verbose_token=verbose_token,
             **kwargs)
 
+    # `traced`: retrace sentinel hook (analysis/retrace.py) — one count
+    # per compilation of this SPMD program; zero cost once compiled.
+    fn = traced(
+        "mesh.sharded", fn,
+        static=static_key(residual_jac_fn, f"world{mesh.devices.size}",
+                          option, keys, verbose, cam_sorted, donate))
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
-    # Donate the replicated parameter blocks (same contract as
-    # solve._build_single_solve: flat_solve hands over fresh operands).
-    # NOT under the experimental fallback: there, donated inputs aliased
-    # by replicated (out_specs=P()) outputs intermittently surface
-    # freed-buffer garbage in the result (observed as ~1e-310 denormals
-    # in the world>1 parity tests); parameters are the small arrays, so
-    # forgoing donation costs little off the native path.
+    # Donate the replicated parameter blocks only when the caller opted
+    # in (the internal flat_solve path does; the public entry point
+    # defaults to donate=False so external device arrays survive the
+    # call).  NEVER under the experimental fallback: there, donated
+    # inputs aliased by replicated (out_specs=P()) outputs intermittently
+    # surface freed-buffer garbage in the result (observed as ~1e-310
+    # denormals in the world>1 parity tests); parameters are the small
+    # arrays, so forgoing donation costs little off the native path.
     return jax.jit(
-        sharded, donate_argnums=(0, 1) if SHARD_MAP_NATIVE else ())
+        sharded,
+        donate_argnums=(0, 1) if (donate and SHARD_MAP_NATIVE) else ())
 
 
 # Global program cache for long-lived engines.  jax.jit caches by callable

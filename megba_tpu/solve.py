@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from megba_tpu.algo.lm import LMResult, lm_solve
+from megba_tpu.analysis.retrace import static_key, traced
 from megba_tpu.common import ProblemOption, validate_options
 from megba_tpu.core.fm import EDGE_QUANTUM
 from megba_tpu.core.types import is_cam_sorted, pad_edges
@@ -74,7 +75,13 @@ def _build_single_solve(residual_jac_fn, option, keys, verbose, cam_sorted):
     # checkpointed drivers that call the program in a loop).  Safe:
     # flat_solve materializes fresh feature-major operands per call and
     # never reads them after the solve.
-    return jax.jit(fn, donate_argnums=(0, 1))
+    # `traced`: retrace sentinel hook (analysis/retrace.py) — counts one
+    # trace per compilation of this program; zero cost once compiled.
+    return jax.jit(
+        traced("solve.single", fn,
+               static=static_key(residual_jac_fn, option, keys, verbose,
+                                 cam_sorted)),
+        donate_argnums=(0, 1))
 
 
 # Global program cache for long-lived engines (same pitfall and remedy as
@@ -263,7 +270,7 @@ def flat_solve(
                 pt_fixed=pt_fixed_j,
                 verbose=verbose, cam_sorted=True, plans=plans,
                 initial_region=initial_region, initial_v=initial_v,
-                jit_cache=jit_cache)
+                jit_cache=jit_cache, donate=True)
         result = _result_to_edge_major(result)
         _maybe_emit_report(telemetry, report_option, result, timer,
                            problem_shape)

@@ -36,6 +36,7 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from megba_tpu.analysis.retrace import note_trace, static_key
 from megba_tpu.common import ComputeKind, PreconditionerKind
 from megba_tpu.core.fm import (
     block_inv_fm,
@@ -349,6 +350,10 @@ def plain_pcg_solve(
     Hll^-1 amplifies error, and as an independent cross-check of the
     Schur pipeline (both solve the same damped normal equations).
     """
+    # Retrace sentinel hook (analysis/retrace.py): counts only under an
+    # active jax trace — eager calls are not compilations.
+    note_trace("solver.plain_pcg", system.g_cam, system.g_pt, Jc, Jp,
+               static=static_key(compute_kind, axis_name, preconditioner))
     num_cameras = system.Hpp.shape[0]
     num_points = system.Hll.shape[1]
 
@@ -470,6 +475,11 @@ def schur_pcg_solve(
     `region` is the LM trust region; damping multiplies block diagonals by
     (1 + 1/region).
     """
+    # Retrace sentinel hook (analysis/retrace.py): counts only under an
+    # active jax trace — eager calls are not compilations.
+    note_trace("solver.schur_pcg", system.g_cam, system.g_pt, Jc, Jp,
+               static=static_key(compute_kind, axis_name, mixed_precision,
+                                 preconditioner))
     num_cameras = system.Hpp.shape[0]
     num_points = system.Hll.shape[1]
     pd = int(round(system.Hll.shape[0] ** 0.5))

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Static-analysis + sanitizer lane (megba_tpu/analysis/).
+#
+# Three gates, all required (scripts/run_tests.sh invokes this, so
+# tier-1 cannot pass with a violation in any of them):
+#
+#   1. the JAX-contract linter runs CLEAN on the package;
+#   2. the linter FIRES on the seeded bad-pattern fixture (a rule that
+#      silently stops matching is itself a regression);
+#   3. the strict-dtype sanitizer lane: small end-to-end BA + PGO solves
+#      under jax_numpy_dtype_promotion=strict + jax_debug_nans.
+set -e -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "[lint] JAX-contract linter on megba_tpu/"
+python -m megba_tpu.analysis.lint megba_tpu/
+
+echo "[lint] linter must fire on the seeded bad-pattern fixture"
+if python -m megba_tpu.analysis.lint tests/data/lint_fixtures/bad_patterns.py \
+    > /dev/null 2>&1; then
+    echo "ERROR: linter exited 0 on tests/data/lint_fixtures/bad_patterns.py" >&2
+    exit 1
+fi
+
+echo "[lint] linter must stay silent on the good-pattern fixture"
+python -m megba_tpu.analysis.lint tests/data/lint_fixtures/good_patterns.py
+
+echo "[lint] strict-dtype promotion + debug-nans sanitizer lane"
+JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python -m megba_tpu.analysis.strict_dtype
+
+echo "lint lane OK"

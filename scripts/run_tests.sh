@@ -10,6 +10,11 @@
 set -e -o pipefail
 cd "$(dirname "$0")/.."
 
+# Static checks first: the JAX-contract linter + strict-dtype sanitizer
+# lane (scripts/lint.sh) are cheap and fail fast, so a contract
+# violation can never hide behind a green unit-test run.
+bash scripts/lint.sh
+
 FIRST=$(ls tests/test_[a-o]*.py)
 SECOND=$(ls tests/test_[p-z]*.py)
 

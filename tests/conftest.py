@@ -79,3 +79,19 @@ def pytest_collection_modifyitems(config, items):
 def cpu_devices(n: int):
     assert len(_cpus) >= n, f"need {n} cpu devices, have {len(_cpus)}"
     return _cpus[:n]
+
+
+@pytest.fixture
+def retrace_sentinel():
+    """Opt-in retrace guard (megba_tpu/analysis/retrace.py).
+
+    Request this fixture and the test FAILS (at teardown) if the window
+    saw an unexpected jit recompile: the same (site, static config,
+    operand signature) traced twice — a jit cache bust.  Budget extra
+    legitimate compiles with `retrace_sentinel.allow(...)`, or cap the
+    total with `retrace_sentinel.max_compiles = n`.
+    """
+    from megba_tpu.analysis.retrace import sentinel
+
+    with sentinel() as s:
+        yield s

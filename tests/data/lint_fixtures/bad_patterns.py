@@ -1,0 +1,64 @@
+"""Seeded known-BAD patterns for megba_tpu.analysis.lint.
+
+Every rule must fire at least once on this file — tests/test_analysis.py
+pins the exact (rule, function) pairs, so a rule that silently stops
+matching breaks the suite, not the codebase.  This file is never
+imported or executed; it only exists to be parsed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+
+def leaky_callback(x):
+    # host-callback: callback outside observability/ and utils/debug.py
+    jax.debug.callback(print, x)
+    jax.debug.print("x = {}", x)
+    io_callback(print, None, x)
+    return x
+
+
+def hot_body(cams, pts):  # megba: jit-entry
+    # np-in-jit: host numpy + coercions inside a jit-reachable function
+    norms = np.linalg.norm(cams, axis=0)
+    scale = float(norms[0])
+    first = pts[0].item()
+    return cams * scale + first
+
+
+def helper_called_from_hot(x):
+    # np-in-jit via reachability: not an entry itself, but hot_entry
+    # below references it.
+    return np.sqrt(x)
+
+
+def hot_entry(x):  # megba: jit-entry
+    return helper_called_from_hot(x) + 1.0
+
+
+def implicit_dtypes(n):
+    # implicit-dtype: constructors with nothing to inherit a dtype from
+    a = jnp.zeros((n, 3))
+    b = jnp.ones(n)
+    c = jnp.arange(n)
+    d = jnp.array([1.0, 2.0, 3.0])
+    e = jnp.full((n,), 0)
+    f = jnp.eye(3)
+    return a, b, c, d, e, f
+
+
+def promoting_math(x):  # megba: jit-entry
+    # scalar-promotion: strongly-typed scalar ctors in array arithmetic
+    y = x * np.float64(2.0)
+    z = jnp.int64(3) + x
+    return y, z
+
+
+def donated_then_reused(cameras, points, obs):
+    prog = jax.jit(lambda c, p, o: (c + o, p), donate_argnums=(0, 1))
+    out_c, out_p = prog(cameras, points, obs)
+    # donated-reuse: cameras' buffer was deleted by the call above
+    leak = cameras + 1.0
+    return out_c, out_p, leak

@@ -1,0 +1,77 @@
+"""Seeded known-GOOD patterns: the same idioms as bad_patterns.py done
+right, plus the legitimate edge cases each rule must NOT flag.  The
+linter must stay silent on this file — a false positive here is a
+regression in a rule, caught by tests/test_analysis.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_driver(cams_np):
+    # np.* / float() are fine OUTSIDE jit-reachable code: this is the
+    # host lowering layer, exactly where numpy belongs.
+    cams = np.ascontiguousarray(cams_np.T)
+    scale = float(np.linalg.norm(cams))
+    return cams / scale
+
+
+def hot_body(cams, pts):  # megba: jit-entry
+    # pure jnp math, weak Python scalars (which do NOT promote dtypes)
+    y = cams * 2.0 + 1.0
+    return y + jnp.sum(pts)
+
+
+def explicit_dtypes(n, dtype):
+    a = jnp.zeros((n, 3), dtype)
+    b = jnp.ones(n, dtype=dtype)
+    c = jnp.arange(n, dtype=jnp.int32)
+    d = jnp.array([1.0, 2.0, 3.0], dtype=dtype)
+    e = jnp.full((n,), 0, jnp.int32)
+    f = jnp.eye(3, dtype=dtype)
+    return a, b, c, d, e, f
+
+
+def inherited_dtype(x, c, s):
+    # jnp.array over expressions inherits its operands' dtype — the
+    # rule must not demand redundant annotations here.
+    rot = jnp.array([[c, -s], [s, c]])
+    return rot @ x
+
+
+def allowed_np(x):  # megba: jit-entry
+    # pragma suppression: trace-time static shape math, deliberate
+    n = np.prod(x.shape)  # megba: allow-np-in-jit
+    return x.reshape(n)
+
+
+def safe_cast(x):  # megba: jit-entry
+    # the blessed alternative to scalar-promotion: asarray to the
+    # array's own dtype keeps the expression dtype-stable
+    two = jnp.asarray(2.0, x.dtype)
+    return x * two
+
+
+def donate_handoff(cameras, points, obs):
+    prog = jax.jit(lambda c, p, o: (c + o, p), donate_argnums=(0, 1))
+    out_c, out_p = prog(cameras, points, obs)
+    # only the RESULTS are read after the call; the donated operands
+    # are never touched again
+    return out_c * 2.0, out_p
+
+
+def donate_rebound(cameras, obs):
+    prog = jax.jit(lambda c, o: c + o, donate_argnums=(0,))
+    cameras = prog(cameras, obs)
+    # `cameras` was rebound to the result — reading it now is fine
+    return cameras + 1.0
+
+
+def donate_multiline_call(cameras, points, obs):
+    prog = jax.jit(lambda c, p, o: (c + o, p), donate_argnums=(0, 1))
+    # the call's own arguments on continuation lines are not
+    # reads-after-donation
+    out_c, out_p = prog(
+        cameras,
+        points, obs)
+    return out_c, out_p

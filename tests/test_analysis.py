@@ -1,0 +1,295 @@
+"""megba_tpu/analysis/: linter rules, retrace sentinel, strict lane.
+
+Every lint rule gets a positive (fires on the seeded bad fixture) AND a
+negative (silent on the good fixture) test, so a rule that silently
+stops matching — or starts over-matching — breaks this suite rather
+than the codebase.  The retrace sentinel is exercised against a real
+deliberately shape-unstable solve loop, and the strict-promotion lane
+runs the small solve smoke in-process.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "lint_fixtures")
+BAD = os.path.join(FIXTURES, "bad_patterns.py")
+GOOD = os.path.join(FIXTURES, "good_patterns.py")
+PACKAGE = os.path.join(os.path.dirname(__file__), "..", "megba_tpu")
+
+
+def _lint(*paths, rules=None):
+    from megba_tpu.analysis.lint import lint_paths
+
+    return lint_paths(list(paths), rules=rules)
+
+
+# ------------------------------------------------------------ lint rules
+
+
+def test_lint_clean_on_package():
+    """THE acceptance gate: the package itself carries no violations."""
+    findings = _lint(PACKAGE)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.parametrize("rule", [
+    "host-callback", "np-in-jit", "implicit-dtype", "scalar-promotion",
+    "donated-reuse"])
+def test_each_rule_fires_on_bad_and_not_on_good(rule):
+    bad = _lint(BAD, rules=[rule])
+    assert bad, f"rule {rule} found nothing in the seeded bad fixture"
+    assert all(f.rule == rule for f in bad)
+    good = _lint(GOOD, rules=[rule])
+    assert good == [], "\n".join(f.format() for f in good)
+
+
+def test_bad_fixture_finding_shape():
+    """Pin the exact per-rule hit counts in the seeded fixture, so both
+    silent rule decay and over-matching regress loudly."""
+    from collections import Counter
+
+    counts = Counter(f.rule for f in _lint(BAD))
+    assert counts == {
+        "host-callback": 3,     # debug.callback, debug.print, io_callback
+        "np-in-jit": 5,         # np call, float(), .item(), np.sqrt via
+                                # reachability, np.float64 in promoting_math
+        "implicit-dtype": 6,    # zeros/ones/arange/array/full/eye
+        "scalar-promotion": 2,  # np.float64 *, jnp.int64 +
+        "donated-reuse": 1,
+    }, counts
+
+
+def test_pragma_suppresses_single_line(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    a = jnp.zeros(n)\n"
+        "    b = jnp.zeros(n)  # megba: allow-implicit-dtype\n"
+        "    return a, b\n")
+    findings = _lint(str(src))
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_jit_entry_pragma_extends_reachability(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import numpy as np\n"
+        "def helper(x):\n"
+        "    return np.sqrt(x)\n"
+        "def engine(x):  # megba: jit-entry\n"
+        "    return helper(x)\n"
+        "def host_only(x):\n"
+        "    return np.sqrt(x)\n")
+    findings = _lint(str(src), rules=["np-in-jit"])
+    # helper is reachable through engine; host_only is not reachable
+    assert [f.line for f in findings] == [3]
+
+
+def test_callgraph_detects_repo_entry_points():
+    """The real builders must be recognised: decorated partial(jax.jit),
+    jax.jit(fn, ...), shard_map(fn, ...), and the jit-entry pragma."""
+    from megba_tpu.analysis.callgraph import PackageIndex
+
+    idx = PackageIndex.build([PACKAGE])
+    entries = {q for q, f in idx.functions.items() if f.is_entry}
+    assert "megba_tpu.solve._build_single_solve.fn" in entries
+    assert "megba_tpu.parallel.mesh._build_sharded_solve.fn" in entries
+    assert "megba_tpu.models.pgo._pgo_program.run" in entries
+    assert "megba_tpu.ops.residuals.bal_residual" in entries  # pragma
+    # and the hot inner layers are reachable from them
+    for q in ("megba_tpu.algo.lm.lm_solve",
+              "megba_tpu.solver.pcg.schur_pcg_solve",
+              "megba_tpu.solver.pcg.plain_pcg_solve",
+              "megba_tpu.linear_system.builder.build_schur_system",
+              "megba_tpu.ops.robust.robustify"):
+        assert q in idx.reachable, q
+
+
+def test_cli_exit_codes():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    root = os.path.dirname(PACKAGE)
+    bad = subprocess.run(
+        [sys.executable, "-m", "megba_tpu.analysis.lint", BAD],
+        capture_output=True, text=True, timeout=120, cwd=root, env=env)
+    assert bad.returncode == 1, bad.stderr
+    assert "host-callback" in bad.stdout and "donated-reuse" in bad.stdout
+    good = subprocess.run(
+        [sys.executable, "-m", "megba_tpu.analysis.lint", GOOD,
+         "--list-rules"],
+        capture_output=True, text=True, timeout=120, cwd=root, env=env)
+    assert good.returncode == 0, good.stderr
+    none = subprocess.run(
+        [sys.executable, "-m", "megba_tpu.analysis.lint"],
+        capture_output=True, text=True, timeout=120, cwd=root, env=env)
+    assert none.returncode == 2
+    # A vanished target must FAIL the gate (exit 2), not lint zero
+    # files and report clean — a typo'd path in scripts/lint.sh would
+    # otherwise silently disarm the whole acceptance gate.
+    gone = subprocess.run(
+        [sys.executable, "-m", "megba_tpu.analysis.lint",
+         "no_such_dir_xyz/"],
+        capture_output=True, text=True, timeout=120, cwd=root, env=env)
+    assert gone.returncode == 2, (gone.stdout, gone.stderr)
+    assert "not a directory" in gone.stderr
+
+
+# -------------------------------------------------------------- retrace
+
+
+def _tiny_option(**kw):
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+
+    return ProblemOption(
+        dtype=np.float32,
+        algo_option=AlgoOption(max_iter=2),
+        # distinctive tolerances: a config no other suite compiles, so
+        # these programs are always fresh compiles inside the window
+        solver_option=SolverOption(max_iter=3, tol=3.7e-9), **kw)
+
+
+def _tiny_solve(num_cameras, option):
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    s = make_synthetic_bal(num_cameras=num_cameras, num_points=23,
+                           obs_per_point=3, seed=1, dtype=np.float32)
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                      s.pt_idx, option)
+
+
+def test_retrace_sentinel_quiet_on_cache_hit(retrace_sentinel):
+    """Two identical solves = one compile; the opt-in fixture passes."""
+    option = _tiny_option()
+    _tiny_solve(6, option)
+    before = retrace_sentinel.total_new()
+    assert before > 0  # the first solve really did trace
+    _tiny_solve(6, option)
+    assert retrace_sentinel.total_new() == before  # jit cache hit
+
+
+def test_retrace_sentinel_catches_shape_unstable_loop():
+    """A loop that grows the problem each call compiles per iteration —
+    exactly the silent-retrace failure mode the sentinel exists for."""
+    from megba_tpu.analysis.retrace import RetraceError, sentinel
+
+    option = _tiny_option()
+    with pytest.raises(RetraceError, match="shape-unstable"):
+        with sentinel(max_compiles=4) as s:
+            for nc in (7, 9, 11):  # three signatures, >= 9 traces
+                _tiny_solve(nc, option)
+
+
+def test_retrace_sentinel_counts_per_signature():
+    from megba_tpu.analysis.retrace import sentinel
+
+    option = _tiny_option(use_schur=False)  # distinct config
+    with sentinel() as s:
+        _tiny_solve(6, option)
+        new = s.new_compiles()
+    sites = {k[0] for k in new}
+    assert {"solve.single", "algo.lm_solve", "solver.plain_pcg"} <= sites
+    assert all(count == 1 for count in new.values())
+
+
+def test_retrace_duplicate_detection_and_allow():
+    """A second trace of an identical (site, static, signature) is the
+    cache-bust signal; `allow(duplicates=...)` budgets legitimate ones."""
+    from megba_tpu.analysis.retrace import (
+        RetraceError, note_trace, sentinel)
+
+    class FakeAval:
+        shape = (3, 4)
+        dtype = "float32"
+
+    with pytest.raises(RetraceError, match="retrace"):
+        with sentinel() as s:
+            note_trace("test.dup", FakeAval(), static="cfg", force=True)
+            note_trace("test.dup", FakeAval(), static="cfg", force=True)
+
+    with sentinel() as s:
+        note_trace("test.dup2", FakeAval(), static="cfg", force=True)
+        note_trace("test.dup2", FakeAval(), static="cfg", force=True)
+        s.allow(duplicates=1)
+
+
+def test_note_trace_ignores_eager_calls():
+    """Eager (non-jit) executions of instrumented layers are NOT
+    compilations: two identical eager lm_solve/pcg-style calls must not
+    read as a duplicate-signature cache bust (lm_solve is supported
+    eagerly — e.g. tests/test_lm.py calls it without jit)."""
+    import jax.numpy as jnp
+
+    from megba_tpu.analysis.retrace import note_trace, sentinel
+
+    x = jnp.ones((2, 3), jnp.float32)
+    with sentinel() as s:
+        note_trace("test.eager", x, static="cfg")
+        note_trace("test.eager", x, static="cfg")
+        assert s.total_new() == 0  # guard filtered both; exit is quiet
+
+
+def test_static_key_closure_identity_is_qualname():
+    """Two closures of one factory produce the SAME static key — the
+    property that makes rebuilt-per-call programs show as duplicates."""
+    from megba_tpu.analysis.retrace import static_key
+
+    def factory():
+        def engine(x):
+            return x
+
+        return engine
+
+    assert static_key(factory()) == static_key(factory())
+    assert static_key(factory(), 1, "a") != static_key(factory(), 2, "a")
+
+
+# ---------------------------------------------------------- strict lane
+
+
+def test_strict_promotion_context_restores_config():
+    import jax
+
+    from megba_tpu.analysis.strict_dtype import strict_promotion
+
+    before = (jax.config.jax_numpy_dtype_promotion, jax.config.jax_debug_nans)
+    with strict_promotion():
+        assert jax.config.jax_numpy_dtype_promotion == "strict"
+        assert jax.config.jax_debug_nans
+    assert (jax.config.jax_numpy_dtype_promotion,
+            jax.config.jax_debug_nans) == before
+
+
+def test_strict_lane_ba_and_pgo_smoke():
+    """The real solve pipelines must trace clean under strict promotion
+    + debug-nans (the dynamic half of the sanitizer lane; scripts/lint.sh
+    runs the same smoke as a subprocess gate)."""
+    from megba_tpu.analysis.strict_dtype import (
+        run_ba_smoke, run_pgo_smoke, strict_promotion)
+
+    with strict_promotion():
+        res = run_ba_smoke(dtype=np.float32)
+        assert float(res.cost) < float(res.initial_cost)
+        pgo = run_pgo_smoke(dtype=np.float32)
+        assert float(pgo.cost) < float(pgo.initial_cost)
+
+
+def test_strict_promotion_actually_bites():
+    """Sanity that the lane is not a no-op: a mixed-dtype op that strict
+    mode must reject really raises inside the context."""
+    import jax.numpy as jnp
+
+    from megba_tpu.analysis.strict_dtype import strict_promotion
+
+    a = jnp.ones(3, jnp.float32)
+    b = jnp.ones(3, jnp.bfloat16)
+    with strict_promotion(debug_nans=False):
+        with pytest.raises(Exception, match="[Pp]romotion"):
+            _ = a + b

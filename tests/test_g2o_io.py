@@ -400,3 +400,35 @@ def test_solve_g2o_prior_ids_anchor_file_estimates():
 
     with pytest.raises(ValueError, match="not a vertex"):
         solve_g2o(graph, opt, prior_ids=[999])
+
+
+def test_prior_gauge_decided_per_connected_component():
+    """On a FIX-less multi-component graph, the defaulted anchor is
+    dropped ONLY in components a prior reaches; every unreached
+    component gets a hard anchor at one of its OWN poses (previously
+    all-or-nothing: the kept fixed[0] fought the prior in its component
+    and other components could end up entirely free)."""
+    a = make_synthetic_pose_graph(num_poses=6, loop_closures=2, seed=3)
+    b = make_synthetic_pose_graph(num_poses=6, loop_closures=2, seed=5)
+    na = a.poses0.shape[0]
+    n = na + b.poses0.shape[0]
+    g2 = G2OGraph(
+        poses=np.concatenate([a.poses0, b.poses0]),
+        edge_i=np.concatenate([a.edge_i, b.edge_i + na]),
+        edge_j=np.concatenate([a.edge_j, b.edge_j + na]),
+        meas=np.concatenate([a.meas, b.meas]),
+        info=np.tile(np.eye(6), (len(a.edge_i) + len(b.edge_i), 1, 1)),
+        fixed=np.eye(1, n, 0, dtype=bool)[0],  # parser's default anchor
+        ids=np.arange(n, dtype=np.int64), had_fix=False)
+    _, res = solve_g2o(g2, _option(max_iter=30), prior_ids=[2],
+                       prior_weight=1e5)
+    out = np.asarray(res.poses)
+    assert out.shape[0] == n
+    # Exact measurements: both components converge to (near-)zero cost.
+    assert float(res.cost) < 1e-6
+    # Component A's gauge comes from the prior alone: pose 2 sits at its
+    # file estimate instead of being dragged by a kept fixed[0] anchor.
+    np.testing.assert_allclose(out[2], np.asarray(a.poses0)[2], atol=1e-4)
+    # Component B was not reached by the prior: it is anchored at its
+    # own first pose (index na), exactly at that pose's file estimate.
+    np.testing.assert_allclose(out[na], np.asarray(b.poses0)[0], atol=1e-8)
