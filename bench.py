@@ -317,6 +317,46 @@ def main() -> None:
     fallback = (not _C.force_cpu) and backend != "tpu"
     vs_baseline = (
         None if fallback else round(lm_iters_per_sec / baseline, 3))
+    # Opt-in compiled-program audit embed (MEGBA_BENCH_AUDIT=1): the
+    # static census of the canonical CPU-lowered programs rides the
+    # bench line, so a committed BENCH_*.json can show a perf move next
+    # to the collective/FLOP-budget story of the same tree.  Off by
+    # default — it costs extra CPU lowers/compiles inside a possibly
+    # precious accelerator window.
+    audit_summaries = None
+    if os.environ.get("MEGBA_BENCH_AUDIT") == "1":
+        # Context rides with the summaries: unlike the CLI gate, this
+        # embed lowers on THIS process's backend and x64 setting — on a
+        # TPU backend with x64 off the dtype census is vacuous and the
+        # cost metrics are not comparable to the (CPU, x64-on)
+        # ANALYSIS_BUDGET.json.  `audit --check` is the gate; this is
+        # the bench line's descriptive snapshot, labeled as such.
+        # Never let a failed audit discard a finished measurement: the
+        # timing loop already ran, so ANY embed error (import included)
+        # becomes data in the line rather than a crashed bench.
+        try:
+            from megba_tpu.analysis import program_audit
+
+            # The SPMD program needs a 2-device mesh; a single-device
+            # bench topology audits just the single-device program (the
+            # audit CLI lane always forces >= 2 virtual CPU devices).
+            names = ["ba_single_f32"]
+            if len(jax.devices()) >= 2:
+                names.append("ba_sharded_w2_f32")
+            audit_summaries = {
+                "backend": backend,
+                "x64": bool(jax.config.jax_enable_x64),
+                "gate": "python -m megba_tpu.analysis.audit --check",
+                "programs": {
+                    name: audit.summary()
+                    for name, audit in program_audit.audit_all(names).items()
+                },
+            }
+        except Exception as exc:  # audit must not kill the bench line
+            audit_summaries = {
+                "backend": backend,
+                "error": f"{type(exc).__name__}: {exc}",
+            }
     print(
         json.dumps(
             {
@@ -351,6 +391,8 @@ def main() -> None:
                                "calls": d["calls"]}
                         for name, d in timer.as_dict().items()
                     },
+                    # analysis/program_audit summaries (MEGBA_BENCH_AUDIT=1).
+                    "program_audit": audit_summaries,
                 },
             }
         )
@@ -370,7 +412,7 @@ def main() -> None:
                 "num_edges_padded": int(args[2].shape[-1]),
                 "world_size": 1,
                 "bench_config": CONFIG,
-            }), telemetry)
+            }, audit=audit_summaries), telemetry)
 
 
 if __name__ == "__main__":

@@ -14,6 +14,12 @@ package is the enforcement layer:
   trigger unexpected recompiles.
 - `analysis.strict_dtype` — the sanitizer lane: a small end-to-end solve
   under `jax_numpy_dtype_promotion=strict` + `jax_debug_nans`.
+- `analysis.program_audit` (+ `hlo`, `budget`) — the compiled-program
+  auditor: AOT-lowers the canonical solver programs and audits the
+  StableHLO / optimized HLO for host transfers, the per-PCG-iteration
+  collective pattern, dtype leaks and materialised donation, plus an
+  AOT FLOP/byte budget gate against the committed ANALYSIS_BUDGET.json.
+  CLI: `python -m megba_tpu.analysis.audit --check` / `--update`.
 
 Suppress a single lint finding with an inline `# megba: allow-<rule>`
 pragma on the flagged line; mark a function that is only ever called
@@ -31,6 +37,9 @@ _EXPORTS = {
     "RetraceError": "retrace", "RetraceSentinel": "retrace",
     "note_trace": "retrace", "sentinel": "retrace", "traced": "retrace",
     "strict_promotion": "strict_dtype",
+    "ProgramAudit": "program_audit", "ProgramSpec": "program_audit",
+    "audit_all": "program_audit", "audit_program": "program_audit",
+    "program_specs": "program_audit",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -1,0 +1,133 @@
+"""AOT FLOP/byte budget baseline for the canonical solver programs.
+
+The committed `ANALYSIS_BUDGET.json` (repo root) records, per canonical
+program, the XLA AOT cost model's view of the compiled executable:
+FLOPs, bytes accessed, peak temp allocation, and the collective census
+totals.  `python -m megba_tpu.analysis.audit --check` re-measures and
+fails on any tolerance-breaking drift — a refactor that doubles the
+Schur build's FLOPs, fattens the PCG's transient memory, or adds a
+collective fails CI without running a single benchmark;
+`--update` re-baselines after an intentional change.
+
+Tolerances are per-metric: the continuous cost-model metrics get a
+relative band (default 15%, both directions — an unrecorded 2x
+improvement is also a baseline that no longer describes the program);
+the discrete collective counts are exact (one extra all-reduce IS the
+regression this layer exists to catch).  All stdlib, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+SCHEMA = "megba_tpu.analysis_budget/v1"
+
+# metric name -> relative tolerance (0.0 = exact match required).
+TOLERANCES: Dict[str, float] = {
+    "flops": 0.15,
+    "bytes_accessed": 0.15,
+    "peak_temp_bytes": 0.15,
+    "argument_bytes": 0.15,
+    "output_bytes": 0.15,
+    "all_reduce_count": 0.0,
+    "other_collective_count": 0.0,
+}
+
+
+def default_baseline_path() -> str:
+    """The committed baseline at the repo root."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)),
+                        "ANALYSIS_BUDGET.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, Dict[str, float]]:
+    """program -> metric -> value.  {} when the file does not exist."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        doc = json.load(fh)
+    return doc.get("programs", {})
+
+
+def write_baseline(measured: Dict[str, Dict[str, float]],
+                   path: Optional[str] = None,
+                   meta: Optional[Dict[str, str]] = None) -> str:
+    path = path or default_baseline_path()
+    doc = {"schema": SCHEMA, "programs": measured}
+    if meta:
+        doc["meta"] = meta
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def compare(baseline: Dict[str, Dict[str, float]],
+            measured: Dict[str, Dict[str, float]],
+            tolerances: Optional[Dict[str, float]] = None) -> List[str]:
+    """Violation messages (empty = within budget), program+metric named.
+
+    A program missing from the baseline, or a baseline program no longer
+    measured, is itself a violation: the committed budget must describe
+    exactly the canonical program set (run `--update` to re-baseline).
+    """
+    tolerances = TOLERANCES if tolerances is None else tolerances
+    out: List[str] = []
+    for prog in sorted(measured):
+        if prog not in baseline:
+            out.append(
+                f"{prog}: not in ANALYSIS_BUDGET.json baseline "
+                "(new program? run `audit --update`)")
+            continue
+        base = baseline[prog]
+        for metric in sorted(measured[prog]):
+            tol = tolerances.get(metric)
+            if tol is None:
+                continue  # informational metric, not gated
+            got = float(measured[prog][metric])
+            if metric not in base:
+                out.append(f"{prog}: metric {metric} missing from "
+                           "baseline (run `audit --update`)")
+                continue
+            want = float(base[metric])
+            if tol == 0.0:
+                if got != want:
+                    out.append(
+                        f"{prog}: {metric} changed {want:g} -> {got:g} "
+                        "(exact-match metric; an added/removed collective "
+                        "must be intentional — re-baseline with --update)")
+                continue
+            ref = max(abs(want), 1.0)
+            drift = (got - want) / ref
+            if drift > tol:
+                out.append(
+                    f"{prog}: {metric} regressed {want:g} -> {got:g} "
+                    f"(+{100 * drift:.1f}% > {100 * tol:.0f}% budget)")
+            elif drift < -tol:
+                out.append(
+                    f"{prog}: {metric} dropped {want:g} -> {got:g} "
+                    f"({100 * drift:.1f}%; unrecorded improvement — "
+                    "re-baseline with --update)")
+        # Gated metrics the baseline pins but this run could not measure
+        # (backend without cost/memory analysis): the gate must degrade
+        # LOUDLY — a silent skip would disarm the budget, and comparing
+        # a sentinel would read as a fake 100% improvement.
+        for metric in sorted(base):
+            if metric in measured[prog]:
+                continue
+            if tolerances.get(metric) is None:
+                continue
+            out.append(
+                f"{prog}: {metric} unavailable on this backend (baseline "
+                f"pins {float(base[metric]):g}; gate cannot run — audit "
+                "on a cost-model-capable backend, or `--update` there)")
+    for prog in sorted(baseline):
+        if prog not in measured:
+            out.append(
+                f"{prog}: in ANALYSIS_BUDGET.json but no longer audited "
+                "(removed program? run `audit --update`)")
+    return out

@@ -1,0 +1,272 @@
+"""Textual census passes over lowered StableHLO / compiled HLO.
+
+The compiled-program auditor (analysis/program_audit.py) works on two
+artifacts of one jitted solve program, both plain text:
+
+- the **StableHLO** module from `jax.jit(...).lower(...).as_text()` —
+  pre-optimization, so every effectful op the traced Python emitted is
+  still present (host callbacks cannot be DCE'd away) and every weak
+  Python scalar that materialised as a wide constant is still visible;
+- the **optimized HLO** from `.compile().as_text()` — post-DCE/fusion
+  truth of what actually runs, whose op `metadata={op_name=...}` carries
+  the `jax.named_scope` path (e.g. `megba.pcg/megba.pcg_core/while/
+  body/psum`), which is how collectives are attributed to the PCG inner
+  loop without any private JAX API.
+
+Everything here is stdlib-only string analysis: no jax import, no
+execution, no dialect bindings — the parsers accept the exact textual
+forms jaxlib 0.4.x prints and degrade to "op not recognised" (never a
+crash) on anything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Collective op mnemonics, normalised to underscore form.  StableHLO
+# spells them `stablehlo.all_reduce`; optimized HLO spells them
+# `all-reduce` (plus the async `-start`/`-done` pair forms).
+COLLECTIVE_KINDS = (
+    "all_reduce", "all_gather", "all_to_all", "collective_permute",
+    "reduce_scatter", "collective_broadcast",
+)
+
+# custom_call targets that move data between host and device (or name a
+# host callback).  Compute custom_calls (lapack_*, cu*, Sharding
+# annotations) do not match.
+_TRANSFER_TARGET_RE = re.compile(
+    r"callback|host_|_host|infeed|outfeed|xla_ffi_partial_buffer",
+    re.IGNORECASE)
+
+# Op kinds that are host transfers by construction.
+_TRANSFER_KINDS = frozenset(
+    {"infeed", "outfeed", "send", "recv", "send_done", "recv_done"})
+
+
+@dataclasses.dataclass(frozen=True)
+class HloOp:
+    """One interesting op occurrence in an HLO/StableHLO text module."""
+
+    kind: str  # normalised mnemonic, e.g. "all_reduce", "custom_call"
+    line: int  # 1-based line number in the module text
+    text: str  # the stripped source line (truncated for reporting)
+    while_depth: int = 0  # enclosing `stablehlo.while` regions (StableHLO)
+    target: Optional[str] = None  # custom_call target, when present
+    op_name: Optional[str] = None  # compiled-HLO metadata scope path
+    result_dtype: Optional[str] = None
+    result_elems: Optional[int] = None
+
+    def where(self) -> str:
+        scope = f" [{self.op_name}]" if self.op_name else ""
+        tgt = f" @{self.target}" if self.target else ""
+        return f"line {self.line}: {self.kind}{tgt}{scope}"
+
+
+_STRING_RE = re.compile(r'"[^"]*"')
+_SHLO_OP_RE = re.compile(r'"?stablehlo\.(\w+)"?')
+_SHLO_TARGET_RE = re.compile(
+    r'stablehlo\.custom_call\s+@([\w.\-]+)|call_target_name\s*=\s*"([^"]+)"')
+_TENSOR_RE = re.compile(r"tensor<((?:\d+x)*)([a-z][a-z0-9]*)>")
+
+
+def _dims_elems(dims: str) -> int:
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    return n
+
+
+def parse_stablehlo_ops(text: str) -> List[HloOp]:
+    """Scan a StableHLO module for ops, tracking while-region nesting.
+
+    Only op-defining lines are recorded (one op per line in jax's pretty
+    printer).  `while_depth` counts enclosing `stablehlo.while` regions,
+    so depth >= 1 means "inside some loop body/cond".
+    """
+    ops: List[HloOp] = []
+    depth = 0  # brace depth, strings stripped
+    # Each entry: [brace depth at the `while` line, region-opened flag].
+    # The regions (`cond { ... } do { ... }`) open on LATER lines, so a
+    # frame only becomes poppable once depth has risen above its
+    # threshold — otherwise the while line itself would pop it.
+    while_stack: List[List] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        # Strip string literals for BRACE counting only (attr strings can
+        # contain braces); ops are matched on the raw line — the generic
+        # print form quotes the op name (`"stablehlo.all_reduce"(...)`).
+        line = _STRING_RE.sub('""', raw)
+        m = _SHLO_OP_RE.search(raw)
+        if m:
+            kind = m.group(1)
+            target = None
+            if kind == "custom_call":
+                tm = _SHLO_TARGET_RE.search(raw)
+                if tm:
+                    target = tm.group(1) or tm.group(2)
+            rd, re_ = _stablehlo_result(line)
+            ops.append(HloOp(
+                kind=kind, line=lineno, text=raw.strip()[:200],
+                while_depth=len(while_stack), target=target,
+                result_dtype=rd, result_elems=re_))
+            if kind == "while":
+                opens, closes = line.count("{"), line.count("}")
+                if not (opens and opens == closes):
+                    # jax's pretty form opens the regions on LATER lines
+                    # (push unopened); the generic one-line form
+                    # `"stablehlo.while"(...) ({...}, {...})` is fully
+                    # self-contained — pushing it would leak a frame
+                    # (net brace delta 0 never pops), so skip it.
+                    while_stack.append([depth, opens > closes])
+        depth += line.count("{") - line.count("}")
+        while while_stack:
+            threshold, opened = while_stack[-1]
+            if not opened:
+                if depth > threshold:
+                    while_stack[-1][1] = True
+                break
+            if depth <= threshold:
+                while_stack.pop()
+            else:
+                break
+    return ops
+
+
+def _stablehlo_result(line: str) -> Tuple[Optional[str], Optional[int]]:
+    """Element dtype/count of an op line's (last) result tensor type."""
+    # Result types trail the op: `... : (in) -> tensor<...>` or
+    # `... : tensor<...>`; take the last tensor token on the line.
+    matches = _TENSOR_RE.findall(line)
+    if not matches:
+        return None, None
+    dims, dtype = matches[-1]
+    return dtype, _dims_elems(dims)
+
+
+# Optimized-HLO op definitions: `%name = f32[9,24]{1,0} all-reduce(...)`.
+# The result may be a TUPLE type `(f32[..]{..}, s32[..]{..})` — XLA's
+# AllReduceCombiner emits combined collectives in exactly that form, so
+# the tuple alternative must come first or a merged all-reduce would be
+# invisible to the census.
+# (scalar result types like `f32[]` match the empty-bracket form too).
+_HLO_DEF_RE = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+([a-z][a-z0-9\-]*)\(")
+_HLO_TYPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+_HLO_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+
+
+def parse_compiled_ops(text: str) -> List[HloOp]:
+    """Scan an optimized-HLO module for op definitions with metadata."""
+    ops: List[HloOp] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        m = _HLO_DEF_RE.search(raw)
+        if not m:
+            continue
+        kind = m.group(2).replace("-", "_")
+        # The async pair forms count once, at the -start op.
+        if kind.endswith("_done"):
+            kind_base = kind[:-5]
+            if kind_base in COLLECTIVE_KINDS:
+                continue
+        if kind.endswith("_start"):
+            kind = kind[:-6]
+        tm = _HLO_TYPE_RE.search(m.group(1))
+        rd = tm.group(1) if tm else None
+        re_ = _dims_elems(tm.group(2).replace(",", "x")) if tm else None
+        nm = _OP_NAME_RE.search(raw)
+        tg = _HLO_TARGET_RE.search(raw)
+        ops.append(HloOp(
+            kind=kind, line=lineno, text=raw.strip()[:200],
+            target=tg.group(1) if tg else None,
+            op_name=nm.group(1) if nm else None,
+            result_dtype=rd, result_elems=re_))
+    return ops
+
+
+def transfer_ops(ops: Iterable[HloOp],
+                 allow: Sequence[str] = ()) -> List[HloOp]:
+    """Host-transfer ops: infeed/outfeed/send/recv + callback custom_calls.
+
+    `allow` lists custom_call targets that are sanctioned (the
+    observability layer's trace outputs); everything else that matches
+    the transfer pattern is a violation.
+    """
+    out = []
+    for op in ops:
+        if op.kind in _TRANSFER_KINDS:
+            out.append(op)
+        elif op.kind == "custom_call" and op.target:
+            if op.target in allow:
+                continue
+            if _TRANSFER_TARGET_RE.search(op.target):
+                out.append(op)
+    return out
+
+
+def collective_ops(ops: Iterable[HloOp]) -> List[HloOp]:
+    return [op for op in ops if op.kind in COLLECTIVE_KINDS]
+
+
+def dtype_census(text: str) -> Dict[str, int]:
+    """tensor element-type -> occurrence count over a StableHLO module."""
+    census: Dict[str, int] = {}
+    for dims, dtype in _TENSOR_RE.findall(text):
+        census[dtype] = census.get(dtype, 0) + 1
+    return census
+
+
+def lines_with_dtype(text: str, dtype: str, limit: int = 5
+                     ) -> List[Tuple[int, str]]:
+    """First `limit` (lineno, line) occurrences of tensor<...x{dtype}>."""
+    needle = re.compile(r"tensor<(?:\d+x)*" + re.escape(dtype) + ">")
+    out: List[Tuple[int, str]] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        if needle.search(raw):
+            out.append((lineno, raw.strip()[:200]))
+            if len(out) >= limit:
+                break
+    return out
+
+
+# `input_output_alias={ {5}: (0, {}, may-alias), ... }` in the module
+# header: output-index-tuple -> (parameter, param_index_tuple, kind).
+_ALIAS_ENTRY_RE = re.compile(r"\{([\d,\s]*)\}:\s*\((\d+)")
+
+
+def input_output_aliases(compiled_text: str) -> List[Tuple[str, int]]:
+    """[(output_index_tuple, parameter_number)] of the entry computation.
+
+    Empty when the compiled executable materialised no aliasing (i.e.
+    declared donation was dropped).
+    """
+    # The alias map lives on the `HloModule` header line.
+    start = compiled_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    # The block nests one level of braces per entry; scan to the close
+    # (no length cap: a truncated scan would read as "donation dropped"
+    # and fail the gate with a wrong answer — the loop terminates at the
+    # matching brace anyway).
+    i = compiled_text.find("{", start)
+    depth = 0
+    block = ""
+    for j in range(i, len(compiled_text)):
+        if compiled_text[j] == "{":
+            depth += 1
+        elif compiled_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                block = compiled_text[i:j + 1]
+                break
+    if not block:
+        return []
+    return [(m.group(1).strip(), int(m.group(2)))
+            for m in _ALIAS_ENTRY_RE.finditer(block)]
+
+
+def aliased_parameters(compiled_text: str) -> frozenset:
+    """The set of entry parameters that alias some output."""
+    return frozenset(p for _, p in input_output_aliases(compiled_text))

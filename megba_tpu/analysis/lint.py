@@ -49,6 +49,31 @@ def lint_paths(paths: Iterable[str],
     return findings
 
 
+def list_suppressions(paths: Iterable[str]):
+    """Every inline `# megba: allow-<rule>` pragma under `paths`.
+
+    Returns sorted (path, line, [allow-tokens], source-line) tuples —
+    the audit trail of accumulated suppressions, so a pragma can never
+    quietly outlive the code smell it excused.  Only well-formed
+    `allow-<rule>` tokens count (a docstring's literal `allow-<rule>`
+    placeholder captures as a bare "allow-" and is not a suppression).
+    """
+    import re
+
+    well_formed = re.compile(r"allow-[A-Za-z0-9_][A-Za-z0-9_-]*$")
+    index = PackageIndex.build(paths)
+    out = []
+    for mod in index.modules.values():
+        for lineno in range(1, len(mod.source_lines) + 1):
+            allows = sorted(
+                t for t in pragmas_on_line(mod.source_lines, lineno)
+                if well_formed.fullmatch(t))
+            if allows:
+                out.append((mod.path, lineno, allows,
+                            mod.source_lines[lineno - 1].strip()))
+    return sorted(out)
+
+
 def run_lint(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m megba_tpu.analysis.lint",
@@ -60,6 +85,9 @@ def run_lint(argv: Optional[Sequence[str]] = None) -> int:
                         help="run only this rule (repeatable)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule ids and exit")
+    parser.add_argument("--list-suppressions", action="store_true",
+                        help="print every inline `# megba: allow-<rule>` "
+                             "pragma under the given paths with file:line")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -69,6 +97,16 @@ def run_lint(argv: Optional[Sequence[str]] = None) -> int:
     if not args.paths:
         parser.print_usage(sys.stderr)
         return 2
+    if args.list_suppressions:
+        try:
+            found = list_suppressions(args.paths)
+        except ValueError as exc:  # bad path: usage error, not traceback
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        for path, lineno, allows, source in found:
+            print(f"{path}:{lineno}: {', '.join(allows)} | {source}")
+        print(f"{len(found)} suppression(s)", file=sys.stderr)
+        return 0
     try:
         findings = lint_paths(args.paths, rules=args.rules)
     except ValueError as exc:

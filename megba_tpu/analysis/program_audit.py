@@ -1,0 +1,351 @@
+"""Compiled-program auditor: static CI gates on the lowered executables.
+
+PR 2's AST linter sees Python source; this layer sees what XLA actually
+emitted.  Each canonical solver program — the single-device `flat_solve`
+program, its tiled variant, the sharded SPMD program from
+`parallel/mesh.py`, and the PGO program (single + sharded) — is
+AOT-lowered on small synthetic problems via the production entry points
+themselves (`flat_solve(..., lower_only=True)` / `solve_pgo(...,
+lower_only=True)`: same host prep, same jit caches, same donation
+flags), compiled, and audited in four passes:
+
+1. **transfer-freedom** — walk the StableHLO for host callbacks /
+   infeed / outfeed / send / recv custom_calls; any occurrence outside
+   the observability-sanctioned targets fails (MegBA's contract: one
+   fused device program per solve, zero host round-trips — arxiv
+   2112.01349 §4).
+2. **collective census** — enumerate all-reduce / all-gather /
+   collective-permute ops in the *optimized* HLO (post-DCE truth),
+   attribute them to program regions via the `jax.named_scope` paths in
+   op metadata, and compare against the analytic per-PCG-iteration
+   expectation: exactly TWO reductions inside the PCG while body for
+   the Schur solve (hlp + hpl per S·p product), ONE for PGO's
+   matrix-free H·x.  An accidental extra sync is a lint failure with
+   the offending op named.
+3. **dtype census + donation** — no f64 tensor in an f32 solve (and
+   vice versa; weak Python literals that materialise as wide constants
+   count), and every declared donation must have materialised as
+   input-output aliasing in the compiled executable.
+4. **budget gate** — `cost_analysis()` FLOPs / bytes-accessed and
+   `memory_analysis()` peak temp size against the committed
+   `ANALYSIS_BUDGET.json` (analysis/budget.py): >15% drift fails,
+   collective-count changes fail exactly.
+
+The CLI lives in `python -m megba_tpu.analysis.audit` (--check /
+--update); scripts/lint.sh runs it as gate 4.  Everything is
+CPU-lowered: passes run without executing a single solver FLOP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from megba_tpu.analysis import hlo
+
+# Scope-path fragment that marks the PCG inner loop's body in compiled
+# op metadata (jax.named_scope "megba.pcg_core" + the while lowering).
+PCG_BODY_MARK = "megba.pcg_core/while/body"
+
+# custom_call targets the observability layer is allowed to emit (the
+# sanctioned trace outputs).  The canonical audited programs are built
+# verbose=False so none should appear at all, but the allowance keeps
+# the pass honest if a sanctioned trace output ever becomes part of a
+# canonical program.
+SANCTIONED_TRANSFER_TARGETS: Tuple[str, ...] = ()
+
+_WRONG_FAMILY = {
+    "f32": ("f64", "bf16", "f16"),
+    "f64": ("f32", "bf16", "f16"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One canonical program: how to lower it + its audited invariants."""
+
+    name: str
+    float_family: str  # "f32" | "f64" — every float tensor must be this
+    world: int  # mesh size; 1 => no collectives allowed at all
+    pcg_psums: int  # all-reduces expected inside the PCG while body
+    donate_leaves: Tuple[int, ...]  # flat params declared donated
+    build: Callable[[], object]  # () -> jax.stages.Lowered
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    """Artifacts + derived census of one lowered/compiled program."""
+
+    spec: ProgramSpec
+    stablehlo: str
+    compiled_text: str
+    flops: float
+    bytes_accessed: float
+    peak_temp_bytes: float
+    argument_bytes: float
+    output_bytes: float
+
+    @functools.cached_property
+    def stablehlo_ops(self) -> List[hlo.HloOp]:
+        return hlo.parse_stablehlo_ops(self.stablehlo)
+
+    @functools.cached_property
+    def compiled_ops(self) -> List[hlo.HloOp]:
+        return hlo.parse_compiled_ops(self.compiled_text)
+
+    @functools.cached_property
+    def collectives(self) -> List[hlo.HloOp]:
+        return hlo.collective_ops(self.compiled_ops)
+
+    # ---- pass 1: transfer freedom ------------------------------------
+    def transfer_violations(self) -> List[str]:
+        bad = hlo.transfer_ops(self.stablehlo_ops,
+                               allow=SANCTIONED_TRANSFER_TARGETS)
+        return [
+            f"{self.spec.name}: host transfer in compiled program — "
+            f"{op.where()} :: {op.text[:120]}"
+            for op in bad
+        ]
+
+    # ---- pass 2: collective census -----------------------------------
+    def pcg_body_collectives(self) -> List[hlo.HloOp]:
+        return [op for op in self.collectives
+                if op.op_name and PCG_BODY_MARK in op.op_name]
+
+    def collective_violations(self) -> List[str]:
+        out: List[str] = []
+        if self.spec.world == 1:
+            for op in self.collectives:
+                out.append(
+                    f"{self.spec.name}: collective in a single-device "
+                    f"program — {op.where()}")
+            return out
+        non_ar = [op for op in self.collectives if op.kind != "all_reduce"]
+        for op in non_ar:
+            out.append(
+                f"{self.spec.name}: unexpected collective kind (psum is "
+                f"the only prescribed sync) — {op.where()}")
+        pcg = self.pcg_body_collectives()
+        if len(pcg) != self.spec.pcg_psums:
+            ops = "\n".join(f"    {op.where()}" for op in pcg) or "    (none)"
+            out.append(
+                f"{self.spec.name}: {len(pcg)} all-reduce(s) inside the "
+                f"PCG while body, analytic expectation is "
+                f"{self.spec.pcg_psums} per CG step "
+                f"(MegBA per-iteration collective pattern):\n{ops}")
+        return out
+
+    # ---- pass 3: dtype census + donation -----------------------------
+    def dtype_violations(self) -> List[str]:
+        census = hlo.dtype_census(self.stablehlo)
+        out: List[str] = []
+        for wrong in _WRONG_FAMILY[self.spec.float_family]:
+            n = census.get(wrong, 0)
+            if not n:
+                continue
+            sites = hlo.lines_with_dtype(self.stablehlo, wrong, limit=3)
+            where = "\n".join(f"    line {ln}: {txt[:140]}"
+                              for ln, txt in sites)
+            out.append(
+                f"{self.spec.name}: {n} {wrong} tensor occurrence(s) in "
+                f"a {self.spec.float_family} solve (dtype leak):\n{where}")
+        return out
+
+    def donation_violations(self) -> List[str]:
+        got = hlo.aliased_parameters(self.compiled_text)
+        want = frozenset(self.spec.donate_leaves)
+        out: List[str] = []
+        missing = sorted(want - got)
+        if missing:
+            out.append(
+                f"{self.spec.name}: declared donation of parameter(s) "
+                f"{missing} did not materialise as input-output aliasing "
+                "in the compiled executable (buffer savings silently "
+                "lost; did an output stop aliasing its input?)")
+        unexpected = sorted(got - want)
+        if unexpected:
+            out.append(
+                f"{self.spec.name}: parameter(s) {unexpected} alias "
+                "outputs without a declared donation (audit expectation "
+                "out of date — update ProgramSpec.donate_leaves)")
+        return out
+
+    # ---- pass 4: budget metrics --------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        other = [op for op in self.collectives if op.kind != "all_reduce"]
+        out = {
+            "flops": float(self.flops),
+            "bytes_accessed": float(self.bytes_accessed),
+            "peak_temp_bytes": float(self.peak_temp_bytes),
+            "argument_bytes": float(self.argument_bytes),
+            "output_bytes": float(self.output_bytes),
+        }
+        # A backend without cost/memory analysis yields -1 sentinels:
+        # OMIT those rather than letting "-1" flow into the budget gate
+        # as a measurement (budget.compare reports a gated metric that
+        # went missing, so the gate degrades loudly, not silently).
+        out = {k: v for k, v in out.items() if v >= 0.0}
+        out["all_reduce_count"] = float(
+            sum(1 for op in self.collectives if op.kind == "all_reduce"))
+        out["other_collective_count"] = float(len(other))
+        return out
+
+    def violations(self) -> List[str]:
+        return (self.transfer_violations() + self.collective_violations()
+                + self.dtype_violations() + self.donation_violations())
+
+    def summary(self) -> Dict[str, object]:
+        """JSON-able per-program audit summary (for bench.py and
+        SolveReport embedding)."""
+        pcg = self.pcg_body_collectives()
+        return {
+            "program": self.spec.name,
+            "metrics": self.metrics(),
+            "pcg_body_all_reduces": len(pcg),
+            "collectives": [
+                {"kind": op.kind, "elems": op.result_elems,
+                 "dtype": op.result_dtype, "scope": op.op_name}
+                for op in self.collectives
+            ],
+            "violations": self.violations(),
+        }
+
+
+# --------------------------------------------------------------------------
+# Canonical programs.  Sizes are deliberately tiny (lowering cost, not
+# solve cost, dominates) but non-degenerate: enough edges to pad to one
+# EDGE_QUANTUM per shard, both loops live, every psum site reachable.
+# --------------------------------------------------------------------------
+
+def _ba_problem():
+    from megba_tpu.io.synthetic import make_synthetic_bal
+
+    return make_synthetic_bal(
+        num_cameras=4, num_points=24, obs_per_point=3, seed=0,
+        param_noise=4e-2, pixel_noise=0.3, dtype=np.float32)
+
+
+def _ba_option():
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+
+    return ProblemOption(
+        dtype=np.float32,
+        algo_option=AlgoOption(max_iter=3),
+        solver_option=SolverOption(max_iter=8, tol=1e-8))
+
+
+def _lower_ba(world: int, use_tiled: bool):
+    import dataclasses as _dc
+
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    s = _ba_problem()
+    option = _ba_option()
+    if world > 1:
+        option = _dc.replace(option, world_size=world)
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                      option, use_tiled=use_tiled, lower_only=True)
+
+
+def _lower_pgo(world: int):
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.models.pgo import make_synthetic_pose_graph, solve_pgo
+
+    g = make_synthetic_pose_graph(num_poses=16, loop_closures=4, seed=1)
+    option = ProblemOption(
+        dtype=np.float64, world_size=world,
+        algo_option=AlgoOption(max_iter=3),
+        solver_option=SolverOption(max_iter=8, tol=1e-10))
+    return solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option,
+                     lower_only=True)
+
+
+def _sharded_donation() -> Tuple[int, ...]:
+    # Donation of the replicated parameter blocks is gated off under the
+    # experimental shard_map fallback (freed-buffer aliasing hazard —
+    # parallel/mesh.py); the audit expects exactly what production does.
+    from megba_tpu.parallel.mesh import SHARD_MAP_NATIVE
+
+    return (0, 1) if SHARD_MAP_NATIVE else ()
+
+
+def _pgo_sharded_donation() -> Tuple[int, ...]:
+    from megba_tpu.parallel.mesh import SHARD_MAP_NATIVE
+
+    return (0,) if SHARD_MAP_NATIVE else ()
+
+
+def program_specs() -> Dict[str, ProgramSpec]:
+    """name -> spec for every canonical audited program."""
+    return {
+        "ba_single_f32": ProgramSpec(
+            name="ba_single_f32", float_family="f32", world=1, pcg_psums=0,
+            donate_leaves=(0, 1),
+            build=lambda: _lower_ba(world=1, use_tiled=False)),
+        "ba_tiled_f32": ProgramSpec(
+            name="ba_tiled_f32", float_family="f32", world=1, pcg_psums=0,
+            donate_leaves=(0, 1),
+            build=lambda: _lower_ba(world=1, use_tiled=True)),
+        "ba_sharded_w2_f32": ProgramSpec(
+            name="ba_sharded_w2_f32", float_family="f32", world=2,
+            # Schur S·p = Hpp p - Hpl Hll^-1 Hlp p: one psum in hlp, one
+            # in hpl — exactly two reductions per CG step (solver/pcg.py).
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            build=lambda: _lower_ba(world=2, use_tiled=False)),
+        "pgo_single_f64": ProgramSpec(
+            name="pgo_single_f64", float_family="f64", world=1, pcg_psums=0,
+            donate_leaves=(0,),
+            build=lambda: _lower_pgo(world=1)),
+        "pgo_sharded_w2_f64": ProgramSpec(
+            name="pgo_sharded_w2_f64", float_family="f64", world=2,
+            # PGO's matrix-free H·x has a single segment-reduce psum
+            # (models/pgo.py matvec) — one reduction per CG step.
+            pcg_psums=1,
+            donate_leaves=_pgo_sharded_donation(),
+            build=lambda: _lower_pgo(world=2)),
+    }
+
+
+def audit_program(spec: ProgramSpec,
+                  lowered: Optional[object] = None) -> ProgramAudit:
+    """Lower (unless given), compile, and census one canonical program."""
+    lowered = spec.build() if lowered is None else lowered
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jaxlib: one dict per device
+        ca = ca[0] if ca else {}
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # backend without memory analysis
+        mem = None
+    return ProgramAudit(
+        spec=spec,
+        stablehlo=lowered.as_text(),
+        compiled_text=compiled.as_text(),
+        flops=float(ca.get("flops", -1.0)),
+        bytes_accessed=float(ca.get("bytes accessed", -1.0)),
+        peak_temp_bytes=float(
+            getattr(mem, "temp_size_in_bytes", -1) if mem else -1),
+        argument_bytes=float(
+            getattr(mem, "argument_size_in_bytes", -1) if mem else -1),
+        output_bytes=float(
+            getattr(mem, "output_size_in_bytes", -1) if mem else -1),
+    )
+
+
+def audit_all(names: Optional[List[str]] = None) -> Dict[str, ProgramAudit]:
+    specs = program_specs()
+    if names:
+        unknown = sorted(set(names) - set(specs))
+        if unknown:
+            raise ValueError(
+                f"unknown program(s) {unknown}; known: {sorted(specs)}")
+        specs = {n: specs[n] for n in names}
+    return {name: audit_program(spec) for name, spec in specs.items()}

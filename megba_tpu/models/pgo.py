@@ -188,6 +188,7 @@ def solve_pgo(
     verbose: bool = False,
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
+    lower_only: bool = False,
 ) -> PGOResult:
     """Solve an SE(3) pose graph.  PUBLIC edge-major boundary.
 
@@ -205,6 +206,10 @@ def solve_pgo(
     `option.robust_kind`/`robust_delta` enable IRLS robust losses
     (Huber/Cauchy, ops/robust.py) — the standard defence against bad
     loop closures; `result.cost` is then Sum rho.
+
+    `lower_only=True` returns the `jax.stages.Lowered` of the exact PGO
+    program this call would dispatch (auditor hook,
+    analysis/program_audit.py; single-process only).
     """
     option = option or ProblemOption()
     if option.telemetry is not None:
@@ -278,6 +283,11 @@ def solve_pgo(
     args = [poses_fm, fixed_np, ei, ej, meas_fm,
             jnp.asarray(region0, dtype), jnp.asarray(v0, dtype),
             jnp.asarray(next_verbose_token(), jnp.int32), *extras]
+    if lower_only:
+        # Auditor hook (analysis/program_audit.py): the Lowered of the
+        # exact PGO program this call would dispatch, shared host prep
+        # and all.  Single-process only.
+        return prog.lower(*args)
     if mesh is not None:
         from megba_tpu.parallel.multihost import dispatch_on_mesh
 

@@ -42,8 +42,11 @@ def angle_axis_rotate_point(angle_axis: jnp.ndarray, pt: jnp.ndarray) -> jnp.nda
     theta2 = jnp.dot(angle_axis, angle_axis)
     safe = theta2 > _SMALL_ANGLE
     # Guard against 0-divide inside the untaken branch (both branches are
-    # always evaluated under jit).
-    theta2_safe = jnp.where(safe, theta2, 1.0)
+    # always evaluated under jit).  ones_like, not Python 1.0: a weak
+    # literal in a `where` branch materialises as a wide (f64-under-x64)
+    # constant — a dtype leak the compiled-program auditor
+    # (analysis/program_audit.py) bans from f32 programs.
+    theta2_safe = jnp.where(safe, theta2, jnp.ones_like(theta2))
     theta = jnp.sqrt(theta2_safe)
     cos_t = jnp.cos(theta)
     sin_t = jnp.sin(theta)
@@ -64,7 +67,8 @@ def angle_axis_to_rotation_matrix(angle_axis: jnp.ndarray) -> jnp.ndarray:
     """
     theta2 = jnp.dot(angle_axis, angle_axis)
     safe = theta2 > _SMALL_ANGLE
-    theta2_safe = jnp.where(safe, theta2, 1.0)
+    # ones_like: see angle_axis_rotate_point (weak-literal dtype leak).
+    theta2_safe = jnp.where(safe, theta2, jnp.ones_like(theta2))
     theta = jnp.sqrt(theta2_safe)
     k = angle_axis / theta
     cos_t = jnp.cos(theta)
@@ -180,7 +184,9 @@ def quaternion_to_angle_axis(q: jnp.ndarray) -> jnp.ndarray:
     w = jnp.abs(w)
     n2 = jnp.dot(vec, vec)
     small = n2 < 1e-14
-    n2_safe = jnp.where(small, 1.0, n2)  # keeps sqrt/atan2 grads finite
+    # ones_like: keeps sqrt/atan2 grads finite without a weak-literal
+    # wide constant (see angle_axis_rotate_point).
+    n2_safe = jnp.where(small, jnp.ones_like(n2), n2)
     n = jnp.sqrt(n2_safe)
     # Taylor of 2*atan2(n, w)/n around n=0: 2/w - 2 n^2 / (3 w^3).
     scale = jnp.where(

@@ -87,7 +87,11 @@ def bal_residual_jacobian_analytical_fm(  # megba: jit-entry
     # evaluated under jit; the untaken one must stay finite).
     theta2 = w0 * w0 + w1 * w1 + w2 * w2
     safe = theta2 > _SMALL_ANGLE
-    th2s = jnp.where(safe, theta2, 1.0)
+    # `one`, not Python 1.0: a weak float literal materialises as a
+    # tensor<f64> constant + convert under x64 — an f64 op inside the
+    # f32 program that the compiled-program auditor's dtype census
+    # (analysis/program_audit.py) rightly flags.
+    th2s = jnp.where(safe, theta2, one)
     th = jnp.sqrt(th2s)
     ct, st = jnp.cos(th), jnp.sin(th)
     inv_th = 1.0 / th

@@ -45,7 +45,10 @@ def rho_and_weight(
         sqrt_s = jnp.sqrt(jnp.maximum(s, 1e-30))
         rho = jnp.where(s <= d2, s, 2.0 * delta * sqrt_s - d2)
         # rho'(s) = 1 inside, delta / sqrt(s) outside.
-        w2 = jnp.where(s <= d2, 1.0, delta / sqrt_s)
+        # ones_like, not Python 1.0: a weak literal in a `where` branch
+        # lowers as a wide (f64-under-x64) constant + convert — the
+        # dtype-census pass (analysis/program_audit.py) bans those.
+        w2 = jnp.where(s <= d2, jnp.ones_like(s), delta / sqrt_s)
         return rho, jnp.sqrt(w2)
     if kind == RobustKind.CAUCHY:
         rho = d2 * jnp.log1p(s / d2)

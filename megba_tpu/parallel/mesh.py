@@ -127,6 +127,7 @@ def distributed_lm_solve(
     initial_v=None,
     jit_cache: Optional[dict] = None,
     donate: bool = False,
+    lower_only: bool = False,
 ) -> LMResult:
     """Run the full LM solve SPMD over the mesh's edge axis.
 
@@ -148,6 +149,10 @@ def distributed_lm_solve(
     operand is lifted into a global array first
     (parallel/multihost.globalize_for_mesh), so host values are required
     there anyway.
+
+    `lower_only=True` returns the `jax.stages.Lowered` of the exact SPMD
+    program this call would dispatch (auditor hook,
+    analysis/program_audit.py; single-process only).
     """
     n_edge = obs.shape[-1]
     if n_edge % mesh.devices.size != 0:
@@ -189,6 +194,12 @@ def distributed_lm_solve(
         jit_cache, _cached_sharded_solve, _build_sharded_solve,
         residual_jac_fn, mesh, option, keys, tuple(in_specs), verbose,
         cam_sorted, donate)
+
+    if lower_only:
+        # Auditor hook (analysis/program_audit.py): hand back the
+        # Lowered of the exact SPMD program this call would dispatch.
+        # Single-process only — the audit never globalizes operands.
+        return jitted.lower(*args)
 
     from megba_tpu.parallel.multihost import dispatch_on_mesh
 

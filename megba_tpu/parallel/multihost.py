@@ -21,6 +21,26 @@ import jax
 _initialized_with: Optional[Tuple] = None
 
 
+def _distributed_is_initialized() -> bool:
+    """Whether jax.distributed is already up.
+
+    `jax.distributed.is_initialized()` only exists on newer jax; on this
+    jaxlib (0.4.x) fall back to the distributed global state's client —
+    without the fallback an idempotent re-call would re-invoke
+    `jax.distributed.initialize()` after backend init, which raises
+    "must be called before any JAX computations are executed".
+    """
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if callable(probe):
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return _initialized_with is not None
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -34,8 +54,7 @@ def initialize_multihost(
     local_devices, global_devices}.
     """
     global _initialized_with
-    already = getattr(jax.distributed, "is_initialized", None)
-    initialized = callable(already) and already()
+    initialized = _distributed_is_initialized()
     explicit = any(
         a is not None for a in (coordinator_address, num_processes, process_id)
     )
@@ -70,6 +89,61 @@ def initialize_multihost(
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+def cpu_cross_process_collectives_available() -> bool:
+    """Can this jaxlib's CPU client run MULTIPROCESS computations?
+
+    The plain XLA:CPU client refuses cross-process programs outright
+    ("Multiprocess computations aren't implemented on the CPU backend")
+    unless it was created with a collectives implementation; jaxlib
+    ships gloo TCP collectives on some platforms only.  Tests gate the
+    localhost multi-process lane on this probe so a jaxlib without gloo
+    skips (naming the limitation) instead of failing tier-1.
+    """
+    import warnings
+
+    mods = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        try:  # the raw pybind module (this jaxlib's spelling)
+            from jax.lib import xla_client as _xc
+
+            mods.append(_xc._xla)
+        except Exception:
+            pass
+        try:  # newer re-export
+            from jax.lib import xla_extension as _xe
+
+            mods.append(_xe)
+        except Exception:
+            pass
+    return any(hasattr(m, "make_gloo_tcp_collectives") for m in mods)
+
+
+def enable_cpu_cross_process_collectives() -> bool:
+    """Select gloo CPU collectives for cross-process psums.
+
+    Must run BEFORE the CPU backend initialises (the collectives object
+    is wired into the client at creation, using the distributed runtime
+    client — so `initialize_multihost` must also come before the first
+    device query).  Returns False (and changes nothing) when this
+    jaxlib has no gloo support, OR when a backend is already up — the
+    flag flip would be silently ineffective then, and the caller would
+    hit the very "Multiprocess computations aren't implemented on the
+    CPU backend" failure this helper exists to prevent.
+    """
+    if not cpu_cross_process_collectives_available():
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            return False  # too late: the client was built without gloo
+    except Exception:
+        pass  # private API moved; fall through and set the flag anyway
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    return True
 
 
 def mesh_is_multiprocess(mesh) -> bool:

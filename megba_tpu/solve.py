@@ -109,6 +109,7 @@ def flat_solve(
     initial_v: Optional[float] = None,
     jit_cache: Optional[dict] = None,
     timer: Optional[PhaseTimer] = None,
+    lower_only: bool = False,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
 
@@ -143,6 +144,12 @@ def flat_solve(
     "execute" phase is timed and a SolveReport JSONL line is appended;
     with it disabled the solve stays fully asynchronous and the sink
     module is never even imported.
+
+    `lower_only=True` returns the `jax.stages.Lowered` of the exact
+    program this call would have dispatched — same host prep, same
+    operands, same jit cache — without executing it.  This is the
+    compiled-program auditor's entry point (analysis/program_audit.py):
+    what it inspects IS the production program, not a replica.
     """
     # Resolve the telemetry target here (knob wins over env), then strip
     # the knob: program caches are keyed on `option` and must stay
@@ -270,7 +277,9 @@ def flat_solve(
                 pt_fixed=pt_fixed_j,
                 verbose=verbose, cam_sorted=True, plans=plans,
                 initial_region=initial_region, initial_v=initial_v,
-                jit_cache=jit_cache, donate=True)
+                jit_cache=jit_cache, donate=True, lower_only=lower_only)
+        if lower_only:
+            return result
         result = _result_to_edge_major(result)
         _maybe_emit_report(telemetry, report_option, result, timer,
                            problem_shape)
@@ -287,12 +296,20 @@ def flat_solve(
     ir = option.algo_option.initial_region if initial_region is None else initial_region
     iv = 2.0 if initial_v is None else initial_v
 
+    # ONE operand list for both .lower() and the dispatch: the audited
+    # program must be byte-for-byte the dispatched one.  Built inside
+    # the dispatch phase so the jnp.asarray index/mask uploads stay part
+    # of the timed dispatch cost, as they always were (telemetry phase
+    # breakdowns must stay comparable across artifacts).
     with timer.phase("dispatch"):
-        result = jitted(
+        call_args = (
             cameras_fm, points_fm, obs_fm,
             jnp.asarray(cam_idx), jnp.asarray(pt_idx), jnp.asarray(mask),
             jnp.asarray(ir, dtype), jnp.asarray(iv, dtype),
             jnp.asarray(next_verbose_token(), jnp.int32), plans, *extras)
+        if lower_only:
+            return jitted.lower(*call_args)
+        result = jitted(*call_args)
     result = _result_to_edge_major(result)
     _maybe_emit_report(telemetry, report_option, result, timer,
                        problem_shape)

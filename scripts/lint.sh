@@ -1,14 +1,19 @@
 #!/bin/bash
 # Static-analysis + sanitizer lane (megba_tpu/analysis/).
 #
-# Three gates, all required (scripts/run_tests.sh invokes this, so
+# Four gates, all required (scripts/run_tests.sh invokes this, so
 # tier-1 cannot pass with a violation in any of them):
 #
 #   1. the JAX-contract linter runs CLEAN on the package;
 #   2. the linter FIRES on the seeded bad-pattern fixture (a rule that
 #      silently stops matching is itself a regression);
 #   3. the strict-dtype sanitizer lane: small end-to-end BA + PGO solves
-#      under jax_numpy_dtype_promotion=strict + jax_debug_nans.
+#      under jax_numpy_dtype_promotion=strict + jax_debug_nans;
+#   4. the compiled-program auditor: AOT-lower + compile the canonical
+#      solver programs on CPU and audit the emitted HLO for host
+#      transfers, the per-PCG-iteration collective pattern, dtype
+#      leaks, materialised donation, and FLOP/byte drift against the
+#      committed ANALYSIS_BUDGET.json (no solver execution involved).
 set -e -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -27,5 +32,8 @@ python -m megba_tpu.analysis.lint tests/data/lint_fixtures/good_patterns.py
 
 echo "[lint] strict-dtype promotion + debug-nans sanitizer lane"
 JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python -m megba_tpu.analysis.strict_dtype
+
+echo "[lint] compiled-program audit (HLO census + AOT budget gate)"
+python -m megba_tpu.analysis.audit --check
 
 echo "lint lane OK"

@@ -29,11 +29,18 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
-from megba_tpu.parallel.multihost import initialize_multihost  # noqa: E402
+from megba_tpu.parallel.multihost import (  # noqa: E402
+    enable_cpu_cross_process_collectives,
+    initialize_multihost,
+)
 
 
 def main() -> None:
     pid, port = int(sys.argv[1]), sys.argv[2]
+    # gloo CPU collectives, selected before backend init (the plain
+    # XLA:CPU client refuses multiprocess computations outright).
+    assert enable_cpu_cross_process_collectives(), \
+        "jaxlib has no gloo CPU collectives"
     info = initialize_multihost(f"localhost:{port}", 2, pid)
     world = info["global_devices"]
     assert world == 2 * _n_local, info

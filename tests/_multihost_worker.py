@@ -19,12 +19,21 @@ import jax
 # tests/conftest.py) so this worker never touches the tunnel.
 jax.config.update("jax_platforms", "cpu")
 
-from megba_tpu.parallel.multihost import initialize_multihost  # noqa: E402
+from megba_tpu.parallel.multihost import (  # noqa: E402
+    enable_cpu_cross_process_collectives,
+    initialize_multihost,
+)
 
 
 def main() -> None:
     pid, port = int(sys.argv[1]), sys.argv[2]
     addr = f"localhost:{port}"
+    # The plain XLA:CPU client refuses multiprocess computations; select
+    # gloo TCP collectives BEFORE any backend init.  The orchestrating
+    # test is skipped when this jaxlib has no gloo, so a False return
+    # here is a hard error.
+    assert enable_cpu_cross_process_collectives(), \
+        "jaxlib has no gloo CPU collectives"
     info = initialize_multihost(addr, 2, pid)
     assert info["process_count"] == 2, info
     assert info["process_index"] == pid, info

@@ -138,6 +138,35 @@ def test_cli_exit_codes():
     assert "not a directory" in gone.stderr
 
 
+def test_list_suppressions(tmp_path, capsys):
+    # The accumulated-suppressions audit trail: every real inline
+    # `# megba: allow-<rule>` pragma is listed with file:line; prose
+    # mentions of the pragma syntax (docstrings) are not suppressions.
+    from megba_tpu.analysis.lint import list_suppressions, run_lint
+
+    mod = tmp_path / "suppressed.py"
+    mod.write_text(
+        '"""Mentions `# megba: allow-<rule>` in prose only."""\n'
+        "import numpy as np\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    n = np.prod(x.shape)  # megba: allow-np-in-jit\n"
+        "    return x * n\n")
+    found = list_suppressions([str(mod)])
+    assert [(line, allows) for _, line, allows, _ in found] == [
+        (6, ["allow-np-in-jit"])]
+
+    rc = run_lint(["--list-suppressions", str(mod)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert f"{mod}:6: allow-np-in-jit" in out.out
+    assert "1 suppression(s)" in out.err
+    # The good fixture's one real pragma is found through the same path.
+    found_good = list_suppressions([GOOD])
+    assert any("allow-np-in-jit" in allows for _, _, allows, _ in found_good)
+
+
 # -------------------------------------------------------------- retrace
 
 

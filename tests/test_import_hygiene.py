@@ -18,6 +18,8 @@ def test_import_touches_no_backend():
         "import megba_tpu.parallel, megba_tpu.native\n"
         "import megba_tpu.analysis, megba_tpu.analysis.lint\n"
         "import megba_tpu.analysis.retrace, megba_tpu.analysis.strict_dtype\n"
+        "import megba_tpu.analysis.hlo, megba_tpu.analysis.budget\n"
+        "import megba_tpu.analysis.program_audit, megba_tpu.analysis.audit\n"
         "from jax._src import xla_bridge\n"
         "assert not xla_bridge.backends_are_initialized(), 'import initialized a backend'\n"
         "print('clean')\n"

@@ -1,0 +1,421 @@
+"""Compiled-program auditor (megba_tpu/analysis/program_audit.py).
+
+Two layers of coverage:
+
+- the CLEAN TREE: every canonical program passes all four audit passes
+  and the committed ANALYSIS_BUDGET.json baseline;
+- SEEDED VIOLATING PROGRAMS: each pass demonstrably fires — a
+  callback-in-jit program (transfer pass), a program with a gratuitous
+  extra psum in its PCG-scoped while body (collective census), an
+  f64-leaking f32 program (dtype census), a program whose declared
+  donation never materialises (donation pass), and an inflated budget
+  fixture (budget gate), so a pass that silently stops matching is
+  itself a test failure.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from megba_tpu.analysis import audit as audit_cli
+from megba_tpu.analysis import budget as budget_mod
+from megba_tpu.analysis import hlo, program_audit
+from megba_tpu.parallel.mesh import EDGE_AXIS, make_mesh, shard_map
+
+
+@pytest.fixture(scope="module")
+def audits():
+    """All canonical programs, lowered + compiled once per test module
+    (the persistent compile cache makes repeat runs cheap)."""
+    return program_audit.audit_all()
+
+
+def _fake_spec(**kw):
+    base = dict(name="seeded", float_family="f32", world=1, pcg_psums=0,
+                donate_leaves=(), build=lambda: None)
+    base.update(kw)
+    return program_audit.ProgramSpec(**base)
+
+
+def _audit_of(spec, lowered, compiled=None):
+    return program_audit.ProgramAudit(
+        spec=spec,
+        stablehlo=lowered.as_text(),
+        compiled_text="" if compiled is None else compiled.as_text(),
+        flops=-1.0, bytes_accessed=-1.0, peak_temp_bytes=-1.0,
+        argument_bytes=-1.0, output_bytes=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# Clean tree
+# ---------------------------------------------------------------------------
+
+def test_clean_tree_every_pass_green(audits):
+    for name, audit in audits.items():
+        assert audit.violations() == [], (
+            f"{name} violates the compiled-program contract")
+
+
+def test_clean_tree_matches_committed_budget(audits):
+    baseline = budget_mod.load_baseline()
+    assert baseline, "ANALYSIS_BUDGET.json missing — run audit --update"
+    measured = {n: a.metrics() for n, a in audits.items()}
+    assert budget_mod.compare(baseline, measured) == []
+
+
+def test_collective_census_matches_analytic_expectation(audits):
+    # Two reductions per CG step for the Schur solve (hlp + hpl inside
+    # S·p), one for PGO's matrix-free H·x; single-device programs carry
+    # no collectives at all.
+    assert len(audits["ba_sharded_w2_f32"].pcg_body_collectives()) == 2
+    assert len(audits["pgo_sharded_w2_f64"].pcg_body_collectives()) == 1
+    for name in ("ba_single_f32", "ba_tiled_f32", "pgo_single_f64"):
+        assert audits[name].collectives == [], name
+    # psum is the only prescribed collective: everything the SPMD
+    # programs emit is an all-reduce.
+    for name in ("ba_sharded_w2_f32", "pgo_sharded_w2_f64"):
+        kinds = {op.kind for op in audits[name].collectives}
+        assert kinds == {"all_reduce"}, (name, kinds)
+
+
+def test_donation_materialised_in_compiled_executables(audits):
+    # flat_solve donates (cameras, points); solve_pgo donates poses.
+    assert hlo.aliased_parameters(
+        audits["ba_single_f32"].compiled_text) == {0, 1}
+    assert hlo.aliased_parameters(
+        audits["pgo_single_f64"].compiled_text) == {0}
+
+
+def test_summary_is_json_roundtrippable(audits):
+    for audit in audits.values():
+        doc = json.loads(json.dumps(audit.summary(), sort_keys=True))
+        assert doc["program"] == audit.spec.name
+        assert doc["violations"] == []
+        assert doc["metrics"]["flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Pass 1 seeded violation: a callback inside a jitted program
+# ---------------------------------------------------------------------------
+
+def test_transfer_pass_fires_on_callback_in_jit():
+    def leaky(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2.0,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1.0
+
+    lowered = jax.jit(leaky).lower(np.ones((4,), np.float32))
+    audit = _audit_of(_fake_spec(name="seeded_callback"), lowered)
+    bad = audit.transfer_violations()
+    assert bad, "callback-in-jit must fail the transfer pass"
+    assert "callback" in bad[0]
+    assert "seeded_callback" in bad[0]
+
+
+def test_transfer_pass_fires_on_verbose_program():
+    # The REAL production path: a verbose=True solve carries the
+    # observability iteration-line callback — the audit must see it
+    # (canonical audited programs are verbose=False and stay clean).
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.io.synthetic import make_synthetic_bal
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    s = make_synthetic_bal(num_cameras=3, num_points=12, obs_per_point=3,
+                           seed=2, dtype=np.float32)
+    option = ProblemOption(dtype=np.float32,
+                           algo_option=AlgoOption(max_iter=2),
+                           solver_option=SolverOption(max_iter=4))
+    lowered = flat_solve(
+        make_residual_jacobian_fn(), s.cameras0, s.points0, s.obs,
+        s.cam_idx, s.pt_idx, option, use_tiled=False, verbose=True,
+        lower_only=True)
+    ops = hlo.parse_stablehlo_ops(lowered.as_text())
+    assert hlo.transfer_ops(ops), "verbose program must show its callback"
+
+
+# ---------------------------------------------------------------------------
+# Pass 2 seeded violation: a gratuitous extra psum per CG step
+# ---------------------------------------------------------------------------
+
+def _pcg_like_program(n_psums: int):
+    """A shard_map'ed program with `n_psums` psums inside a while body
+    scoped exactly like the real PCG core (megba.pcg_core)."""
+    mesh = make_mesh(2)
+
+    @jax.named_scope("megba.pcg_core")
+    def fake_pcg(v):
+        def cond(c):
+            return c[0] < 3
+
+        def body(c):
+            k, x = c
+            for i in range(n_psums):
+                x = jax.lax.psum(x * (1.0 + i), EDGE_AXIS)
+            return k + 1, x
+
+        return jax.lax.while_loop(cond, body, (jnp.int32(0), v))
+
+    def prog(x):
+        _, out = fake_pcg(x)
+        return jax.lax.psum(out, EDGE_AXIS)  # "LM bookkeeping" sync
+
+    sharded = shard_map(prog, mesh=mesh, in_specs=P(EDGE_AXIS),
+                        out_specs=P())
+    return jax.jit(sharded).lower(np.ones((8,), np.float32))
+
+
+def test_collective_census_fires_on_extra_psum():
+    spec = _fake_spec(name="seeded_extra_psum", world=2, pcg_psums=2)
+    lowered = _pcg_like_program(n_psums=3)
+    audit = _audit_of(spec, lowered, lowered.compile())
+    bad = audit.collective_violations()
+    assert bad, "an extra psum per CG step must fail the census"
+    assert "3 all-reduce(s) inside the PCG while body" in bad[0]
+    assert "expectation is 2" in bad[0]
+    # ...and the offending ops are named with their scope paths.
+    assert "megba.pcg_core/while/body" in bad[0]
+
+
+def test_collective_census_green_on_expected_psums():
+    spec = _fake_spec(name="seeded_ok_psums", world=2, pcg_psums=2)
+    lowered = _pcg_like_program(n_psums=2)
+    audit = _audit_of(spec, lowered, lowered.compile())
+    assert audit.collective_violations() == []
+
+
+def test_collective_census_rejects_collectives_in_single_device_spec():
+    spec = _fake_spec(name="seeded_unsharded", world=1, pcg_psums=0)
+    lowered = _pcg_like_program(n_psums=1)
+    audit = _audit_of(spec, lowered, lowered.compile())
+    bad = audit.collective_violations()
+    assert bad and "single-device" in bad[0]
+
+
+# ---------------------------------------------------------------------------
+# Pass 3 seeded violations: dtype leak + dropped donation
+# ---------------------------------------------------------------------------
+
+def test_dtype_census_fires_on_f64_leak():
+    def leaky(x):
+        return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+    lowered = jax.jit(leaky).lower(np.ones((8,), np.float32))
+    audit = _audit_of(_fake_spec(name="seeded_f64_leak"), lowered)
+    bad = audit.dtype_violations()
+    assert bad, "an f64 op in an f32 solve must fail the dtype census"
+    assert "f64" in bad[0] and "f32 solve" in bad[0]
+
+
+def test_dtype_census_fires_on_weak_literal_where():
+    # The exact historical leak the clean tree had: a Python float in a
+    # `where` branch materialises as tensor<f64> + convert under x64.
+    def weak(x):
+        return jnp.where(x > 0, x, 1.0)
+
+    lowered = jax.jit(weak).lower(np.ones((8,), np.float32))
+    audit = _audit_of(_fake_spec(name="seeded_weak_literal"), lowered)
+    assert audit.dtype_violations()
+
+
+def test_donation_pass_fires_when_declared_donation_missing():
+    lowered = jax.jit(lambda x: x + 1.0).lower(np.ones((8,), np.float32))
+    spec = _fake_spec(name="seeded_no_alias", donate_leaves=(0,))
+    audit = _audit_of(spec, lowered, lowered.compile())
+    bad = audit.donation_violations()
+    assert bad and "[0]" in bad[0] and "did not materialise" in bad[0]
+
+
+def test_donation_pass_fires_on_undeclared_alias():
+    lowered = jax.jit(lambda x: x + 1.0,
+                      donate_argnums=(0,)).lower(np.ones((8,), np.float32))
+    spec = _fake_spec(name="seeded_surprise_alias", donate_leaves=())
+    audit = _audit_of(spec, lowered, lowered.compile())
+    bad = audit.donation_violations()
+    assert bad and "without a declared donation" in bad[0]
+
+
+# ---------------------------------------------------------------------------
+# Pass 4 seeded violation: budget fixture broken beyond tolerance
+# ---------------------------------------------------------------------------
+
+def test_budget_gate_fires_on_inflated_baseline(audits):
+    measured = {n: a.metrics() for n, a in audits.items()}
+    doctored = {n: dict(m) for n, m in measured.items()}
+    # Tolerance-breaking: the baseline claims ~9x fewer FLOPs than the
+    # program costs, so the measurement reads as a >15% regression.
+    doctored["ba_single_f32"]["flops"] = measured["ba_single_f32"]["flops"] / 9
+    violations = budget_mod.compare(doctored, measured)
+    assert violations, "a 9x flops drift must break the budget"
+    assert any("ba_single_f32" in v and "flops" in v for v in violations)
+    # ...and metrics inside tolerance stay silent.
+    assert not any("pgo_single_f64" in v for v in violations)
+
+
+def test_budget_gate_exact_match_on_collective_count(audits):
+    measured = {n: a.metrics() for n, a in audits.items()}
+    doctored = {n: dict(m) for n, m in measured.items()}
+    doctored["ba_sharded_w2_f32"]["all_reduce_count"] += 1  # one extra sync
+    violations = budget_mod.compare(doctored, measured)
+    assert any("ba_sharded_w2_f32" in v and "all_reduce_count" in v
+               for v in violations)
+
+
+def test_budget_gate_degrades_loudly_when_metric_unavailable(audits):
+    # A backend without cost/memory analysis yields no measurement for a
+    # gated metric: that must be an explicit violation, not a silent
+    # skip and not a fake "-100% improvement" from a -1 sentinel.
+    measured = {n: dict(a.metrics()) for n, a in audits.items()}
+    del measured["ba_single_f32"]["peak_temp_bytes"]
+    violations = budget_mod.compare(
+        {n: dict(m) for n, m in measured.items()}
+        | {"ba_single_f32": dict(audits["ba_single_f32"].metrics())},
+        measured)
+    assert any("ba_single_f32" in v and "peak_temp_bytes" in v
+               and "unavailable" in v for v in violations)
+    # ...and the -1 sentinel itself never reaches the metrics dict.
+    crippled = program_audit.ProgramAudit(
+        spec=audits["ba_single_f32"].spec, stablehlo="", compiled_text="",
+        flops=-1.0, bytes_accessed=-1.0, peak_temp_bytes=-1.0,
+        argument_bytes=-1.0, output_bytes=-1.0)
+    assert set(crippled.metrics()) == {"all_reduce_count",
+                                      "other_collective_count"}
+
+
+def test_audit_cli_check_exits_nonzero_on_broken_budget(
+        audits, tmp_path, capsys):
+    # End-to-end CLI contract (satellite): a tolerance-breakingly edited
+    # ANALYSIS_BUDGET.json makes `audit --check` exit nonzero with the
+    # program and metric named.  Scoped to one (cached) program so the
+    # in-process run costs one re-lower, not five.
+    measured = {"ba_single_f32": audits["ba_single_f32"].metrics()}
+    doctored = {n: dict(m) for n, m in measured.items()}
+    doctored["ba_single_f32"]["flops"] = measured["ba_single_f32"]["flops"] / 9
+    path = tmp_path / "budget.json"
+    budget_mod.write_baseline(doctored, str(path))
+
+    rc = audit_cli.main(["--check", "--baseline", str(path),
+                         "--program", "ba_single_f32"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "ba_single_f32" in err and "flops" in err
+
+    # --update rewrites the baseline from measurements; --check then
+    # passes on the same tree.
+    rc = audit_cli.main(["--update", "--baseline", str(path),
+                         "--program", "ba_single_f32"])
+    assert rc == 0
+    rc = audit_cli.main(["--check", "--baseline", str(path),
+                         "--program", "ba_single_f32"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# Parser units (pure text, no jax)
+# ---------------------------------------------------------------------------
+
+def test_stablehlo_while_depth_tracking():
+    text = """\
+module @jit_fn {
+  func.func public @main(%arg0: tensor<4xf32>) -> tensor<4xf32> {
+    %0 = stablehlo.add %arg0, %arg0 : tensor<4xf32>
+    %1:2 = stablehlo.while(%iterArg = %0, %iterArg_0 = %0) : tensor<4xf32>, tensor<4xf32>
+     cond {
+      %c = stablehlo.constant dense<true> : tensor<i1>
+      stablehlo.return %c : tensor<i1>
+    } do {
+      %2 = stablehlo.multiply %iterArg, %iterArg : tensor<4xf32>
+      %3:2 = stablehlo.while(%iterArg2 = %2, %iterArg_3 = %2) : tensor<4xf32>, tensor<4xf32>
+       cond {
+        %c2 = stablehlo.constant dense<true> : tensor<i1>
+        stablehlo.return %c2 : tensor<i1>
+      } do {
+        %4 = stablehlo.subtract %iterArg2, %iterArg2 : tensor<4xf32>
+        stablehlo.return %4, %4 : tensor<4xf32>, tensor<4xf32>
+      }
+      stablehlo.return %3#0, %3#1 : tensor<4xf32>, tensor<4xf32>
+    }
+    %5 = stablehlo.negate %1#0 : tensor<4xf32>
+    return %5 : tensor<4xf32>
+  }
+}
+"""
+    ops = hlo.parse_stablehlo_ops(text)
+    depth = {(op.kind, op.line): op.while_depth for op in ops}
+    assert depth[("add", 3)] == 0
+    assert depth[("multiply", 9)] == 1
+    assert depth[("subtract", 15)] == 2
+    assert depth[("negate", 20)] == 0
+
+
+def test_stablehlo_one_line_while_does_not_leak_depth():
+    # Generic print form: a while whose regions open AND close on one
+    # line is self-contained — it must not push a region frame that
+    # inflates while_depth for everything after it.
+    text = (
+        "module {\n"
+        "  func.func @main(%arg0: tensor<f32>) -> tensor<f32> {\n"
+        '    %0 = "stablehlo.while"(%arg0) ({ '
+        '"stablehlo.return"(%arg0) : (tensor<f32>) -> () }, { '
+        '"stablehlo.return"(%arg0) : (tensor<f32>) -> () })'
+        " : (tensor<f32>) -> tensor<f32>\n"
+        "    %1 = stablehlo.negate %0 : tensor<f32>\n"
+        "    return %1 : tensor<f32>\n"
+        "  }\n"
+        "}\n")
+    ops = hlo.parse_stablehlo_ops(text)
+    negate = [op for op in ops if op.kind == "negate"]
+    assert negate and negate[0].while_depth == 0
+
+
+def test_input_output_alias_parser():
+    header = ("HloModule jit_fn, is_scheduled=true, input_output_alias="
+              "{ {5}: (0, {}, may-alias), {1}: (2, {}, must-alias) }, "
+              "entry_computation_layout={(f32[4]{0})->f32[4]{0}}\n")
+    assert hlo.aliased_parameters(header) == {0, 2}
+    assert hlo.aliased_parameters("HloModule jit_fn\n") == frozenset()
+
+
+def test_compiled_hlo_parser_reads_metadata():
+    line = ('  %all-reduce.8 = f32[9,24]{1,0} all-reduce(f32[9,24]{1,0} '
+            '%slice), channel_id=19, replica_groups={{0,1}}, '
+            'to_apply=%region_82, metadata={op_name="jit(fn)/jit(main)/'
+            'while/body/megba.pcg/megba.pcg_core/while/body/psum" '
+            'source_file="x.py"}\n')
+    ops = hlo.parse_compiled_ops(line)
+    assert len(ops) == 1
+    (op,) = ops
+    assert op.kind == "all_reduce"
+    assert op.result_dtype == "f32" and op.result_elems == 216
+    assert program_audit.PCG_BODY_MARK in op.op_name
+
+
+def test_compiled_hlo_parser_reads_tuple_result_collectives():
+    # XLA's AllReduceCombiner merges adjacent all-reduces into ONE op
+    # with a tuple result type; the census must not lose it.
+    line = ('  %all-reduce = (f32[9,24]{1,0}, f32[24]{0}) all-reduce('
+            'f32[9,24]{1,0} %a, f32[24]{0} %b), replica_groups={{0,1}}, '
+            'to_apply=%region, metadata={op_name="jit(fn)/'
+            'megba.pcg/megba.pcg_core/while/body/psum"}\n')
+    ops = hlo.parse_compiled_ops(line)
+    assert [op.kind for op in ops] == ["all_reduce"]
+    assert ops[0].result_dtype == "f32"
+    assert program_audit.PCG_BODY_MARK in ops[0].op_name
+
+
+def test_transfer_target_classification():
+    mk = lambda target: hlo.HloOp(kind="custom_call", line=1, text="",
+                                  target=target)
+    assert hlo.transfer_ops([mk("xla_python_cpu_callback")])
+    assert hlo.transfer_ops([mk("xla_ffi_python_cpu_callback")])
+    assert not hlo.transfer_ops([mk("lapack_spotrf_ffi")])
+    assert not hlo.transfer_ops([mk("Sharding")])
+    # Sanctioned targets are exempt.
+    assert not hlo.transfer_ops([mk("xla_python_cpu_callback")],
+                                allow=("xla_python_cpu_callback",))
