@@ -63,11 +63,13 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
                  total, checkpoint_path, checkpoint_every):
     """The shared chunk loop: resume, solve in chunks, snapshot, aggregate.
 
-    `solve_chunk(params, max_iter, region, v) -> (result, new_params)`
+    `solve_chunk(params, max_iter, region, v, dx) -> (result, new_params)`
     runs up to `max_iter` LM iterations from `params` with the given
-    trust-region resume state (None, None on a fresh start).  `result`
-    must expose cost / initial_cost / region / v / iterations / accepted
-    / pcg_iterations / stopped.  `dump_params(params)` returns the two
+    trust-region resume state (None, None on a fresh start; `dx` is the
+    warm-start resume state — the previous chunk's last accepted step —
+    None when unknown or warm starts are off).  `result` must expose
+    cost / initial_cost / region / v / iterations / accepted /
+    pcg_iterations / stopped.  `dump_params(params)` returns the two
     arrays the snapshot format stores; `load_params(st)` inverts it.
     """
     if checkpoint_every < 1:
@@ -76,6 +78,7 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     done = 0
     region = None
     v = None
+    dx = None
     accepted_total = 0
     pcg_total = 0
     first_cost = None
@@ -110,9 +113,17 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         if "extra_first_cost" in st:
             first_cost = jnp.asarray(st["extra_first_cost"])
         already_stopped = bool(st.get("extra_stopped", False))
+        if "extra_dx" in st:
+            dx = np.asarray(st["extra_dx"])
         if "extra_trace_cost" in st:
+            # Fields added after a snapshot was written get inert NaN
+            # history for the pre-resume iterations (same contract as
+            # pre-trace snapshots below).
+            filler = trace_filler(
+                int(np.asarray(st["extra_trace_cost"]).shape[0]))
             trace_parts.append(SolveTrace(**{
-                f: np.asarray(st[f"extra_trace_{f}"])
+                f: (np.asarray(st[f"extra_trace_{f}"])
+                    if f"extra_trace_{f}" in st else getattr(filler, f))
                 for f in TRACE_FIELDS}))
         elif done:
             # Snapshot predates the trace: pad the unknowable pre-resume
@@ -123,9 +134,11 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     result = None
     while not already_stopped and done < total:
         chunk = min(checkpoint_every, total - done)
-        result, params = solve_chunk(params, chunk, region, v)
+        result, params = solve_chunk(params, chunk, region, v, dx)
         region = float(result.region)
         v = float(result.v)
+        if getattr(result, "dx_cam", None) is not None:
+            dx = np.asarray(result.dx_cam)
         if first_cost is None:
             first_cost = result.initial_cost
         accepted_total += int(result.accepted)
@@ -140,6 +153,10 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
                  "first_cost": np.asarray(float(first_cost)),
                  "stopped": np.asarray(stopped),
                  "topology": topo}
+        if dx is not None:
+            # Warm-start resume state (SolverOption.warm_start): the
+            # last accepted step, threaded into the next chunk/resume.
+            extra["dx"] = dx
         chunk_trace = getattr(result, "trace", None)
         if chunk_trace is not None:
             # Keep only the iterations this chunk actually ran, and
@@ -157,7 +174,7 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
             break  # converged (possibly exactly on the chunk boundary)
 
     if result is None:  # resumed at/past total (or converged): evaluate
-        result, params = solve_chunk(params, 0, region, v)
+        result, params = solve_chunk(params, 0, region, v, dx)
         if first_cost is None:
             first_cost = result.initial_cost
         if already_stopped:
@@ -204,7 +221,7 @@ def solve_checkpointed(
     cam_dtype = cameras.dtype
     pt_dtype = points.dtype
 
-    def solve_chunk(params, max_iter, region, v):
+    def solve_chunk(params, max_iter, region, v, dx):
         cams, pts = params
         chunk_option = dataclasses.replace(
             option,
@@ -213,7 +230,8 @@ def solve_checkpointed(
         result = flat_solve(
             residual_jac_fn, cams, pts, obs, cam_idx, pt_idx,
             chunk_option, verbose=verbose,
-            initial_region=region, initial_v=v, **solve_kwargs)
+            initial_region=region, initial_v=v, initial_dx=dx,
+            **solve_kwargs)
         return result, (result.cameras, result.points)
 
     return _run_chunked(
@@ -253,7 +271,11 @@ def solve_pgo_checkpointed(
     """
     from megba_tpu.models.pgo import solve_pgo
 
-    def solve_chunk(params, max_iter, region, v):
+    def solve_chunk(params, max_iter, region, v, dx):
+        # PGO has no cross-chunk warm-start operand (its warm-start
+        # carry lives inside the loop only); `dx` is accepted for the
+        # shared chunk-loop contract and unused.
+        del dx
         chunk_option = dataclasses.replace(
             option,
             algo_option=dataclasses.replace(

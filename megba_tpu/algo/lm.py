@@ -49,6 +49,38 @@ _TINY = 1e-30
 _next_verbose_token = next_verbose_token
 
 
+def initial_forcing_eta(eta_min, eta_max, dtype):
+    """Eisenstat-Walker start: half the RHS energy removed is plenty for
+    the first (least accurate) linearization, never looser than the cap.
+    Shared by the BA and PGO loops."""
+    return jnp.clip(
+        jnp.minimum(eta_max, jnp.asarray(0.5, dtype)), eta_min, None)
+
+
+def eisenstat_walker_eta(eta_prev, cost_new, cost_prev, rho, accept,
+                         eta_min, eta_max, dtype):
+    """One Eisenstat-Walker choice-2 forcing update (gamma=0.9, alpha=2).
+
+    Costs are squared residual norms, so the cost ratio IS the norm
+    ratio squared.  Safeguarded against over-tightening while the
+    previous eta was still loose; loosened when the gain ratio says the
+    linear model is trustworthy; tightened on reject (the failed step
+    may be the inexact solve's fault, and the shrunken region makes the
+    next system cheaper anyway).  Clamped to [eta_min, eta_max].  The
+    ONE home of the forcing schedule — the BA and PGO loops both call
+    it, so a tuning change can never leave them on different schedules.
+    """
+    ratio2 = cost_new / jnp.maximum(cost_prev, jnp.asarray(_TINY, dtype))
+    eta_ew = 0.9 * ratio2
+    safeguard = 0.9 * eta_prev * eta_prev
+    eta_ew = jnp.where(safeguard > 0.1,
+                       jnp.maximum(eta_ew, safeguard), eta_ew)
+    eta_ew = jnp.where(rho > 0.75, 2.0 * eta_ew, eta_ew)
+    return jnp.where(accept,
+                     jnp.clip(eta_ew, eta_min, eta_max),
+                     jnp.maximum(0.25 * eta_prev, eta_min))
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class LMResult:
@@ -69,6 +101,13 @@ class LMResult:
     # observability/trace.py.  None only for results built by legacy
     # constructors that predate the trace.
     trace: Optional[SolveTrace] = None
+    # Warm-start resume state: the last ACCEPTED step (the same layout
+    # `cameras` uses — feature-major here, edge-major after flat_solve's
+    # boundary transpose).  Populated only under
+    # SolverOption.warm_start; the chunked/checkpointed drivers thread
+    # it back in as `initial_dx` so warm starts survive chunk
+    # boundaries.
+    dx_cam: Optional[jax.Array] = None
 
 
 def lm_solve(
@@ -90,6 +129,7 @@ def lm_solve(
     initial_region=None,
     initial_v=None,
     verbose_token=None,
+    initial_dx=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -102,6 +142,9 @@ def lm_solve(
 
     `initial_region`/`initial_v` override the trust-region start state —
     the resume hook used by utils.checkpoint / solve_checkpointed.
+    `initial_dx` ([cd, Nc] rows) seeds the warm-start carry under
+    SolverOption.warm_start (the cross-chunk resume hook); ignored
+    otherwise.
 
     `plans` (ops/segtiles.DualPlans) turns on the scatter-free tiled
     path: edge arrays must be in the cam plan's slot order (the lowering
@@ -167,6 +210,13 @@ def lm_solve(
     r0, Jc0, Jp0, system0, cost0, wcost0 = linearize(cameras, points)
 
     dtype = cameras.dtype
+    forcing = solver_opt.forcing
+    warm_start = solver_opt.warm_start
+    # eta_k is a NORM-relative forcing term; the PCG threshold is on the
+    # residual ENERGY rho, so eta rides squared into the solver.  With
+    # forcing on, `tol` is the eta cap (SolverOption docs).
+    eta_min_c = jnp.asarray(solver_opt.eta_min, dtype)
+    eta_max_c = jnp.asarray(solver_opt.tol, dtype)
     state0 = dict(
         k=jnp.int32(0),
         accepted=jnp.int32(0),
@@ -188,6 +238,13 @@ def lm_solve(
         # iteration, no host traffic (observability/trace.py).
         trace=SolveTrace.empty(algo_opt.max_iter, dtype),
     )
+    if forcing:
+        state0["eta"] = initial_forcing_eta(eta_min_c, eta_max_c, dtype)
+    if warm_start:
+        dx0_cam = (jnp.zeros_like(cameras) if initial_dx is None
+                   else jnp.asarray(initial_dx, dtype))
+        state0["dx0"] = (dx0_cam if option.use_schur
+                         else (dx0_cam, jnp.zeros_like(points)))
 
     def cond(s):
         return (s["k"] < algo_opt.max_iter) & (~s["stop"])
@@ -195,16 +252,24 @@ def lm_solve(
     pcg_solve = schur_pcg_solve if option.use_schur else plain_pcg_solve
 
     def body(s):
+        # Per-iteration tolerance: the carried eta_k (squared — see
+        # above) under forcing, the static option otherwise.  eta_k and
+        # the warm-start carry are replicated across shards (derived
+        # from psum-reduced costs and the replicated PCG output), so
+        # they ride shard_map like the rest of the LM state.
+        tol_k = s["eta"] * s["eta"] if forcing else solver_opt.tol
+        tol_rel = True if forcing else solver_opt.tol_relative
         with jax.named_scope("megba.pcg"):
             pcg = pcg_solve(
                 s["system"], s["Jc"], s["Jp"], cam_idx, pt_idx, s["region"],
-                max_iter=solver_opt.max_iter, tol=solver_opt.tol,
+                max_iter=solver_opt.max_iter, tol=tol_k,
                 refuse_ratio=solver_opt.refuse_ratio,
-                tol_relative=solver_opt.tol_relative,
+                tol_relative=tol_rel,
                 compute_kind=compute_kind, axis_name=axis_name,
                 mixed_precision=option.mixed_precision_pcg,
                 cam_sorted=cam_sorted,
-                preconditioner=solver_opt.preconditioner, plans=plans)
+                preconditioner=solver_opt.preconditioner, plans=plans,
+                x0=s["dx0"] if warm_start else None)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply
@@ -293,6 +358,12 @@ def lm_solve(
             return jax.tree_util.tree_map(
                 lambda a, b: jnp.where(accept, a, b), new, old)
 
+        if forcing:
+            with jax.named_scope("megba.lm_forcing"):
+                eta_next = eisenstat_walker_eta(
+                    s["eta"], cost_new, s["cost"], rho, accept,
+                    eta_min_c, eta_max_c, dtype)
+
         s_next = dict(
             k=s["k"] + 1,
             accepted=s["accepted"] + jnp.where(accept, 1, 0).astype(jnp.int32),
@@ -318,8 +389,21 @@ def lm_solve(
             trace=s["trace"].record(
                 s["k"], cost=cost_new, grad_inf_norm=g_inf,
                 trust_region=s["region"], rho=rho, accept=accept,
-                pcg_iters=pcg.iterations),
+                pcg_iters=pcg.iterations,
+                pcg_eta=(s["eta"] if forcing
+                         else jnp.asarray(solver_opt.tol, dtype)),
+                pcg_r0_ratio=pcg.r0_ratio.astype(dtype)),
         )
+        if forcing:
+            s_next["eta"] = eta_next
+        if warm_start:
+            # Seed the NEXT solve with this iteration's step only when
+            # it was accepted; a reject shrinks the trust region (the
+            # damped system changes sharply), so the carry is zeroed —
+            # bitwise identical to a cold start.
+            new_dx = (dx_cam if option.use_schur else (dx_cam, dx_pt))
+            s_next["dx0"] = jax.tree_util.tree_map(
+                lambda d: jnp.where(accept, d, jnp.zeros_like(d)), new_dx)
         if verbose:
             token = (jnp.int32(0) if verbose_token is None
                      else jnp.asarray(verbose_token, jnp.int32))
@@ -328,6 +412,9 @@ def lm_solve(
         return s_next
 
     out = jax.lax.while_loop(cond, body, state0)
+    dx_final = None
+    if warm_start:
+        dx_final = out["dx0"] if option.use_schur else out["dx0"][0]
     return LMResult(
         cameras=out["cameras"],
         points=out["points"],
@@ -340,6 +427,7 @@ def lm_solve(
         v=out["v"],
         stopped=out["stop"],
         trace=out["trace"],
+        dx_cam=dx_final,
     )
 
 

@@ -237,10 +237,10 @@ def _ba_option():
         solver_option=SolverOption(max_iter=8, tol=1e-8))
 
 
-def _lower_ba(world: int, use_tiled: bool):
+def _lower_ba(world: int, use_tiled: bool, forcing: bool = False):
     import dataclasses as _dc
 
-    from megba_tpu.common import JacobianMode
+    from megba_tpu.common import JacobianMode, SolverOption
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
     from megba_tpu.solve import flat_solve
 
@@ -248,6 +248,11 @@ def _lower_ba(world: int, use_tiled: bool):
     option = _ba_option()
     if world > 1:
         option = _dc.replace(option, world_size=world)
+    if forcing:
+        # Inexact-LM canonical program: adaptive Eisenstat-Walker
+        # forcing (eta_k a traced while-carry scalar) + warm starts.
+        option = _dc.replace(option, solver_option=SolverOption(
+            max_iter=8, tol=1e-1, forcing=True, warm_start=True))
     f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
                       option, use_tiled=use_tiled, lower_only=True)
@@ -299,6 +304,19 @@ def program_specs() -> Dict[str, ProgramSpec]:
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
             build=lambda: _lower_ba(world=2, use_tiled=False)),
+        "ba_forcing_w2_f32": ProgramSpec(
+            name="ba_forcing_w2_f32", float_family="f32", world=2,
+            # Inexact LM (forcing + warm_start): the adaptive tolerance
+            # is a traced carry scalar and the warm-start r0 = b - S x0
+            # / recurrence-priming S·u0 products live OUTSIDE the PCG
+            # while body, so the per-CG-step census is UNCHANGED —
+            # exactly two all-reduces.  Adaptive forcing adding a
+            # collective or a host transfer is precisely the regression
+            # this spec pins against.
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            build=lambda: _lower_ba(world=2, use_tiled=False,
+                                    forcing=True)),
         "pgo_single_f64": ProgramSpec(
             name="pgo_single_f64", float_family="f64", world=1, pcg_psums=0,
             donate_leaves=(0,),

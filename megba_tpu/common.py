@@ -103,8 +103,25 @@ class SolverOption:
     `tol` follows the reference's semantics: an ABSOLUTE threshold on the
     preconditioned residual energy rho = <r, M^-1 r> (fine when costs are
     large, awkward otherwise).  `tol_relative=True` reinterprets it as a
-    fraction of the initial rho — the conventional, scale-free PCG
-    stopping rule (capability beyond the reference).
+    fraction of the RHS energy <b, M^-1 b> — the conventional, scale-free
+    PCG stopping rule (capability beyond the reference).
+
+    Inexact-LM controls (capabilities beyond the reference):
+
+    `forcing=True` turns on the Eisenstat-Walker (choice 2) adaptive
+    forcing sequence: each LM iteration k computes its own tolerance
+    eta_k ON DEVICE (inside the jitted while_loop) from the observed cost
+    ratio, clamped to `[eta_min, tol]`, tightened on rejected steps and
+    loosened after strong gain ratios.  eta_k is a NORM-relative forcing
+    term (||r||_{M^-1} <= eta_k ||b||_{M^-1}); with forcing on, `tol`
+    becomes the eta cap and `tol_relative` is implied.
+
+    `warm_start=True` seeds each PCG solve with the previous ACCEPTED
+    LM step (zeroed on reject — a rejected step shrinks the trust region,
+    so the damped system the next solve sees is sharply different).
+    Costs one extra S·p product per LM iteration (r0 = b - S x0),
+    outside the PCG while body, so the per-iteration collective census
+    (2 all-reduces per S·p) is unchanged.
     """
 
     solver_kind: SolverKind = SolverKind.PCG
@@ -113,6 +130,10 @@ class SolverOption:
     refuse_ratio: float = 1.0
     tol_relative: bool = False
     preconditioner: PreconditionerKind = PreconditionerKind.HPP
+    # Inexact-LM: adaptive Eisenstat-Walker forcing + warm starts.
+    forcing: bool = False
+    eta_min: float = 1e-6
+    warm_start: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -205,6 +226,15 @@ def validate_options(option: ProblemOption) -> None:
         raise ValueError("use_schur=True requires LinearSystemKind.SCHUR")
     if option.solver_option.solver_kind != SolverKind.PCG:
         raise ValueError("only SolverKind.PCG is supported")
+    if not option.solver_option.eta_min > 0:
+        raise ValueError(
+            f"eta_min must be > 0, got {option.solver_option.eta_min}")
+    if (option.solver_option.forcing
+            and option.solver_option.eta_min > option.solver_option.tol):
+        raise ValueError(
+            "forcing=True clamps eta_k to [eta_min, tol]; need "
+            f"eta_min <= tol, got eta_min={option.solver_option.eta_min} "
+            f"> tol={option.solver_option.tol}")
     if not option.use_schur and option.mixed_precision_pcg:
         raise ValueError(
             "mixed_precision_pcg is only implemented for the Schur solver "

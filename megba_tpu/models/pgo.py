@@ -342,6 +342,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
     axis_name = EDGE_AXIS if world > 1 else None
 
     from megba_tpu.observability.emit import emit_verbose_iteration
+    from megba_tpu.algo.lm import eisenstat_walker_eta, initial_forcing_eta
     from megba_tpu.solver.pcg import _pcg_core, block_inv
 
     def run(poses_fm, fixed_j, ei, ej, meas_fm, region0, v0,
@@ -361,7 +362,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
             return _grad_and_diag(r, Ji, Jj, ei, ej, n_poses, fixed_j,
                                   axis_name)
 
-        def step_system(g, h_rows, Ji, Jj, region):
+        def step_system(g, h_rows, Ji, Jj, region, tol, x0):
             damp = 1.0 + 1.0 / region
             h_blocks = jnp.moveaxis(h_rows.reshape(6, 6, n_poses), -1, 0)
             # Diagonal ENTRIES of each 6x6 block: rows 0,7,...,35 of the
@@ -392,26 +393,41 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
             def precond(x):
                 return jnp.einsum("nab,bn->an", minv, x)
 
-            dx, iters, _ = _pcg_core(
-                matvec, precond, -g, solver_opt.max_iter, solver_opt.tol,
-                solver_opt.refuse_ratio, solver_opt.tol_relative)
+            dx, iters, _, _ = _pcg_core(
+                matvec, precond, -g, solver_opt.max_iter, tol,
+                solver_opt.refuse_ratio,
+                True if solver_opt.forcing else solver_opt.tol_relative,
+                x0=x0)
             return dx, iters
 
         r0, Ji0, Jj0, cost0, wcost0 = lin(poses_fm)
         g0, h0 = grad_and_diag(r0, Ji0, Jj0)
+        # Inexact-LM knobs, same semantics as the BA loop (algo/lm.py):
+        # eta_k is norm-relative (squared into the energy threshold),
+        # Eisenstat-Walker choice 2 updates, warm start zeroed on reject.
+        forcing = solver_opt.forcing
+        warm_start = solver_opt.warm_start
+        eta_min_c = jnp.asarray(solver_opt.eta_min, dtype)
+        eta_max_c = jnp.asarray(solver_opt.tol, dtype)
         state0 = dict(
             k=jnp.int32(0), accepted=jnp.int32(0), pcg_total=jnp.int32(0),
             poses=poses_fm, r=r0, Ji=Ji0, Jj=Jj0, g=g0, h_rows=h0,
             cost=cost0, wcost=wcost0,
             region=jnp.asarray(region0, dtype),
             v=jnp.asarray(v0, dtype), stop=jnp.bool_(False))
+        if forcing:
+            state0["eta"] = initial_forcing_eta(eta_min_c, eta_max_c, dtype)
+        if warm_start:
+            state0["dx0"] = jnp.zeros_like(poses_fm)
 
         def cond(s):
             return (s["k"] < algo_opt.max_iter) & (~s["stop"])
 
         def body(s):
+            tol_k = s["eta"] * s["eta"] if forcing else solver_opt.tol
             dx, pcg_iters = step_system(s["g"], s["h_rows"], s["Ji"],
-                                        s["Jj"], s["region"])
+                                        s["Jj"], s["region"], tol_k,
+                                        s["dx0"] if warm_start else None)
             dx_norm = jnp.sqrt(jnp.sum(dx * dx))
             x_norm = jnp.sqrt(jnp.sum(s["poses"] ** 2))
             converged = dx_norm <= algo_opt.epsilon2 * (
@@ -472,6 +488,12 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
                                  s["region"] / s["v"]),
                 v=jnp.where(accept, jnp.asarray(2.0, dtype), s["v"] * 2.0),
                 stop=converged | (accept & (g_inf <= algo_opt.epsilon1)))
+            if forcing:
+                s_next["eta"] = eisenstat_walker_eta(
+                    s["eta"], cost_new, s["cost"], rho, accept,
+                    eta_min_c, eta_max_c, dtype)
+            if warm_start:
+                s_next["dx0"] = jnp.where(accept, dx, jnp.zeros_like(dx))
             if verbose:
                 # Reference-style per-iteration line, same shared
                 # mechanism as the BA loop (algo/lm.py).

@@ -28,6 +28,8 @@ TRACE_FIELDS = (
     "rho",
     "accept",
     "pcg_iters",
+    "pcg_eta",
+    "pcg_r0_ratio",
 )
 
 
@@ -50,6 +52,12 @@ class SolveTrace:
     rho: jax.Array  # [max_iter] float
     accept: jax.Array  # [max_iter] bool
     pcg_iters: jax.Array  # [max_iter] int32
+    # Inexact-LM observables: the norm-relative forcing tolerance eta_k
+    # the iteration's PCG ran with (the static tol when forcing is off),
+    # and the warm-start initial-residual ratio |rho0| / <b, M^-1 b>
+    # (1.0 on a cold start — see solver/pcg.PCGResult.r0_ratio).
+    pcg_eta: jax.Array  # [max_iter] float
+    pcg_r0_ratio: jax.Array  # [max_iter] float
 
     @classmethod
     def empty(cls, max_iter: int, dtype) -> "SolveTrace":
@@ -61,11 +69,16 @@ class SolveTrace:
             rho=jnp.zeros((max_iter,), dtype),
             accept=jnp.zeros((max_iter,), jnp.bool_),
             pcg_iters=jnp.zeros((max_iter,), jnp.int32),
+            pcg_eta=jnp.zeros((max_iter,), dtype),
+            pcg_r0_ratio=jnp.zeros((max_iter,), dtype),
         )
 
     def record(self, k, *, cost, grad_inf_norm, trust_region, rho, accept,
-               pcg_iters) -> "SolveTrace":
-        """Write iteration k's observables; returns the updated trace."""
+               pcg_iters, pcg_eta=None, pcg_r0_ratio=None) -> "SolveTrace":
+        """Write iteration k's observables; returns the updated trace.
+
+        `pcg_eta`/`pcg_r0_ratio` default to None for callers that predate
+        the inexact-LM fields (their buffers keep the zero fill)."""
         if self.cost.shape[0] == 0:
             # max_iter=0 programs (the checkpointed driver's evaluate-only
             # chunk) still TRACE the loop body; indexing a size-0 buffer
@@ -78,6 +91,10 @@ class SolveTrace:
             rho=self.rho.at[k].set(rho),
             accept=self.accept.at[k].set(accept),
             pcg_iters=self.pcg_iters.at[k].set(pcg_iters),
+            pcg_eta=(self.pcg_eta if pcg_eta is None
+                     else self.pcg_eta.at[k].set(pcg_eta)),
+            pcg_r0_ratio=(self.pcg_r0_ratio if pcg_r0_ratio is None
+                          else self.pcg_r0_ratio.at[k].set(pcg_r0_ratio)),
         )
 
 
@@ -107,6 +124,8 @@ def trace_filler(n: int) -> SolveTrace:
         rho=np.full((n,), np.nan),
         accept=np.zeros((n,), np.bool_),
         pcg_iters=np.zeros((n,), np.int32),
+        pcg_eta=np.full((n,), np.nan),
+        pcg_r0_ratio=np.full((n,), np.nan),
     )
 
 

@@ -973,6 +973,111 @@ def squeeze_plans(plans: DualPlans) -> DualPlans:
     return jax.tree_util.tree_map(lambda x: x[0], plans)
 
 
+# ---------------------------------------------------------------------------
+# Host plan cache
+# ---------------------------------------------------------------------------
+#
+# Plan construction is pure host work (argsorts + bincounts over the
+# edge axis; ~270 ms per venice-scale solve, PROFILE.md) and depends
+# only on the problem GRAPH and the tile geometry — not on parameters
+# or observations.  Repeated solves of one problem (bench reruns,
+# chunked/checkpointed drivers, the auditor's canonical lowerings,
+# parameter sweeps) therefore reuse one plan, keyed by a content
+# fingerprint of the index arrays.  A strong digest (blake2b), not
+# Python's hash(): a collision would silently solve the wrong graph.
+
+_PLAN_CACHE: "dict" = {}
+_PLAN_CACHE_MAX = 8  # LRU bound: plans pin host+device index arrays
+
+
+def _array_digest(a: np.ndarray) -> bytes:
+    import hashlib
+
+    a = np.ascontiguousarray(a)
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.digest()
+
+
+def _plan_cache_get(key):
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None:
+        # Refresh LRU position (dicts preserve insertion order).
+        _PLAN_CACHE.pop(key)
+        _PLAN_CACHE[key] = hit
+    return hit
+
+
+def _plan_cache_put(key, value):
+    while len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+    _PLAN_CACHE[key] = value
+
+
+def cached_dual_plans(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    tile_cam: int = DEFAULT_TILE_CAM,
+    block_cam: int = DEFAULT_BLOCK_CAM,
+    tile_pt: int = DEFAULT_TILE_PT,
+    block_pt: int = DEFAULT_BLOCK_PT,
+    use_kernels: Optional[bool] = None,
+):
+    """`make_dual_plans` behind the host plan cache.
+
+    Returns ((cam_host_plan, DualPlans), cache_hit).  `use_kernels` is
+    resolved (probe_kernels) BEFORE keying, so a plan probed on one
+    backend can never serve a solve on another.
+    """
+    if use_kernels is None:
+        use_kernels = probe_kernels()
+    key = ("single", _array_digest(cam_idx), _array_digest(pt_idx),
+           int(num_cameras), int(num_points),
+           tile_cam, block_cam, tile_pt, block_pt, use_kernels)
+    hit = _plan_cache_get(key)
+    if hit is not None:
+        return hit, True
+    value = make_dual_plans(
+        cam_idx, pt_idx, num_cameras, num_points,
+        tile_cam, block_cam, tile_pt, block_pt, use_kernels)
+    _plan_cache_put(key, value)
+    return value, False
+
+
+def cached_sharded_dual_plans(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    world_size: int,
+    tile_cam: int = DEFAULT_TILE_CAM,
+    block_cam: int = DEFAULT_BLOCK_CAM,
+    tile_pt: int = DEFAULT_TILE_PT,
+    block_pt: int = DEFAULT_BLOCK_PT,
+    use_kernels: Optional[bool] = None,
+):
+    """`make_sharded_dual_plans` behind the host plan cache.
+
+    Returns ((perms, masks, cam_segs, DualPlans), cache_hit)."""
+    if use_kernels is None:
+        use_kernels = probe_kernels()
+    key = ("sharded", _array_digest(cam_idx), _array_digest(pt_idx),
+           int(num_cameras), int(num_points), int(world_size),
+           tile_cam, block_cam, tile_pt, block_pt, use_kernels)
+    hit = _plan_cache_get(key)
+    if hit is not None:
+        return hit, True
+    value = make_sharded_dual_plans(
+        cam_idx, pt_idx, num_cameras, num_points, world_size,
+        tile_cam, block_cam, tile_pt, block_pt, use_kernels)
+    _plan_cache_put(key, value)
+    return value, False
+
+
 @functools.lru_cache(maxsize=1)
 def probe_kernels() -> bool:
     """True iff ALL five Pallas kernels compile AND match on this backend.

@@ -125,6 +125,7 @@ def distributed_lm_solve(
     plans=None,
     initial_region=None,
     initial_v=None,
+    initial_dx=None,
     jit_cache: Optional[dict] = None,
     donate: bool = False,
     lower_only: bool = False,
@@ -182,6 +183,11 @@ def distributed_lm_solve(
         ("sqrt_info", sqrt_info, edge),
         ("cam_fixed", cam_fixed, rep),
         ("pt_fixed", pt_fixed, rep),
+        # Warm-start resume state ([cd, Nc] rows): replicated like the
+        # parameter blocks; the in-loop warm-start carry it seeds stays
+        # replicated too (it is the PCG's psum-derived output), so the
+        # solver's out_specs=P() contract is unchanged.
+        ("initial_dx", initial_dx, rep),
         # Per-shard tiled plans: every leaf carries a leading shard axis
         # split by the mesh (ops/segtiles.make_sharded_dual_plans).
         ("plans", plans, P(EDGE_AXIS)),

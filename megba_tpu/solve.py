@@ -107,6 +107,7 @@ def flat_solve(
     use_tiled: Optional[bool] = None,
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
+    initial_dx: Optional[np.ndarray] = None,
     jit_cache: Optional[dict] = None,
     timer: Optional[PhaseTimer] = None,
     lower_only: bool = False,
@@ -128,6 +129,11 @@ def flat_solve(
     the caller-owned `jit_cache` dict when the engine is a per-problem
     closure whose lifetime must not exceed its problem's (BaseProblem
     passes its own dict).
+
+    `initial_dx` ([Nc, cd], edge-major like `cameras`) seeds the
+    warm-start carry under `SolverOption.warm_start` — the cross-chunk
+    resume hook (`LMResult.dx_cam` of the previous chunk); ignored when
+    warm starts are off.
 
     `use_tiled` selects the scatter-free tiled path (ops/segtiles):
     default ON for float32 solves on TPU backends (where it replaces
@@ -181,11 +187,14 @@ def flat_solve(
         # Sharded tiled lowering: contiguous per-shard edge chunks, each
         # with its own dual plans; the concatenated per-shard slot
         # streams form the edge axis (equal shard sizes by construction).
-        from megba_tpu.ops.segtiles import make_sharded_dual_plans
+        from megba_tpu.ops.segtiles import cached_sharded_dual_plans
 
         with timer.phase("plan"):
-            perms, masks, cam_segs, plans = make_sharded_dual_plans(
-                cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws)
+            (perms, masks, cam_segs, plans), plan_hit = (
+                cached_sharded_dual_plans(
+                    cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws))
+            if plan_hit:
+                timer.count_event("plan_cache_hit")
             obs = np.concatenate([
                 obs[perms[k]] * masks[k][:, None].astype(dtype)
                 for k in range(ws)])
@@ -206,11 +215,13 @@ def flat_solve(
     elif use_tiled:
         # Tiled lowering: the cam plan's slot order IS the edge axis from
         # here on (it subsumes the camera sort and quantum padding).
-        from megba_tpu.ops.segtiles import make_dual_plans
+        from megba_tpu.ops.segtiles import cached_dual_plans
 
         with timer.phase("plan"):
-            plan_c, plans = make_dual_plans(
+            (plan_c, plans), plan_hit = cached_dual_plans(
                 cam_idx, pt_idx, cameras.shape[0], points.shape[0])
+            if plan_hit:
+                timer.count_event("plan_cache_hit")
             perm, pmask = plan_c.perm, plan_c.mask
             obs = obs[perm] * pmask[:, None].astype(dtype)
             cam_idx = plan_c.seg
@@ -247,6 +258,13 @@ def flat_solve(
         sqrt_info_j = None
     cam_fixed_j = None if cam_fixed is None else np.asarray(cam_fixed)
     pt_fixed_j = None if pt_fixed is None else np.asarray(pt_fixed)
+    # Warm-start resume state rides the same optional-operand mechanism
+    # as sqrt_info/fixed masks; feature-major like cameras.  Dropped when
+    # warm starts are off so the program cache keys stay stable.
+    initial_dx_j = None
+    if initial_dx is not None and option.solver_option.warm_start:
+        initial_dx_j = np.ascontiguousarray(
+            np.asarray(initial_dx).astype(dtype, copy=False).T)
 
     # Feature-major boundary transposes (host numpy, once per solve).
     # Stay on HOST here: the jitted program uploads each operand exactly
@@ -277,6 +295,7 @@ def flat_solve(
                 pt_fixed=pt_fixed_j,
                 verbose=verbose, cam_sorted=True, plans=plans,
                 initial_region=initial_region, initial_v=initial_v,
+                initial_dx=initial_dx_j,
                 jit_cache=jit_cache, donate=True, lower_only=lower_only)
         if lower_only:
             return result
@@ -286,7 +305,7 @@ def flat_solve(
         return result
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
-                ("pt_fixed", pt_fixed_j)]
+                ("pt_fixed", pt_fixed_j), ("initial_dx", initial_dx_j)]
     keys = tuple(k for k, v in optional if v is not None)
     extras = [v for _, v in optional if v is not None]
     with timer.phase("program"):
@@ -340,7 +359,9 @@ def _result_to_edge_major(result: LMResult) -> LMResult:
     return dataclasses.replace(
         result,
         cameras=jnp.swapaxes(result.cameras, 0, 1),
-        points=jnp.swapaxes(result.points, 0, 1))
+        points=jnp.swapaxes(result.points, 0, 1),
+        dx_cam=(None if result.dx_cam is None
+                else jnp.swapaxes(result.dx_cam, 0, 1)))
 
 
 def solve_bal(

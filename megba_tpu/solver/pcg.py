@@ -67,6 +67,11 @@ class PCGResult:
     dx_pt: jax.Array  # [pd, Np]
     iterations: jax.Array  # scalar int32
     rho: jax.Array  # final residual-energy <r, M^-1 r>
+    # |<r0, M^-1 r0>| / |<b, M^-1 b>|: how much of the RHS energy the
+    # warm start already removed (1.0 for a cold start).  The LM loop
+    # records it per iteration (observability/trace.py).
+    r0_ratio: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.float32(1.0))
 
 
 def cam_block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
@@ -257,14 +262,37 @@ def make_coupling_matvecs(
 # named_scope: the PCG while_loop (body traced inside this call) carries
 # a navigable label in profiler traces — see observability/__init__.py.
 @jax.named_scope("megba.pcg_core")
-def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative):
+def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
+              x0=None):
     """Preconditioned CG over an arbitrary pytree "vector".
 
     One implementation of the reference's stopping + refuse semantics
     (|rho| < tol exit, schur_pcg_solver.cu:406-407; rho > refuse_ratio *
     min(rho) -> restore best iterate, :288-296) shared by the Schur
     solver (vector = one array) and the plain full-system solver
-    (vector = a (camera, point) pair).  Returns (x, iterations, rho).
+    (vector = a (camera, point) pair).  Returns
+    (x, iterations, rho, r0_ratio).
+
+    The body is the Chronopoulos-Gear single-recurrence CG: carrying the
+    auxiliary direction s = A p alongside p lets each iteration run as
+    ONE pass — four fused axpys (x, r, p, s), one preconditioner apply,
+    one matvec, and BOTH compensated dots (<r, u> and <u, w>) computed
+    back-to-back on the freshly produced u/w instead of at two separate
+    reduction points, with alpha recovered by the scalar recurrence
+    alpha = gamma / (delta - beta * gamma / alpha_prev).  Iterates are
+    identical to textbook PCG in exact arithmetic; the matvec count is
+    k+1 (one extra A·u before the loop primes the recurrence).  The
+    matvec stays the only collective site, so the census invariant —
+    exactly 2 all-reduces per S·p inside the while body
+    (analysis/program_audit.py pass 2) — is unchanged.
+
+    `x0` warm-starts the iteration (r0 = b - A x0; one extra matvec,
+    also outside the while body).  `tol_relative` anchors the threshold
+    to the RHS energy <b, M^-1 b> — NOT the initial-guess residual
+    rho0, which a good warm start drives toward _TINY_RHO and which
+    would therefore either exit spuriously after 0 iterations or
+    over-solve relative to an already-tiny baseline.  For x0=None the
+    two anchors coincide bitwise (r0 = b).
     """
     tm = jax.tree_util.tree_map
 
@@ -278,43 +306,84 @@ def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative):
     def select(pred, a, c):
         return tm(lambda ai, ci: jnp.where(pred, ai, ci), a, c)
 
-    x0 = tm(jnp.zeros_like, b)
-    r0 = b  # x0 = 0 so r0 = b - A x0 = b
-    z0 = precond(r0)
-    rho0 = tdot(r0, z0)
+    if x0 is None:
+        x_init = tm(jnp.zeros_like, b)
+        r0 = b  # x0 = 0 so r0 = b - A x0 = b
+        u0 = precond(r0)
+        rho0 = tdot(r0, u0)
+        rhs_energy = rho0  # r0 IS b: reuse, bitwise-identical threshold
+        r0_ratio = jnp.ones_like(rho0)
+    else:
+        x_init = x0
+        r0 = axpy(jnp.asarray(-1.0, jax.tree_util.tree_leaves(b)[0].dtype),
+                  matvec(x0), b)
+        u0 = precond(r0)
+        rho0 = tdot(r0, u0)
+        ub = precond(b)
+        rhs_energy = tdot(b, ub)
+        # Diagnostic first, then the safeguard: a warm start whose
+        # residual energy EXCEEDS the RHS energy is a worse start than
+        # zero (the trust region moved the damped system out from under
+        # the previous step) — fall back to the cold start, which is
+        # fully available from the quantities just computed.  The
+        # recorded ratio stays raw so the trace shows warm-start quality
+        # honestly (values > 1 mean "fell back").
+        r0_ratio = jnp.abs(rho0) / jnp.maximum(
+            jnp.abs(rhs_energy), jnp.asarray(_TINY_RHO, rho0.dtype))
+        use_ws = jnp.abs(rho0) <= jnp.abs(rhs_energy)
+        x_init = select(use_ws, x_init, tm(jnp.zeros_like, b))
+        r0 = select(use_ws, r0, b)
+        u0 = select(use_ws, u0, ub)
+        rho0 = jnp.where(use_ws, rho0, rhs_energy)
     # Reference semantics: absolute threshold on rho; tol_relative scales
-    # it by rho0, floored so a zero RHS exits immediately instead of
-    # iterating into 0/0 NaNs.
+    # it by the RHS energy, floored so a zero RHS exits immediately
+    # instead of iterating into 0/0 NaNs.
     threshold = (
-        jnp.maximum(tol * jnp.abs(rho0), jnp.asarray(_TINY_RHO, rho0.dtype))
+        jnp.maximum(tol * jnp.abs(rhs_energy),
+                    jnp.asarray(_TINY_RHO, rho0.dtype))
         if tol_relative else tol
     )
 
-    state0 = (jnp.int32(0), x0, r0, z0, rho0, jnp.abs(rho0), x0,
-              jnp.bool_(False))
+    # Prime the Chronopoulos-Gear recurrence: p0 = u0, s0 = A p0,
+    # alpha0 = rho0 / <p0, A p0> — exactly classic CG's first alpha.
+    # (Guard the division: u0 = 0 on a zero residual, where the loop
+    # below never runs and alpha is never consumed.)
+    w0 = matvec(u0)
+    delta0 = tdot(u0, w0)
+    alpha0 = rho0 / jnp.where(delta0 == 0, jnp.ones_like(delta0), delta0)
+
+    state0 = (jnp.int32(0), x_init, r0, u0, w0, alpha0, rho0,
+              jnp.abs(rho0), x_init, jnp.bool_(False))
 
     def cond(state):
-        k, _, _, _, rho, _, _, refused = state
+        k, _, _, _, _, _, rho, _, _, refused = state
         return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
 
     def body(state):
-        k, x, r, p, rho, rho_min, x_best, _ = state
-        q = matvec(p)
-        alpha = rho / tdot(p, q)
+        k, x, r, p, s, alpha, rho, rho_min, x_best, _ = state
+        # One fused vector pass: both solution/residual updates...
         x = axpy(alpha, p, x)
-        r = axpy(-alpha, q, r)
-        z = precond(r)
-        rho_new = tdot(r, z)
+        r = axpy(-alpha, s, r)
+        # ...then the only preconditioner apply and the only matvec (the
+        # sole collective site: 2 psums inside the Schur S·p)...
+        u = precond(r)
+        w = matvec(u)
+        # ...and both compensated dots on the same fresh u/w.
+        rho_new = tdot(r, u)
+        delta = tdot(u, w)
+        beta = rho_new / rho
+        alpha = rho_new / (delta - beta * rho_new / alpha)
+        p = axpy(beta, p, u)  # u + beta p
+        s = axpy(beta, s, w)  # w + beta s == A p, by linearity
         refused = jnp.abs(rho_new) > refuse_ratio * rho_min
         improved = jnp.abs(rho_new) < rho_min
         rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
         x_best = select(improved, x, x_best)
-        beta = rho_new / rho
-        p = axpy(beta, p, z)
-        return (k + 1, x, r, p, rho_new, rho_min, x_best, refused)
+        return (k + 1, x, r, p, s, alpha, rho_new, rho_min, x_best, refused)
 
-    k, x, _, _, rho, _, x_best, refused = jax.lax.while_loop(cond, body, state0)
-    return select(~refused, x, x_best), k, rho
+    (k, x, _, _, _, _, rho, _, x_best, refused) = jax.lax.while_loop(
+        cond, body, state0)
+    return select(~refused, x, x_best), k, rho, r0_ratio
 
 
 def plain_pcg_solve(
@@ -334,8 +403,13 @@ def plain_pcg_solve(
     cam_sorted: bool = False,
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
     plans: Optional[DualPlans] = None,
+    x0: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
+
+    `x0` (a (dx_cam, dx_pt) pair) warm-starts the CG iteration; `tol`
+    may be a traced scalar (the inexact-LM forcing path passes eta_k^2
+    per LM iteration).
 
     `preconditioner` is accepted for signature parity and ignored: the
     full system's exact block diagonal (Hpp, Hll) IS this solver's
@@ -381,10 +455,11 @@ def plain_pcg_solve(
         rc, rp = r
         return cam_block_matvec(Minv_c, rc), block_matvec_fm(Minv_p, rp)
 
-    (xc, xp), k, rho = _pcg_core(
+    (xc, xp), k, rho, r0_ratio = _pcg_core(
         h_matvec, precond, (system.g_cam, system.g_pt),
-        max_iter, tol, refuse_ratio, tol_relative)
-    return PCGResult(dx_cam=xc, dx_pt=xp, iterations=k, rho=rho)
+        max_iter, tol, refuse_ratio, tol_relative, x0=x0)
+    return PCGResult(dx_cam=xc, dx_pt=xp, iterations=k, rho=rho,
+                     r0_ratio=r0_ratio)
 
 
 @jax.named_scope("megba.schur_diag_precond")
@@ -464,6 +539,7 @@ def schur_pcg_solve(
     cam_sorted: bool = False,
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
     plans: Optional[DualPlans] = None,
+    x0: Optional[jax.Array] = None,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -474,6 +550,10 @@ def schur_pcg_solve(
     restores the best iterate and stops (schur_pcg_solver.cu:288-296).
     `region` is the LM trust region; damping multiplies block diagonals by
     (1 + 1/region).
+
+    `x0` ([cd, Nc] rows, original variables) warm-starts the reduced CG
+    iteration; `tol` may be a traced scalar (the inexact-LM forcing path
+    passes eta_k^2 per LM iteration).
     """
     # Retrace sentinel hook (analysis/retrace.py): counts only under an
     # active jax trace — eager calls are not compilations.
@@ -559,13 +639,19 @@ def schur_pcg_solve(
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
     v = g_cam - hpl(block_matvec_fm(Hll_inv, g_pt))
 
-    x, k, rho = _pcg_core(
+    if x0 is not None and mixed_precision:
+        # The CG runs in the symmetrically scaled variables x~ = x / d;
+        # bring the (original-variable) warm start over.
+        x0 = x0 / d_cam
+
+    x, k, rho, r0_ratio = _pcg_core(
         s_matvec, lambda r: cam_block_matvec(Minv, r), v,
-        max_iter, tol, refuse_ratio, tol_relative)
+        max_iter, tol, refuse_ratio, tol_relative, x0=x0)
 
     # Back-substitute the point update       [1 psum]
     dx_pt = block_matvec_fm(Hll_inv, g_pt - hlp(x))
     if mixed_precision:
         x = x * d_cam  # unscale back to the original variables
         dx_pt = dx_pt * d_pt
-    return PCGResult(dx_cam=x, dx_pt=dx_pt, iterations=k, rho=rho)
+    return PCGResult(dx_cam=x, dx_pt=dx_pt, iterations=k, rho=rho,
+                     r0_ratio=r0_ratio)

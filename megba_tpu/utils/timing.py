@@ -60,6 +60,14 @@ class PhaseTimer:
                 self.totals[name] = self.totals.get(name, 0.0) + dt
                 self.counts[name] = self.counts.get(name, 0) + 1
 
+    def count_event(self, name: str, n: int = 1) -> None:
+        """Count an instantaneous event (zero duration) — e.g. the host
+        plan cache's `plan_cache_hit` counter.  Shows up in `as_dict()`
+        / `report()` with total_s 0.0 and `calls` = occurrence count, so
+        the SolveReport/bench phase schema is unchanged."""
+        self.totals.setdefault(name, 0.0)
+        self.counts[name] = self.counts.get(name, 0) + n
+
     def as_dict(self) -> Dict[str, Dict[str, float]]:
         """{name: {total_s, calls}} — the SolveReport `phases` payload."""
         return {name: {"total_s": self.totals[name],

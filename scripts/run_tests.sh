@@ -46,3 +46,29 @@ assert res.trace is not None and int(res.iterations) > 0
 PY
 JAX_PLATFORMS=cpu python -m megba_tpu.observability.summarize "$SMOKE" | grep -q "phases:"
 echo "observability smoke OK"
+
+# Inexact-LM smoke: venice-10% convergence-mode bench with the
+# MEGBA_BENCH_FORCING=1 head-to-head — adaptive forcing + warm starts
+# must cut total PCG iterations >= 30% at an unchanged final cost
+# (the curve-parity gap_tol regime, utils/curves), and the comparison
+# rides the bench JSON line.
+FORCING_OUT=$(mktemp /tmp/megba_forcing_smoke.XXXXXX.json)
+trap 'rm -f "$SMOKE" "$FORCING_OUT"' EXIT
+JAX_PLATFORMS=cpu MEGBA_BENCH_CONFIG=venice MEGBA_BENCH_SCALE=0.1 \
+MEGBA_BENCH_CONVERGENCE=0 MEGBA_BENCH_FORCING=1 \
+  python bench.py > "$FORCING_OUT"
+python - "$FORCING_OUT" <<'PY'
+import json
+import sys
+
+line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
+fc = json.loads(line)["extra"]["forcing"]
+print("inexact-LM smoke:", json.dumps(fc))
+assert fc["pcg_reduction"] >= 0.30, (
+    f"forcing cut only {100 * fc['pcg_reduction']:.1f}% of PCG iterations "
+    "(need >= 30%)")
+assert fc["cost_rel_gap"] <= 1e-2, (
+    f"forcing moved the final cost by {fc['cost_rel_gap']:.2e} "
+    "(> 1e-2 curve gap_tol)")
+PY
+echo "inexact-LM smoke OK"

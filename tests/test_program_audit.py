@@ -73,12 +73,17 @@ def test_collective_census_matches_analytic_expectation(audits):
     # S·p), one for PGO's matrix-free H·x; single-device programs carry
     # no collectives at all.
     assert len(audits["ba_sharded_w2_f32"].pcg_body_collectives()) == 2
+    # Inexact LM (adaptive forcing + warm starts) must add ZERO
+    # collectives to the CG step: the traced eta_k is pure carry math
+    # and the warm-start products live outside the while body.
+    assert len(audits["ba_forcing_w2_f32"].pcg_body_collectives()) == 2
     assert len(audits["pgo_sharded_w2_f64"].pcg_body_collectives()) == 1
     for name in ("ba_single_f32", "ba_tiled_f32", "pgo_single_f64"):
         assert audits[name].collectives == [], name
     # psum is the only prescribed collective: everything the SPMD
     # programs emit is an all-reduce.
-    for name in ("ba_sharded_w2_f32", "pgo_sharded_w2_f64"):
+    for name in ("ba_sharded_w2_f32", "ba_forcing_w2_f32",
+                 "pgo_sharded_w2_f64"):
         kinds = {op.kind for op in audits[name].collectives}
         assert kinds == {"all_reduce"}, (name, kinds)
 
