@@ -130,6 +130,14 @@ LM_ITERS = 8
 PCG_ITERS = 30
 
 
+def _status_name(res):
+    if getattr(res, "status", None) is None:
+        return None
+    from megba_tpu.common import status_name
+
+    return status_name(res.status)
+
+
 def main() -> None:
     import sys
 
@@ -418,6 +426,10 @@ def main() -> None:
                 "fallback": fallback,
                 "extra": {
                     "backend": backend,
+                    # Termination semantics (common.SolveStatus): a
+                    # driver reading this line can tell a converged
+                    # number from a stalled or recovered one.
+                    "status": _status_name(res),
                     "tiled_engine": bool(tiled),
                     "lm_iter_ms": round(1000.0 * elapsed / iters, 3),
                     "pcg_iters_per_lm": round(measured_pcg_per_lm, 2),

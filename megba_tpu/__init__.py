@@ -24,8 +24,11 @@ from megba_tpu.common import (
     LinearSystemKind,
     PreconditionerKind,
     ProblemOption,
+    RobustOption,
     SolverKind,
     SolverOption,
+    SolveStatus,
+    status_name,
 )
 from megba_tpu.core.types import BALData, BAState
 from megba_tpu.problem import (
@@ -78,10 +81,13 @@ __all__ = [
     "PreconditionerKind",
     "ProblemOption",
     "RobustKind",
+    "RobustOption",
+    "SolveStatus",
     "SolverKind",
     "SolverOption",
     "VertexKind",
     "solve_bal",
     "solve_g2o",
     "solve_pgo",
+    "status_name",
 ]

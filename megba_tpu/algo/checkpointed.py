@@ -63,14 +63,17 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
                  total, checkpoint_path, checkpoint_every):
     """The shared chunk loop: resume, solve in chunks, snapshot, aggregate.
 
-    `solve_chunk(params, max_iter, region, v, dx) -> (result, new_params)`
-    runs up to `max_iter` LM iterations from `params` with the given
-    trust-region resume state (None, None on a fresh start; `dx` is the
-    warm-start resume state — the previous chunk's last accepted step —
-    None when unknown or warm starts are off).  `result` must expose
-    cost / initial_cost / region / v / iterations / accepted /
-    pcg_iterations / stopped.  `dump_params(params)` returns the two
-    arrays the snapshot format stores; `load_params(st)` inverts it.
+    `solve_chunk(params, max_iter, region, v, dx, done) -> (result,
+    new_params)` runs up to `max_iter` LM iterations from `params` with
+    the given trust-region resume state (None, None on a fresh start;
+    `dx` is the warm-start resume state — the previous chunk's last
+    accepted step — None when unknown or warm starts are off; `done` is
+    the GLOBAL iteration the chunk starts at, so per-chunk operands like
+    a FaultPlan window can be anchored in whole-solve iterations).
+    `result` must expose cost / initial_cost / region / v / iterations /
+    accepted / pcg_iterations / stopped.  `dump_params(params)` returns
+    the two arrays the snapshot format stores; `load_params(st)` inverts
+    it.
     """
     if checkpoint_every < 1:
         raise ValueError(
@@ -81,6 +84,8 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     dx = None
     accepted_total = 0
     pcg_total = 0
+    recoveries_total = 0
+    fatal_total = False
     first_cost = None
     already_stopped = False
     # Per-chunk trace slices (host numpy), stitched into one whole-solve
@@ -110,6 +115,8 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         done = int(st["iteration"])
         accepted_total = int(st.get("extra_accepted", 0))
         pcg_total = int(st.get("extra_pcg", 0))
+        recoveries_total = int(st.get("extra_recoveries", 0))
+        fatal_total = bool(st.get("extra_fatal", False))
         if "extra_first_cost" in st:
             first_cost = jnp.asarray(st["extra_first_cost"])
         already_stopped = bool(st.get("extra_stopped", False))
@@ -134,7 +141,7 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     result = None
     while not already_stopped and done < total:
         chunk = min(checkpoint_every, total - done)
-        result, params = solve_chunk(params, chunk, region, v, dx)
+        result, params = solve_chunk(params, chunk, region, v, dx, done)
         region = float(result.region)
         v = float(result.v)
         if getattr(result, "dx_cam", None) is not None:
@@ -143,6 +150,16 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
             first_cost = result.initial_cost
         accepted_total += int(result.accepted)
         pcg_total += int(result.pcg_iterations)
+        if getattr(result, "recoveries", None) is not None:
+            recoveries_total += int(result.recoveries)
+        if getattr(result, "status", None) is not None:
+            # Fatality is sticky across chunk boundaries: without this
+            # the snapshot records only stopped=True, and a resumed
+            # fatal solve would re-derive as recovered/converged.
+            from megba_tpu.common import SolveStatus
+
+            fatal_total = fatal_total or (
+                int(result.status) == int(SolveStatus.FATAL_NONFINITE))
         ran = int(result.iterations)
         done += ran
         stopped = bool(result.stopped) or ran < chunk
@@ -150,6 +167,8 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         extra = {"v": np.asarray(v),
                  "accepted": np.asarray(accepted_total),
                  "pcg": np.asarray(pcg_total),
+                 "recoveries": np.asarray(recoveries_total),
+                 "fatal": np.asarray(fatal_total),
                  "first_cost": np.asarray(float(first_cost)),
                  "stopped": np.asarray(stopped),
                  "topology": topo}
@@ -174,7 +193,7 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
             break  # converged (possibly exactly on the chunk boundary)
 
     if result is None:  # resumed at/past total (or converged): evaluate
-        result, params = solve_chunk(params, 0, region, v, dx)
+        result, params = solve_chunk(params, 0, region, v, dx, done)
         if first_cost is None:
             first_cost = result.initial_cost
         if already_stopped:
@@ -192,6 +211,27 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         # last chunk's raw [chunk] buffers alone would misreport a
         # resumed/chunked solve.
         fields["trace"] = trace_concat(trace_parts)
+    if getattr(result, "status", None) is not None:
+        # Whole-solve termination semantics: a fatal last chunk stays
+        # fatal; recoveries in ANY chunk mark the solve recovered; the
+        # converged/max_iter/stalled split re-derives from whole-solve
+        # aggregates (the last chunk alone would call a resumed,
+        # long-converged solve "stalled").
+        from megba_tpu.algo.lm import derive_status
+        from megba_tpu.common import SolveStatus
+
+        # `fatal_total` covers chunks persisted before a resume; the
+        # last-chunk check covers the in-process path (it is what set
+        # fatal_total on the final loop pass anyway).
+        fatal = fatal_total or (
+            int(result.status) == int(SolveStatus.FATAL_NONFINITE))
+        fields["status"] = derive_status(
+            stopped=jnp.bool_(bool(result.stopped)),
+            accepted=accepted_total,
+            recoveries=recoveries_total,
+            fatal=jnp.bool_(fatal))
+        if getattr(result, "recoveries", None) is not None:
+            fields["recoveries"] = jnp.asarray(recoveries_total, jnp.int32)
     return _replace(result, **fields)
 
 
@@ -220,18 +260,28 @@ def solve_checkpointed(
 
     cam_dtype = cameras.dtype
     pt_dtype = points.dtype
+    # A seeded FaultPlan is anchored in GLOBAL iterations: each chunk
+    # re-offsets it so local iteration 0 maps to the chunk's resume
+    # point.  window/offset are dynamic operands, so the slide costs no
+    # recompile.
+    fault_plan = solve_kwargs.pop("fault_plan", None)
 
-    def solve_chunk(params, max_iter, region, v, dx):
+    def solve_chunk(params, max_iter, region, v, dx, done):
         cams, pts = params
         chunk_option = dataclasses.replace(
             option,
             algo_option=dataclasses.replace(
                 option.algo_option, max_iter=max_iter))
+        kwargs = dict(solve_kwargs)
+        if fault_plan is not None:
+            from megba_tpu.robustness.faults import with_offset
+
+            kwargs["fault_plan"] = with_offset(fault_plan, done)
         result = flat_solve(
             residual_jac_fn, cams, pts, obs, cam_idx, pt_idx,
             chunk_option, verbose=verbose,
             initial_region=region, initial_v=v, initial_dx=dx,
-            **solve_kwargs)
+            **kwargs)
         return result, (result.cameras, result.points)
 
     return _run_chunked(
@@ -271,11 +321,11 @@ def solve_pgo_checkpointed(
     """
     from megba_tpu.models.pgo import solve_pgo
 
-    def solve_chunk(params, max_iter, region, v, dx):
+    def solve_chunk(params, max_iter, region, v, dx, done):
         # PGO has no cross-chunk warm-start operand (its warm-start
-        # carry lives inside the loop only); `dx` is accepted for the
-        # shared chunk-loop contract and unused.
-        del dx
+        # carry lives inside the loop only); `dx`/`done` are accepted
+        # for the shared chunk-loop contract and unused.
+        del dx, done
         chunk_option = dataclasses.replace(
             option,
             algo_option=dataclasses.replace(

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from megba_tpu.analysis.retrace import note_trace, static_key
-from megba_tpu.common import ComputeKind, ProblemOption
+from megba_tpu.common import ComputeKind, ProblemOption, SolveStatus
 from megba_tpu.linear_system.builder import (
     SchurSystem,
     build_schur_system,
@@ -81,6 +81,27 @@ def eisenstat_walker_eta(eta_prev, cost_new, cost_prev, rho, accept,
                      jnp.maximum(0.25 * eta_prev, eta_min))
 
 
+def derive_status(*, stopped, accepted, recoveries, fatal):
+    """Termination status code (common.SolveStatus), computed on device.
+
+    Shared by the BA and PGO loops and re-derived by the chunked driver
+    from whole-solve aggregates.  Priority: a fatal bail-out trumps
+    everything; any contained recovery marks the solve `recovered`
+    (callers should treat the result as valid but re-validate inputs);
+    otherwise the stop flag separates `converged` from budget
+    exhaustion, and zero accepted steps downgrade the latter to
+    `stalled`.
+    """
+    status = jnp.where(
+        stopped, jnp.int32(SolveStatus.CONVERGED),
+        jnp.where(jnp.asarray(accepted) > 0,
+                  jnp.int32(SolveStatus.MAX_ITER),
+                  jnp.int32(SolveStatus.STALLED)))
+    status = jnp.where(jnp.asarray(recoveries) > 0,
+                       jnp.int32(SolveStatus.RECOVERED), status)
+    return jnp.where(fatal, jnp.int32(SolveStatus.FATAL_NONFINITE), status)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class LMResult:
@@ -108,6 +129,12 @@ class LMResult:
     # it back in as `initial_dx` so warm starts survive chunk
     # boundaries.
     dx_cam: Optional[jax.Array] = None
+    # Termination semantics (robustness layer): a common.SolveStatus
+    # code (int32 scalar, derive_status) and the number of contained
+    # fault recoveries the guards performed (0 with guards off).  None
+    # only on results built by legacy constructors.
+    status: Optional[jax.Array] = None
+    recoveries: Optional[jax.Array] = None
 
 
 def lm_solve(
@@ -130,6 +157,7 @@ def lm_solve(
     initial_v=None,
     verbose_token=None,
     initial_dx=None,
+    fault_plan=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -151,6 +179,20 @@ def lm_solve(
     in solve.py arranges this); internally Jp is carried in PT-slot
     order so both Hessian sides and both coupling products reduce over
     sorted block-aligned segments.
+
+    `fault_plan` (robustness.faults.FaultPlan, edge_nan already in this
+    call's edge order) injects deterministic faults at the residual /
+    linear-system boundary — the CI harness for the RobustOption guards.
+    `option.robust_option.guards` arms on-device fault containment: a
+    non-finite step (trial cost, dx, or PCG residual energy) is rolled
+    back bitwise (the carry already holds the last accepted state), the
+    system is relinearised at the rolled-back point, the trust region is
+    divided by `damping_inflation`, and after more than `max_recoveries`
+    consecutive failures the loop bails out with
+    SolveStatus.FATAL_NONFINITE.  Detection reads only replicated,
+    already-psum-reduced scalars, so the sharded program gains no
+    collectives; with nothing failing every selected value is bitwise
+    identical to the unguarded solve.
     """
     # Retrace sentinel (analysis/retrace.py): note_trace counts only
     # under an active jax trace (eager lm_solve calls are not
@@ -164,6 +206,8 @@ def lm_solve(
     algo_opt = option.algo_option
     solver_opt = option.solver_option
     compute_kind = option.compute_kind
+    robust_opt = option.robust_option
+    guards = robust_opt.guards
 
     def psum(x):
         return jax.lax.psum(x, axis_name) if axis_name is not None else x
@@ -171,7 +215,7 @@ def lm_solve(
     robust = option.robust_kind
     robust_delta = option.robust_delta
 
-    def linearize(cams, pts):
+    def linearize(cams, pts, k=0):
         # named_scope: zero runtime cost, but the residual+Jacobian ops
         # carry a navigable label in trace_profile output
         # (TensorBoard/Perfetto) instead of dissolving into fused soup.
@@ -181,6 +225,15 @@ def lm_solve(
             r, Jc, Jp = weight_system_inputs(
                 r, Jc, Jp, cam_idx, pt_idx, mask, sqrt_info, cam_fixed,
                 pt_fixed)
+        if fault_plan is not None:
+            # Seeded fault (robustness/faults.py): poison AFTER masking
+            # so the injection cannot be laundered away by padding, and
+            # stamp the call with the LM iteration whose system it
+            # produces (the pre-loop linearisation shares stamp 0 with
+            # iteration 0's evaluations).
+            from megba_tpu.robustness.faults import poison_residuals
+
+            r = poison_residuals(r, fault_plan, k)
         # Costs use compensated f32 sums (ops/accum.py): at BAL-Final
         # scale (~58M terms) a plain f32 sum's O(n*eps) error would flip
         # accept/reject decisions near convergence; the reference gets
@@ -205,9 +258,16 @@ def lm_solve(
             compute_kind=compute_kind, axis_name=axis_name,
             cam_fixed=cam_fixed, pt_fixed=pt_fixed, cam_sorted=cam_sorted,
             plans=plans)
+        if fault_plan is not None:
+            # Linear-system boundary fault: Schur-block indefiniteness
+            # (chosen Hll blocks negated while the window is open).
+            from megba_tpu.robustness.faults import poison_system
+
+            system = poison_system(system, fault_plan, k)
         return r, Jc, Jp, system, cost, wcost
 
-    r0, Jc0, Jp0, system0, cost0, wcost0 = linearize(cameras, points)
+    r0, Jc0, Jp0, system0, cost0, wcost0 = linearize(
+        cameras, points, jnp.int32(0))
 
     dtype = cameras.dtype
     forcing = solver_opt.forcing
@@ -245,6 +305,12 @@ def lm_solve(
                    else jnp.asarray(initial_dx, dtype))
         state0["dx0"] = (dx0_cam if option.use_schur
                          else (dx0_cam, jnp.zeros_like(points)))
+    if guards:
+        # Fault-containment carry: consecutive-failure streak, total
+        # contained recoveries, and the fatal bail-out flag.
+        state0["fail_streak"] = jnp.int32(0)
+        state0["recoveries"] = jnp.int32(0)
+        state0["fatal"] = jnp.bool_(False)
 
     def cond(s):
         return (s["k"] < algo_opt.max_iter) & (~s["stop"])
@@ -269,7 +335,9 @@ def lm_solve(
                 mixed_precision=option.mixed_precision_pcg,
                 cam_sorted=cam_sorted,
                 preconditioner=solver_opt.preconditioner, plans=plans,
-                x0=s["dx0"] if warm_start else None)
+                x0=s["dx0"] if warm_start else None,
+                guard=guards,
+                max_restarts=robust_opt.pcg_max_restarts if guards else 0)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply
@@ -321,20 +389,55 @@ def lm_solve(
         # loop body.  This mirrors the reference's cheap second forward()
         # (residual jets only feed the norm unless the step is accepted,
         # lm_algo.cu:183-189,209-214).
-        _, _, _, _, cost_new, wcost_new = linearize(cams_new, pts_new)
+        _, _, _, _, cost_new, wcost_new = linearize(cams_new, pts_new,
+                                                    s["k"])
         rho = (cost_new - s["cost"]) / denominator
 
         # Reference lm_algo.cu breaks BEFORE edges.update() when the
         # step-size test fires — a converged step is never applied.
         accept = (cost_new < s["cost"]) & (~converged)
+        recover = jnp.bool_(False)
+        if guards:
+            # Fault containment.  Every detector input is a replicated
+            # scalar that already rode the existing psums (NaN
+            # propagates through them), so the sharded program gains no
+            # collectives.  `step_bad`: the trial cost, the step, or the
+            # PCG's final residual energy left the finite range — the
+            # latter catches a poisoned CARRIED system, whose zero-
+            # iteration PCG exit would otherwise masquerade as a
+            # converged dx = 0.  A `broken` PCG (breakdown-restart
+            # budget exhausted — the inner solver declared the operator
+            # sick) is a step failure too: its carried system needs the
+            # same rollback + relinearisation + damping inflation.
+            step_bad = ~(jnp.isfinite(cost_new) & jnp.isfinite(dx_norm)
+                         & jnp.isfinite(pcg.rho)) | pcg.broken
+            converged = converged & ~step_bad
+            # Adoption heals a non-finite CARRIED cost (a fault during
+            # the linearisation that produced it — e.g. a poisoned
+            # chunk-resume relinearisation): once the step evaluates
+            # finite again, accept it unconditionally so the carried
+            # cost/wcost rejoin the finite regime.
+            adopt = (~jnp.isfinite(s["cost"])) & ~step_bad & ~converged
+            accept = (accept & ~step_bad) | adopt
+            # Recovery = rollback (the reject path already keeps the
+            # last accepted parameters bitwise) + relinearisation at the
+            # rolled-back point + damping inflation, counted below.
+            recover = step_bad
 
-        # Relinearise ONLY on accept (lax.cond; `accept` is replicated
-        # across shards, so all replicas take the same branch and the
-        # psums inside stay collective-safe).  The reference's reject
-        # path likewise skips buildLinearSystem (lm_algo.cu:206-214);
-        # round 1 paid a full rebuild per rejected step.
+        # Relinearise on accept — and, with guards armed, on a recovery
+        # (at the ROLLED-BACK parameters, healing a poisoned carried
+        # r/J/system).  lax.cond: the predicate and the selected
+        # parameters are replicated across shards, so all replicas take
+        # the same branch and the psums inside stay collective-safe.
+        # The reference's reject path likewise skips buildLinearSystem
+        # (lm_algo.cu:206-214); round 1 paid a full rebuild per rejected
+        # step.
+        relin = accept | recover
+
         def _relinearize(_):
-            r_n, Jc_n, Jp_n, system_n, _, _ = linearize(cams_new, pts_new)
+            r_n, Jc_n, Jp_n, system_n, _, _ = linearize(
+                jnp.where(accept, cams_new, s["cameras"]),
+                jnp.where(accept, pts_new, s["points"]), s["k"])
             return r_n, Jc_n, Jp_n, system_n
 
         def _keep_old(_):
@@ -342,17 +445,31 @@ def lm_solve(
 
         with jax.named_scope("megba.lm_accept_reject"):
             r_n, Jc_n, Jp_n, system_n = jax.lax.cond(
-                accept, _relinearize, _keep_old, None)
+                relin, _relinearize, _keep_old, None)
 
         g_inf = jnp.maximum(jnp.max(jnp.abs(system_n.g_cam)),
                             jnp.max(jnp.abs(system_n.g_pt)))
         region_accept = s["region"] / jnp.maximum(
             jnp.asarray(1.0 / 3.0, dtype), 1.0 - (2.0 * rho - 1.0) ** 3)
+        if guards:
+            # An adopted (carry-healing) accept has rho = NaN by
+            # construction (its denominator ran through the non-finite
+            # carried cost); the region must not inherit it.
+            region_accept = jnp.where(jnp.isfinite(rho), region_accept,
+                                      s["region"])
         stop_accept = g_inf <= algo_opt.epsilon1
 
         # --- reject branch values ---
         region_reject = s["region"] / s["v"]
         v_reject = s["v"] * 2.0
+        if guards:
+            # A recovery inflates damping by the configured factor
+            # instead of the reject back-off (region ∝ 1/damping), and
+            # leaves the back-off factor untouched.
+            inflation = jnp.asarray(robust_opt.damping_inflation, dtype)
+            region_reject = jnp.where(recover, s["region"] / inflation,
+                                      region_reject)
+            v_reject = jnp.where(recover, s["v"], v_reject)
 
         def pick(new, old):
             return jax.tree_util.tree_map(
@@ -363,7 +480,29 @@ def lm_solve(
                 eta_next = eisenstat_walker_eta(
                     s["eta"], cost_new, s["cost"], rho, accept,
                     eta_min_c, eta_max_c, dtype)
+            if guards:
+                # An adopted accept feeds NaN cost ratios through the
+                # forcing update; restart the schedule at the cap
+                # rather than poisoning every later tolerance.
+                eta_next = jnp.where(jnp.isfinite(eta_next), eta_next,
+                                     eta_max_c)
 
+        stop = converged | (accept & stop_accept)
+        if guards:
+            fail_streak = jnp.where(recover, s["fail_streak"] + 1,
+                                    jnp.int32(0))
+            fatal = fail_streak > robust_opt.max_recoveries
+            stop = stop | fatal
+        # Robustness trace fields stay None (zero-fill, zero update ops)
+        # with guards off; the precond-fallback count is recorded
+        # whenever the SCHUR_DIAG preconditioner is live.
+        trace_robust = dict(
+            precond_fallback=(
+                pcg.precond_fallback
+                if solver_opt.preconditioner.name == "SCHUR_DIAG" else None))
+        if guards:
+            trace_robust.update(recovery=recover,
+                                pcg_breakdown=pcg.breakdowns)
         s_next = dict(
             k=s["k"] + 1,
             accepted=s["accepted"] + jnp.where(accept, 1, 0).astype(jnp.int32),
@@ -379,7 +518,7 @@ def lm_solve(
             wcost=jnp.where(accept, wcost_new, s["wcost"]),
             region=jnp.where(accept, region_accept, region_reject),
             v=jnp.where(accept, jnp.asarray(2.0, dtype), v_reject),
-            stop=converged | (accept & stop_accept),
+            stop=stop,
             # Every recorded value is replicated across shards (costs,
             # g_inf and rho come out of psum-reduced quantities; the
             # trust-region state is carried replicated), so the trace
@@ -392,8 +531,13 @@ def lm_solve(
                 pcg_iters=pcg.iterations,
                 pcg_eta=(s["eta"] if forcing
                          else jnp.asarray(solver_opt.tol, dtype)),
-                pcg_r0_ratio=pcg.r0_ratio.astype(dtype)),
+                pcg_r0_ratio=pcg.r0_ratio.astype(dtype),
+                **trace_robust),
         )
+        if guards:
+            s_next["fail_streak"] = fail_streak
+            s_next["recoveries"] = s["recoveries"] + recover.astype(jnp.int32)
+            s_next["fatal"] = s["fatal"] | fatal
         if forcing:
             s_next["eta"] = eta_next
         if warm_start:
@@ -415,6 +559,10 @@ def lm_solve(
     dx_final = None
     if warm_start:
         dx_final = out["dx0"] if option.use_schur else out["dx0"][0]
+    recoveries = out["recoveries"] if guards else jnp.int32(0)
+    fatal = out["fatal"] if guards else jnp.bool_(False)
+    status = derive_status(stopped=out["stop"], accepted=out["accepted"],
+                           recoveries=recoveries, fatal=fatal)
     return LMResult(
         cameras=out["cameras"],
         points=out["points"],
@@ -428,6 +576,8 @@ def lm_solve(
         stopped=out["stop"],
         trace=out["trace"],
         dx_cam=dx_final,
+        status=status,
+        recoveries=recoveries,
     )
 
 

@@ -237,10 +237,11 @@ def _ba_option():
         solver_option=SolverOption(max_iter=8, tol=1e-8))
 
 
-def _lower_ba(world: int, use_tiled: bool, forcing: bool = False):
+def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
+              guarded: bool = False):
     import dataclasses as _dc
 
-    from megba_tpu.common import JacobianMode, SolverOption
+    from megba_tpu.common import JacobianMode, RobustOption, SolverOption
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
     from megba_tpu.solve import flat_solve
 
@@ -253,6 +254,10 @@ def _lower_ba(world: int, use_tiled: bool, forcing: bool = False):
         # forcing (eta_k a traced while-carry scalar) + warm starts.
         option = _dc.replace(option, solver_option=SolverOption(
             max_iter=8, tol=1e-1, forcing=True, warm_start=True))
+    if guarded:
+        # Fault-containment canonical program: LM rollback/recovery +
+        # PCG breakdown restarts armed (robustness layer).
+        option = _dc.replace(option, robust_option=RobustOption(guards=True))
     f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
                       option, use_tiled=use_tiled, lower_only=True)
@@ -317,6 +322,19 @@ def program_specs() -> Dict[str, ProgramSpec]:
             donate_leaves=_sharded_donation(),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     forcing=True)),
+        "ba_guarded_w2_f32": ProgramSpec(
+            name="ba_guarded_w2_f32", float_family="f32", world=2,
+            # RobustOption guards: detection reads only the already-
+            # psum-reduced scalars (NaN propagates through the existing
+            # reductions) and the PCG restart reuses the body's single
+            # matvec slot, so the guarded while body carries EXACTLY the
+            # same two all-reduces as the unguarded Schur solve — a
+            # guard that added a sync or a host transfer is precisely
+            # the regression this spec pins against.
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            build=lambda: _lower_ba(world=2, use_tiled=False,
+                                    guarded=True)),
         "pgo_single_f64": ProgramSpec(
             name="pgo_single_f64", float_family="f64", world=1, pcg_psums=0,
             donate_leaves=(0,),

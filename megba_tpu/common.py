@@ -96,6 +96,59 @@ class PreconditionerKind(enum.Enum):
     SCHUR_DIAG = 1
 
 
+class SolveStatus(enum.IntEnum):
+    """Termination status of one LM solve (robustness layer).
+
+    Carried as an int32 scalar on `LMResult.status` / `PGOResult.status`
+    so the code is computed ON DEVICE inside the jitted program and the
+    caller can branch without a second device round trip.  The README
+    "Failure semantics" table maps each code to the caller action.
+    """
+
+    MAX_ITER = 0  # iteration budget exhausted with progress made
+    CONVERGED = 1  # a convergence criterion fired (step size / gradient)
+    STALLED = 2  # budget exhausted with ZERO accepted steps
+    RECOVERED = 3  # finished after >= 1 contained fault recovery
+    FATAL_NONFINITE = 4  # bailed out: max_recoveries consecutive failures
+
+
+def status_name(code) -> str:
+    """Human-readable name of a SolveStatus code (tolerates raw ints)."""
+    try:
+        return SolveStatus(int(code)).name.lower()
+    except ValueError:
+        return f"unknown({int(code)})"
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustOption:
+    """Fault-containment knobs (capability beyond the reference).
+
+    `guards=True` arms the on-device fault guards: the LM loop detects
+    non-finite steps (trial cost / dx), rolls back to the last ACCEPTED
+    state bitwise (the functional carry already holds it), relinearises
+    there, inflates damping by `damping_inflation` (the trust region is
+    divided by it, so the next system is more diagonally dominant), and
+    counts consecutive failures — bailing out with
+    `SolveStatus.FATAL_NONFINITE` after more than `max_recoveries`
+    consecutive failed recoveries.  The PCG core additionally detects
+    recurrence breakdown (non-finite or sign-flipped gamma/delta in the
+    Chronopoulos-Gear scalars) and performs up to `pcg_max_restarts`
+    in-loop cold restarts from the current iterate before flagging exit.
+
+    Detection piggybacks on scalars that are already psum-reduced (NaN
+    propagates through the existing reductions), so the sharded path
+    adds ZERO new collectives; with guards armed and nothing failing,
+    every selected value is bitwise identical to the unguarded solve
+    (tests/test_robustness.py pins this).
+    """
+
+    guards: bool = False
+    max_recoveries: int = 3
+    damping_inflation: float = 4.0
+    pcg_max_restarts: int = 2
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverOption:
     """Inner (PCG) solver options — reference common.h:27-33 defaults.
@@ -173,6 +226,9 @@ class ProblemOption:
     jacobian_mode: JacobianMode = JacobianMode.AUTODIFF
     solver_option: SolverOption = dataclasses.field(default_factory=SolverOption)
     algo_option: AlgoOption = dataclasses.field(default_factory=AlgoOption)
+    # Fault containment (robustness layer; guards are OFF by default so
+    # existing configurations keep their exact compiled programs).
+    robust_option: RobustOption = dataclasses.field(default_factory=RobustOption)
     # bf16 inner PCG vectors with fp32 reductions (BASELINE.md config 5).
     mixed_precision_pcg: bool = False
     # Robust loss (capability beyond the reference; Ceres-style kernels).
@@ -235,6 +291,18 @@ def validate_options(option: ProblemOption) -> None:
             "forcing=True clamps eta_k to [eta_min, tol]; need "
             f"eta_min <= tol, got eta_min={option.solver_option.eta_min} "
             f"> tol={option.solver_option.tol}")
+    if option.robust_option.max_recoveries < 1:
+        raise ValueError(
+            f"max_recoveries must be >= 1, got "
+            f"{option.robust_option.max_recoveries}")
+    if not option.robust_option.damping_inflation > 1.0:
+        raise ValueError(
+            f"damping_inflation must be > 1, got "
+            f"{option.robust_option.damping_inflation}")
+    if option.robust_option.pcg_max_restarts < 0:
+        raise ValueError(
+            f"pcg_max_restarts must be >= 0, got "
+            f"{option.robust_option.pcg_max_restarts}")
     if not option.use_schur and option.mixed_precision_pcg:
         raise ValueError(
             "mixed_precision_pcg is only implemented for the Schur solver "

@@ -133,26 +133,81 @@ def load_bal(path: Union[str, os.PathLike], dtype=np.float64) -> BALFile:
 
         parsed = parse_bal_native(str(path), dtype)
         if parsed is not None:
+            # The native scanner validates token counts/indices; the
+            # semantic checks (finiteness, duplicate edges) are shared
+            # here so both parsers enforce one contract.
+            _validate(parsed, where=str(path))
             return parsed
     except ImportError:
         pass
-    except ValueError:
+    except ValueError as exc:
+        if _is_semantic_error(exc):
+            raise
         # Native parse rejected the file; the NumPy tokenizer is the
         # arbiter (it raises the user-facing error if truly malformed).
-        pass
 
     with open(path, "rb") as f:
         tokens = np.fromfile(f, sep=" ")
-    return _assemble(tokens, dtype)
+    return _assemble(tokens, dtype, where=str(path))
 
 
 def loads_bal(text: str, dtype=np.float64) -> BALFile:
     """Parse BAL content from a string (tests)."""
     tokens = np.array(text.split(), dtype=np.float64)
-    return _assemble(tokens, dtype)
+    return _assemble(tokens, dtype, where="<string>")
 
 
-def _assemble(tokens: np.ndarray, dtype) -> BALFile:
+def _is_semantic_error(exc: BaseException) -> bool:
+    """True for _validate's own rejections (they must not be retried
+    through the NumPy tokenizer, which would just re-raise them)."""
+    return str(exc).startswith("BAL semantic error")
+
+
+def _validate(bal: BALFile, where: str) -> None:
+    """Reject semantically-poisoned problems with actionable context.
+
+    A single NaN observation silently poisons every psum-reduced cost in
+    the solver (the exact failure mode the RobustOption guards contain
+    at runtime — but data that arrives broken should be refused at the
+    boundary, not recovered from); duplicate (cam, pt) edges double-
+    count a factor, which BAL — unlike g2o's repeated-constraint
+    convention — never legitimately encodes.
+    """
+    bad = ~np.isfinite(bal.obs).all(axis=1)
+    if bad.any():
+        i = int(np.argmax(bad))
+        raise ValueError(
+            f"BAL semantic error in {where}: observation {i} "
+            f"(cam {int(bal.cam_idx[i])}, pt {int(bal.pt_idx[i])}) has "
+            f"non-finite pixel coordinates {bal.obs[i].tolist()}")
+    bad = ~np.isfinite(bal.cameras).all(axis=1)
+    if bad.any():
+        i = int(np.argmax(bad))
+        raise ValueError(
+            f"BAL semantic error in {where}: camera {i} has non-finite "
+            f"parameters {bal.cameras[i].tolist()}")
+    bad = ~np.isfinite(bal.points).all(axis=1)
+    if bad.any():
+        i = int(np.argmax(bad))
+        raise ValueError(
+            f"BAL semantic error in {where}: point {i} has non-finite "
+            f"coordinates {bal.points[i].tolist()}")
+    if bal.num_observations:
+        key = (bal.cam_idx.astype(np.int64) * np.int64(bal.num_points)
+               + bal.pt_idx.astype(np.int64))
+        uniq, first, counts = np.unique(key, return_index=True,
+                                        return_counts=True)
+        if (counts > 1).any():
+            d = int(first[np.argmax(counts > 1)])
+            dupes = np.nonzero(key == key[d])[0]
+            raise ValueError(
+                f"BAL semantic error in {where}: duplicate observation of "
+                f"(cam {int(bal.cam_idx[d])}, pt {int(bal.pt_idx[d])}) at "
+                f"observation indices {dupes.tolist()} — BAL edges must be "
+                "unique (a repeated row double-counts the factor)")
+
+
+def _assemble(tokens: np.ndarray, dtype, where: str = "<tokens>") -> BALFile:
     if tokens.size < 3:
         raise ValueError("not a BAL file: missing header")
     n_cam, n_pt, n_obs = (int(t) for t in tokens[:3])
@@ -162,6 +217,11 @@ def _assemble(tokens: np.ndarray, dtype) -> BALFile:
             f"BAL token count mismatch: header promises {expect}, file has {tokens.size}"
         )
     ob = tokens[3 : 3 + 4 * n_obs].reshape(n_obs, 4)
+    if not np.isfinite(ob[:, :2]).all():
+        i = int(np.argmax(~np.isfinite(ob[:, :2]).all(axis=1)))
+        raise ValueError(
+            f"BAL semantic error in {where}: observation {i} has a "
+            "non-finite camera/point index")
     cam_idx = ob[:, 0].astype(np.int32)
     pt_idx = ob[:, 1].astype(np.int32)
     obs = ob[:, 2:4].astype(dtype)
@@ -171,7 +231,9 @@ def _assemble(tokens: np.ndarray, dtype) -> BALFile:
     cameras = tokens[off : off + 9 * n_cam].reshape(n_cam, 9).astype(dtype)
     off += 9 * n_cam
     points = tokens[off : off + 3 * n_pt].reshape(n_pt, 3).astype(dtype)
-    return BALFile(cameras=cameras, points=points, obs=obs, cam_idx=cam_idx, pt_idx=pt_idx)
+    bal = BALFile(cameras=cameras, points=points, obs=obs, cam_idx=cam_idx, pt_idx=pt_idx)
+    _validate(bal, where=where)
+    return bal
 
 
 def save_bal(path: Union[str, os.PathLike], bal: BALFile) -> None:

@@ -153,11 +153,12 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
     # angle-axis) happens once on batched numpy arrays afterwards — a
     # per-line conversion costs more than the whole batched pass on
     # files with tens of thousands of records.
-    verts: dict[int, tuple[bool, list]] = {}  # vid -> (is_se2, tokens)
+    verts: dict[int, tuple[bool, list, int]] = {}  # vid -> (se2, toks, ln)
     fixed_ids: set[int] = set()
     e_ids: list[tuple[int, int]] = []
     e_se2: list[bool] = []
     e_vals: list[list] = []  # SE3: 28 tokens; SE2: 9 tokens
+    e_lns: list[int] = []  # source line of each edge (error context)
     se2_seen = False
     se3_seen = False
     had_fix = False
@@ -176,7 +177,7 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             vid = int(tok[1])
             if vid in verts:
                 raise ValueError(f"line {ln}: duplicate VERTEX id {vid}")
-            verts[vid] = (False, tok[2:])
+            verts[vid] = (False, tok[2:], ln)
             se3_seen = True
         elif tag == "VERTEX_SE2":
             if len(tok) != 5:
@@ -186,7 +187,7 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             vid = int(tok[1])
             if vid in verts:
                 raise ValueError(f"line {ln}: duplicate VERTEX id {vid}")
-            verts[vid] = (True, tok[2:])
+            verts[vid] = (True, tok[2:], ln)
             se2_seen = True
         elif tag == "EDGE_SE3:QUAT":
             if len(tok) != 3 + 7 + 21:
@@ -197,6 +198,7 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             e_ids.append((int(tok[1]), int(tok[2])))
             e_se2.append(False)
             e_vals.append(tok[3:])
+            e_lns.append(ln)
             se3_seen = True
         elif tag == "EDGE_SE2":
             if len(tok) != 3 + 3 + 6:
@@ -207,6 +209,7 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
             e_ids.append((int(tok[1]), int(tok[2])))
             e_se2.append(True)
             e_vals.append(tok[3:])
+            e_lns.append(ln)
             se2_seen = True
         elif tag == "FIX":
             had_fix = True
@@ -246,6 +249,14 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
     raw_v, _, _, _, _ = split_rows(
         [verts[vid][0] for vid in ids],
         [verts[vid][1] for vid in ids], 7, 3)
+    bad_v = ~np.isfinite(raw_v).all(axis=1)
+    if bad_v.any():
+        k = int(np.argmax(bad_v))
+        vid = int(ids[k])
+        raise ValueError(
+            f"line {verts[vid][2]}: VERTEX {vid} has non-finite "
+            "values — a NaN/inf estimate would poison every solver "
+            "reduction; fix or drop the record")
     poses = np.concatenate(
         [_quat_xyzw_to_aa(raw_v[:, 3:7]), raw_v[:, :3]], axis=1)
 
@@ -261,6 +272,18 @@ def read_g2o(source: Union[str, TextIO]) -> G2OGraph:
     if n_e:
         raw_e, se3_raw, se2_raw, se3_rows, se2_rows = split_rows(
             e_se2, e_vals, 28, 9)
+        bad_rows = np.zeros(n_e, bool)
+        # The full token payload (measurement AND information entries)
+        # must be finite; check per kind, then map back to source lines.
+        bad_rows[se3_rows] = ~np.isfinite(se3_raw).all(axis=1)
+        bad_rows[se2_rows] = ~np.isfinite(se2_raw).all(axis=1)
+        if bad_rows.any():
+            k = int(np.argmax(bad_rows))
+            raise ValueError(
+                f"line {e_lns[k]}: EDGE {e_ids[k][0]} -> {e_ids[k][1]} "
+                "has non-finite measurement/information values — a "
+                "NaN/inf factor would poison every solver reduction; "
+                "fix or drop the record")
         meas = np.concatenate(
             [_quat_xyzw_to_aa(raw_e[:, 3:7]), raw_e[:, :3]], axis=1)
         info = np.zeros((n_e, 6, 6))

@@ -81,6 +81,10 @@ class PGOResult(NamedTuple):
     region: jax.Array
     v: jax.Array  # trust-region back-off factor (resume state)
     stopped: jax.Array
+    # Termination status (common.SolveStatus int32, algo.lm.derive_status
+    # — the same semantics the BA family reports); None only on results
+    # from constructors predating it.
+    status: Optional[jax.Array] = None
 
 
 def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
@@ -301,7 +305,8 @@ def solve_pgo(
         poses=jnp.swapaxes(out["poses"], 0, 1),
         cost=out["cost"], initial_cost=cost0, iterations=out["k"],
         accepted=out["accepted"], pcg_iterations=out["pcg_total"],
-        region=out["region"], v=out["v"], stopped=out["stop"])
+        region=out["region"], v=out["v"], stopped=out["stop"],
+        status=out["status"])
     if verbose:
         print(f"PGO: cost {float(cost0):.6e} -> {float(result.cost):.6e} "
               f"in {int(result.iterations)} LM iters "
@@ -393,7 +398,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
             def precond(x):
                 return jnp.einsum("nab,bn->an", minv, x)
 
-            dx, iters, _, _ = _pcg_core(
+            dx, iters, _, _, _, _ = _pcg_core(
                 matvec, precond, -g, solver_opt.max_iter, tol,
                 solver_opt.refuse_ratio,
                 True if solver_opt.forcing else solver_opt.tol_relative,
@@ -504,11 +509,19 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
         out = jax.lax.while_loop(cond, body, state0)
         # Per-edge carries (r/J/g/h) are internal; return only the
         # replicated observables so the sharded out_specs stay P().
+        # Termination status: the shared derive_status semantics (no
+        # fault guards in the PGO loop yet, so recoveries/fatal are
+        # inert and the code splits converged / max_iter / stalled).
+        from megba_tpu.algo.lm import derive_status
+
+        status = derive_status(
+            stopped=out["stop"], accepted=out["accepted"],
+            recoveries=jnp.int32(0), fatal=jnp.bool_(False))
         return dict(
             poses=out["poses"], cost=out["cost"], cost0=cost0,
             k=out["k"], accepted=out["accepted"],
             pcg_total=out["pcg_total"], region=out["region"],
-            v=out["v"], stop=out["stop"])
+            v=out["v"], stop=out["stop"], status=status)
 
     # Retrace sentinel hook (analysis/retrace.py): one count per
     # compilation of the PGO program; zero cost once compiled.

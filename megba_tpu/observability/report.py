@@ -31,6 +31,12 @@ import numpy as np
 SCHEMA = "megba_tpu.solve_report/v1"
 
 
+def _status_name(code) -> str:
+    from megba_tpu.common import status_name
+
+    return status_name(code)
+
+
 def config_to_dict(option) -> Dict[str, Any]:
     """Serialize an option dataclass tree to plain JSON types.
 
@@ -130,6 +136,17 @@ def build_report(option, result, phases: Dict[str, Any],
             "pcg_iterations": int(result.pcg_iterations),
             "region": float(result.region),
             "stopped": bool(result.stopped),
+            # Termination semantics (robustness layer): the status CODE
+            # and its name, plus the contained-recovery count — the
+            # fields an alerting pipeline keys on.
+            "status": (None if getattr(result, "status", None) is None
+                       else int(result.status)),
+            "status_name": (
+                None if getattr(result, "status", None) is None
+                else _status_name(result.status)),
+            "recoveries": (
+                None if getattr(result, "recoveries", None) is None
+                else int(result.recoveries)),
         },
         trace=None if trace is None else trace_to_dict(trace, iterations),
         memory=device_memory_stats(),

@@ -30,6 +30,9 @@ TRACE_FIELDS = (
     "pcg_iters",
     "pcg_eta",
     "pcg_r0_ratio",
+    "recovery",
+    "pcg_breakdown",
+    "precond_fallback",
 )
 
 
@@ -58,6 +61,15 @@ class SolveTrace:
     # (1.0 on a cold start — see solver/pcg.PCGResult.r0_ratio).
     pcg_eta: jax.Array  # [max_iter] float
     pcg_r0_ratio: jax.Array  # [max_iter] float
+    # Robustness observables (megba_tpu/robustness/): whether the
+    # iteration was a contained fault recovery (rollback + damping
+    # inflation), how many in-loop cold restarts the PCG breakdown
+    # guard performed, and how many Schur-diagonal preconditioner
+    # blocks fell back to Hpp after a Cholesky NaN.  All zero-filled
+    # when guards are off / the HPP preconditioner is in use.
+    recovery: jax.Array  # [max_iter] bool
+    pcg_breakdown: jax.Array  # [max_iter] int32
+    precond_fallback: jax.Array  # [max_iter] int32
 
     @classmethod
     def empty(cls, max_iter: int, dtype) -> "SolveTrace":
@@ -71,14 +83,20 @@ class SolveTrace:
             pcg_iters=jnp.zeros((max_iter,), jnp.int32),
             pcg_eta=jnp.zeros((max_iter,), dtype),
             pcg_r0_ratio=jnp.zeros((max_iter,), dtype),
+            recovery=jnp.zeros((max_iter,), jnp.bool_),
+            pcg_breakdown=jnp.zeros((max_iter,), jnp.int32),
+            precond_fallback=jnp.zeros((max_iter,), jnp.int32),
         )
 
     def record(self, k, *, cost, grad_inf_norm, trust_region, rho, accept,
-               pcg_iters, pcg_eta=None, pcg_r0_ratio=None) -> "SolveTrace":
+               pcg_iters, pcg_eta=None, pcg_r0_ratio=None, recovery=None,
+               pcg_breakdown=None, precond_fallback=None) -> "SolveTrace":
         """Write iteration k's observables; returns the updated trace.
 
-        `pcg_eta`/`pcg_r0_ratio` default to None for callers that predate
-        the inexact-LM fields (their buffers keep the zero fill)."""
+        The trailing keyword fields default to None for callers that
+        predate them (their buffers keep the zero fill) — and the
+        robustness fields stay None in guard-off programs so arming the
+        guards is the only thing that adds their update ops."""
         if self.cost.shape[0] == 0:
             # max_iter=0 programs (the checkpointed driver's evaluate-only
             # chunk) still TRACE the loop body; indexing a size-0 buffer
@@ -95,12 +113,21 @@ class SolveTrace:
                      else self.pcg_eta.at[k].set(pcg_eta)),
             pcg_r0_ratio=(self.pcg_r0_ratio if pcg_r0_ratio is None
                           else self.pcg_r0_ratio.at[k].set(pcg_r0_ratio)),
+            recovery=(self.recovery if recovery is None
+                      else self.recovery.at[k].set(recovery)),
+            pcg_breakdown=(self.pcg_breakdown if pcg_breakdown is None
+                           else self.pcg_breakdown.at[k].set(pcg_breakdown)),
+            precond_fallback=(
+                self.precond_fallback if precond_fallback is None
+                else self.precond_fallback.at[k].set(precond_fallback)),
         )
 
 
 # Host-side dtypes of the non-float fields (empty concats and fillers
 # must not silently degrade accept/pcg_iters to float64).
-_FIELD_DTYPES = {"accept": np.bool_, "pcg_iters": np.int32}
+_FIELD_DTYPES = {"accept": np.bool_, "pcg_iters": np.int32,
+                 "recovery": np.bool_, "pcg_breakdown": np.int32,
+                 "precond_fallback": np.int32}
 
 
 def trace_slice(trace: SolveTrace, n: int) -> SolveTrace:
@@ -126,6 +153,9 @@ def trace_filler(n: int) -> SolveTrace:
         pcg_iters=np.zeros((n,), np.int32),
         pcg_eta=np.full((n,), np.nan),
         pcg_r0_ratio=np.full((n,), np.nan),
+        recovery=np.zeros((n,), np.bool_),
+        pcg_breakdown=np.zeros((n,), np.int32),
+        precond_fallback=np.zeros((n,), np.int32),
     )
 
 

@@ -126,6 +126,7 @@ def distributed_lm_solve(
     initial_region=None,
     initial_v=None,
     initial_dx=None,
+    fault_plan=None,
     jit_cache: Optional[dict] = None,
     donate: bool = False,
     lower_only: bool = False,
@@ -192,6 +193,13 @@ def distributed_lm_solve(
         # split by the mesh (ops/segtiles.make_sharded_dual_plans).
         ("plans", plans, P(EDGE_AXIS)),
     ]
+    if fault_plan is not None:
+        # Seeded-fault operand (robustness/faults.py): the edge poison
+        # is shard-local like every other edge array; the window/offset
+        # scalars and the point mask ride replicated.
+        from megba_tpu.robustness.faults import fault_partition_specs
+
+        optional.append(("fault_plan", fault_plan, fault_partition_specs()))
     keys = tuple(k for k, v, _ in optional if v is not None)
     args += [v for _, v, _ in optional if v is not None]
     in_specs += [spec for _, v, spec in optional if v is not None]

@@ -1,0 +1,39 @@
+"""Fault containment and recovery (robustness layer).
+
+Three pieces, spanning the solver stack:
+
+- **On-device guards** live where the state lives: the LM loop
+  (algo/lm.py, armed by `common.RobustOption(guards=True)`) detects
+  non-finite steps, rolls back to the last accepted state and inflates
+  damping; the PCG core (solver/pcg.py) detects Chronopoulos-Gear
+  recurrence breakdown and cold-restarts in-loop.  Detection reads only
+  scalars that are already psum-reduced, so the sharded path adds zero
+  new collectives (the compiled-program auditor pins this with the
+  `ba_guarded_w2_f32` canonical program).
+
+- **Deterministic fault injection** (`robustness.faults`): a
+  `FaultPlan` pytree rides the jitted program as a dynamic operand and
+  poisons chosen edges / point blocks at chosen LM iterations — every
+  guard is exercised by a seeded fault in CI, not just clean runs.
+
+- **Host kill-resume harness** (`robustness.harness`): SIGKILLs a
+  checkpointed-driver subprocess mid-chunk and resumes it, for
+  preemption-safety tests that need a real process death rather than an
+  in-process simulation.
+"""
+
+from megba_tpu.robustness.faults import (  # noqa: F401
+    FaultPlan,
+    fault_active,
+    fault_partition_specs,
+    lower_edge_vector,
+    make_nan_burst,
+    make_point_indefinite_burst,
+    poison_residuals,
+    poison_system,
+    with_offset,
+)
+from megba_tpu.robustness.harness import (  # noqa: F401
+    run_to_completion,
+    run_until_snapshot_then_kill,
+)

@@ -1,0 +1,167 @@
+"""Deterministic fault injection at the residual / linear-system boundary.
+
+A `FaultPlan` is a small pytree of DYNAMIC operands that rides the
+jitted LM program exactly like the optional sqrt_info / warm-start
+operands (solve.flat_solve threads it; parallel/mesh shards `edge_nan`
+on the edge axis and replicates the rest).  Because the window and
+offset are data, a chunked/checkpointed driver can slide the fault
+across chunk boundaries without recompiling, and the same compiled
+program serves faulted and clean runs of one configuration.
+
+Two fault families, matching the failure modes the guards contain:
+
+- `edge_nan` ([nE] float): NaN added to the residual rows of chosen
+  edges while the window is active — a transient data fault (bad DMA,
+  corrupted host buffer) that poisons the cost/gradient reductions.
+- `point_crush` ([Np] float): the Hll rows of chosen points are crushed
+  toward zero after the system build, so Hll^-1 blows up and the Schur
+  complement S = Hpp - Hpl Hll^-1 Hlp goes INDEFINITE while every
+  scalar stays finite — the breakdown mode the PCG guard detects via
+  sign-flipped gamma/delta.  (Negating Hll would make S *more*
+  positive definite — the subtrahend flips sign — which is why the
+  indefiniteness fault crushes instead.)
+
+Iteration indexing: a linearisation is stamped with the LM iteration
+whose system it produces — the pre-loop linearisation and every
+linearisation evaluated at carry `k` share stamp `k`, and the stamp is
+shifted into GLOBAL iterations by `offset` (the checkpointed driver
+sets it to the chunk's resume iteration).  The window is the half-open
+global-iteration range `[start, stop)`.
+
+Injection is exact: inactive windows add literal 0.0 / scale by 1.0, so
+a plan whose window never opens changes results only at the level of
+`-0.0 + 0.0` normalisation; omitting the plan entirely removes the
+injection ops from the program altogether.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One seeded fault: what to poison, and when (global LM iterations).
+
+    Frozen: a FaultPlan of PartitionSpecs doubles as the shard_map
+    in_specs tree (fault_partition_specs), which lands in hashable jit
+    cache keys.
+    """
+
+    edge_nan: jax.Array  # [nE] float: NaN at poisoned edges, 0 elsewhere
+    point_crush: jax.Array  # [Np] float: 1 at points whose Hll is crushed
+    window: jax.Array  # [2] int32: global-iteration [start, stop)
+    offset: jax.Array  # scalar int32: global iteration of local k = 0
+
+
+# Hll crush factor: small enough that Hll^-1 dominates the Schur
+# subtrahend (indefinite S), large enough that every f32 intermediate
+# stays finite.
+_CRUSH = 1e-8
+
+
+def make_nan_burst(n_edges: int, edges: Sequence[int], start: int, stop: int,
+                   n_points: int = 0, dtype=np.float32) -> FaultPlan:
+    """NaN residual burst on `edges` for global iterations [start, stop)."""
+    edge_nan = np.zeros((n_edges,), dtype)
+    edge_nan[np.asarray(list(edges), np.int64)] = np.nan
+    return FaultPlan(
+        edge_nan=edge_nan,
+        point_crush=np.zeros((n_points,), dtype),
+        window=np.asarray([start, stop], np.int32),
+        offset=np.int32(0),
+    )
+
+
+def make_point_indefinite_burst(n_points: int, points: Sequence[int],
+                                start: int, stop: int, n_edges: int = 0,
+                                dtype=np.float32) -> FaultPlan:
+    """Crush the Hll blocks of `points` for global iterations [start, stop).
+
+    The crushed blocks invert to huge (finite) values, the Schur
+    subtrahend Hpl Hll^-1 Hlp overwhelms Hpp, and S goes indefinite —
+    the PCG guard's sign-flipped-delta breakdown mode, with every
+    scalar still finite.
+    """
+    crush = np.zeros((n_points,), dtype)
+    crush[np.asarray(list(points), np.int64)] = 1.0
+    return FaultPlan(
+        edge_nan=np.zeros((n_edges,), dtype),
+        point_crush=crush,
+        window=np.asarray([start, stop], np.int32),
+        offset=np.int32(0),
+    )
+
+
+def with_offset(plan: FaultPlan, offset: int) -> FaultPlan:
+    """Shift the plan so local iteration 0 maps to global `offset`."""
+    return dataclasses.replace(plan, offset=np.int32(offset))
+
+
+def fault_active(plan: FaultPlan, k) -> jax.Array:
+    """Replicated bool scalar: is the window open at local iteration k?"""
+    g = jnp.asarray(k, jnp.int32) + plan.offset
+    return (g >= plan.window[0]) & (g < plan.window[1])
+
+
+def poison_residuals(r: jax.Array, plan: FaultPlan, k) -> jax.Array:
+    """Add the (window-gated) edge poison to the [od, nE] residual rows."""
+    active = fault_active(plan, k)
+    poison = jnp.where(active, plan.edge_nan, 0.0).astype(r.dtype)
+    return r + poison[None, :]
+
+
+def poison_system(system, plan: FaultPlan, k):
+    """Crush the Hll rows of the planned points while the window is open.
+
+    `system` is a linear_system.builder.SchurSystem; Hll is replicated
+    ([pd*pd, Np] rows), so the scale vector is replicated too and the
+    sharded path is untouched.
+    """
+    if plan.point_crush.shape[0] != system.Hll.shape[1]:
+        # Plans built without a point axis (pure edge faults) skip the
+        # system transform entirely — no dead multiply in the program.
+        return system
+    active = fault_active(plan, k)
+    scale = jnp.where(active & (plan.point_crush > 0), _CRUSH, 1.0)
+    return dataclasses.replace(
+        system, Hll=system.Hll * scale[None, :].astype(system.Hll.dtype))
+
+
+def fault_partition_specs():
+    """shard_map in_specs tree for a FaultPlan operand (edge axis only
+    on `edge_nan`; everything else replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    from megba_tpu.parallel.mesh import EDGE_AXIS
+
+    return FaultPlan(edge_nan=P(EDGE_AXIS), point_crush=P(),
+                     window=P(), offset=P())
+
+
+def lower_edge_vector(vec: np.ndarray, perm: Optional[np.ndarray] = None,
+                      mask: Optional[np.ndarray] = None,
+                      n_padded: Optional[int] = None) -> np.ndarray:
+    """Apply the solve lowering's edge permutation/padding to a [nE] vector.
+
+    Mirrors what flat_solve does to `obs`: optional permutation into
+    slot/sort order, explicit zeroing of padding slots (np.where, never a
+    multiply — 0 * NaN is NaN), and zero-padding up to the padded edge
+    count.  Used to carry FaultPlan.edge_nan through every lowering
+    branch so the poison lands on the same physical edges the solver
+    sees.
+    """
+    v = np.asarray(vec)
+    if perm is not None:
+        v = v[np.asarray(perm)]
+    if mask is not None:
+        v = np.where(np.asarray(mask) > 0, v, np.zeros_like(v))
+    if n_padded is not None and v.shape[0] < n_padded:
+        v = np.concatenate([v, np.zeros((n_padded - v.shape[0],), v.dtype)])
+    return np.ascontiguousarray(v)

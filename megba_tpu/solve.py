@@ -108,6 +108,7 @@ def flat_solve(
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
     initial_dx: Optional[np.ndarray] = None,
+    fault_plan=None,
     jit_cache: Optional[dict] = None,
     timer: Optional[PhaseTimer] = None,
     lower_only: bool = False,
@@ -141,6 +142,14 @@ def flat_solve(
     OFF otherwise (float64 verification and CPU runs keep the chunked
     scatter-add build, whose transient memory is bounded).
     MEGBA_TILED=1/0 force-enables/disables.
+
+    `fault_plan` (robustness.faults.FaultPlan, edge_nan in the CALLER's
+    edge order) seeds a deterministic fault into the solve; its edge
+    vector rides the same permutation/padding as `obs` so the poison
+    lands on the same physical edges in every lowering, and the plan's
+    window/offset are dynamic operands (a chunked driver slides the
+    fault without recompiling).  Omitted entirely, the program carries
+    no injection ops at all.
 
     `timer` (utils.timing.PhaseTimer, fresh one by default) accumulates
     the host-side phase wall clocks (lowering / sort / plan / program /
@@ -177,6 +186,13 @@ def flat_solve(
         cam_idx = np.asarray(cam_idx)
         pt_idx = np.asarray(pt_idx)
     n_edges_raw = int(cam_idx.shape[0])
+    fault_edge = None
+    if fault_plan is not None:
+        fault_edge = np.asarray(fault_plan.edge_nan)
+        if fault_edge.shape[0] != n_edges_raw:
+            raise ValueError(
+                f"fault_plan.edge_nan has {fault_edge.shape[0]} entries "
+                f"for a problem with {n_edges_raw} edges")
 
     ws = option.world_size
     if use_tiled is None:
@@ -209,6 +225,12 @@ def flat_solve(
             if sqrt_info is not None:
                 sqrt_info = np.concatenate(
                     [np.asarray(sqrt_info)[perms[k]] for k in range(ws)])
+            if fault_edge is not None:
+                from megba_tpu.robustness.faults import lower_edge_vector
+
+                fault_edge = np.concatenate([
+                    lower_edge_vector(fault_edge, perms[k], masks[k])
+                    for k in range(ws)])
             cam_idx, pt_idx = cam_idx_sh, pt_idx_sh
             mask = masks.reshape(-1).astype(dtype)
             n_padded = obs.shape[0]
@@ -229,6 +251,10 @@ def flat_solve(
             mask = pmask.astype(dtype)
             if sqrt_info is not None:
                 sqrt_info = np.asarray(sqrt_info)[perm]
+            if fault_edge is not None:
+                from megba_tpu.robustness.faults import lower_edge_vector
+
+                fault_edge = lower_edge_vector(fault_edge, perm, pmask)
             n_padded = obs.shape[0]
     else:
         with timer.phase("sort"):
@@ -239,12 +265,19 @@ def flat_solve(
                 cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
                 if sqrt_info is not None:
                     sqrt_info = np.asarray(sqrt_info)[perm]
+                if fault_edge is not None:
+                    fault_edge = fault_edge[perm]
 
             # Pad the edge axis: every shard must be a multiple of
             # EDGE_QUANTUM so chunk slices and shards are static-shape.
             obs, cam_idx, pt_idx, mask = pad_edges(
                 obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
             n_padded = obs.shape[0]
+            if fault_edge is not None:
+                from megba_tpu.robustness.faults import lower_edge_vector
+
+                fault_edge = lower_edge_vector(fault_edge,
+                                               n_padded=n_padded)
     if sqrt_info is not None:
         si = np.asarray(sqrt_info).astype(dtype, copy=False)
         if si.shape[0] != n_padded:
@@ -265,6 +298,14 @@ def flat_solve(
     if initial_dx is not None and option.solver_option.warm_start:
         initial_dx_j = np.ascontiguousarray(
             np.asarray(initial_dx).astype(dtype, copy=False).T)
+    fault_j = None
+    if fault_plan is not None:
+        fault_j = dataclasses.replace(
+            fault_plan,
+            edge_nan=np.ascontiguousarray(fault_edge),
+            point_crush=np.asarray(fault_plan.point_crush),
+            window=np.asarray(fault_plan.window, np.int32),
+            offset=np.asarray(fault_plan.offset, np.int32))
 
     # Feature-major boundary transposes (host numpy, once per solve).
     # Stay on HOST here: the jitted program uploads each operand exactly
@@ -295,7 +336,7 @@ def flat_solve(
                 pt_fixed=pt_fixed_j,
                 verbose=verbose, cam_sorted=True, plans=plans,
                 initial_region=initial_region, initial_v=initial_v,
-                initial_dx=initial_dx_j,
+                initial_dx=initial_dx_j, fault_plan=fault_j,
                 jit_cache=jit_cache, donate=True, lower_only=lower_only)
         if lower_only:
             return result
@@ -305,7 +346,8 @@ def flat_solve(
         return result
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
-                ("pt_fixed", pt_fixed_j), ("initial_dx", initial_dx_j)]
+                ("pt_fixed", pt_fixed_j), ("initial_dx", initial_dx_j),
+                ("fault_plan", fault_j)]
     keys = tuple(k for k, v in optional if v is not None)
     extras = [v for _, v in optional if v is not None]
     with timer.phase("program"):
@@ -346,6 +388,19 @@ def _maybe_emit_report(telemetry, option, result, timer, problem) -> None:
         ph.sync(result)
     if jax.process_index() != 0:
         return  # one report line per solve, not one per host
+    trace = getattr(result, "trace", None)
+    if trace is not None:
+        # Surface the robustness counters as PhaseTimer events (the
+        # report is already paying the device sync): how many contained
+        # recoveries the guards performed and how many preconditioner
+        # blocks fell back to Hpp after a Cholesky NaN.
+        iters = int(result.iterations)
+        fallbacks = int(np.sum(np.asarray(trace.precond_fallback)[:iters]))
+        if fallbacks:
+            timer.count_event("precond_fallback", fallbacks)
+        recov = getattr(result, "recoveries", None)
+        if recov is not None and int(recov):
+            timer.count_event("fault_recovery", int(recov))
     from megba_tpu.observability.report import append_report, build_report
 
     append_report(

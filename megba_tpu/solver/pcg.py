@@ -72,6 +72,17 @@ class PCGResult:
     # records it per iteration (observability/trace.py).
     r0_ratio: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.float32(1.0))
+    # Robustness diagnostics (RobustOption.guards): in-loop cold
+    # restarts the breakdown guard performed, whether the solve exited
+    # flagged (restart budget exhausted), and how many Schur-diagonal
+    # preconditioner blocks fell back to the Hpp preconditioner after a
+    # Cholesky NaN (0 for the HPP preconditioner).
+    breakdowns: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.int32(0))
+    broken: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.bool_(False))
+    precond_fallback: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.int32(0))
 
 
 def cam_block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
@@ -263,7 +274,7 @@ def make_coupling_matvecs(
 # a navigable label in profiler traces — see observability/__init__.py.
 @jax.named_scope("megba.pcg_core")
 def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
-              x0=None):
+              x0=None, guard=False, max_restarts=0):
     """Preconditioned CG over an arbitrary pytree "vector".
 
     One implementation of the reference's stopping + refuse semantics
@@ -271,7 +282,22 @@ def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
     min(rho) -> restore best iterate, :288-296) shared by the Schur
     solver (vector = one array) and the plain full-system solver
     (vector = a (camera, point) pair).  Returns
-    (x, iterations, rho, r0_ratio).
+    (x, iterations, rho, r0_ratio, restarts, broken).
+
+    `guard=True` (RobustOption.guards) arms breakdown detection on the
+    Chronopoulos-Gear scalars: a non-finite or sign-flipped gamma
+    (rho_new) / delta means the recurrence has left the SPD regime, and
+    the guard performs an in-loop COLD RESTART from the current iterate
+    — the next two body iterations repurpose the body's single matvec
+    slot to (1) recompute the true residual r = b - A x and (2) re-prime
+    the recurrence (p = M^-1 r, s = A p, alpha = rho/delta), then CG
+    resumes.  At most `max_restarts` restarts; one more breakdown exits
+    with `broken=True` and the best iterate.  The matvec stays the only
+    collective site and restart iterations use the SAME slot, so the
+    per-body-iteration collective census (2 all-reduces for the Schur
+    S.p) is unchanged — the `ba_guarded_w2_f32` canonical program pins
+    exactly this.  When no breakdown fires every selected value is
+    bitwise identical to the unguarded body.
 
     The body is the Chronopoulos-Gear single-recurrence CG: carrying the
     auxiliary direction s = A p alongside p lets each iteration run as
@@ -352,38 +378,116 @@ def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
     delta0 = tdot(u0, w0)
     alpha0 = rho0 / jnp.where(delta0 == 0, jnp.ones_like(delta0), delta0)
 
+    if not guard:
+        state0 = (jnp.int32(0), x_init, r0, u0, w0, alpha0, rho0,
+                  jnp.abs(rho0), x_init, jnp.bool_(False))
+
+        def cond(state):
+            k, _, _, _, _, _, rho, _, _, refused = state
+            return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
+
+        def body(state):
+            k, x, r, p, s, alpha, rho, rho_min, x_best, _ = state
+            # One fused vector pass: both solution/residual updates...
+            x = axpy(alpha, p, x)
+            r = axpy(-alpha, s, r)
+            # ...then the only preconditioner apply and the only matvec
+            # (the sole collective site: 2 psums inside the Schur S·p)...
+            u = precond(r)
+            w = matvec(u)
+            # ...and both compensated dots on the same fresh u/w.
+            rho_new = tdot(r, u)
+            delta = tdot(u, w)
+            beta = rho_new / rho
+            alpha = rho_new / (delta - beta * rho_new / alpha)
+            p = axpy(beta, p, u)  # u + beta p
+            s = axpy(beta, s, w)  # w + beta s == A p, by linearity
+            refused = jnp.abs(rho_new) > refuse_ratio * rho_min
+            improved = jnp.abs(rho_new) < rho_min
+            rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
+            x_best = select(improved, x, x_best)
+            return (k + 1, x, r, p, s, alpha, rho_new, rho_min, x_best,
+                    refused)
+
+        (k, x, _, _, _, _, rho, _, x_best, refused) = jax.lax.while_loop(
+            cond, body, state0)
+        return (select(~refused, x, x_best), k, rho, r0_ratio,
+                jnp.int32(0), jnp.bool_(False))
+
+    # ---- guarded body (RobustOption.guards) -----------------------------
+    # A 3-mode branchless body: phase 0 = normal CG step, phase 1 = the
+    # restart's residual refresh (the matvec slot computes A x and
+    # r := b - A x), phase 2 = recurrence re-prime (p = M^-1 r, s = A p,
+    # alpha = rho / delta).  Every mode runs the SAME one precond + one
+    # matvec, so the body's collective census is identical to the
+    # unguarded body; a phase-0 run with no breakdown selects exactly
+    # the unguarded values, bitwise.
+    threshold_arr = jnp.asarray(threshold, rho0.dtype)
+    # Keep-alive rho carried through restart iterations: strictly above
+    # the exit threshold so cond cannot fire on a placeholder, finite by
+    # construction (|rhs_energy| is, or the solve was empty).
+    keepalive = jnp.maximum(jnp.abs(rhs_energy), threshold_arr) * 2.0 + 1.0
+    minus_one = jnp.asarray(-1.0, rho0.dtype)
+
     state0 = (jnp.int32(0), x_init, r0, u0, w0, alpha0, rho0,
-              jnp.abs(rho0), x_init, jnp.bool_(False))
+              jnp.abs(rho0), x_init, jnp.bool_(False),
+              jnp.int32(0), jnp.int32(0), jnp.bool_(False))
 
     def cond(state):
-        k, _, _, _, _, _, rho, _, _, refused = state
-        return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
+        k, _, _, _, _, _, rho, _, _, refused, _, _, broken = state
+        return ((k < max_iter) & (jnp.abs(rho) >= threshold)
+                & (~refused) & (~broken))
 
     def body(state):
-        k, x, r, p, s, alpha, rho, rho_min, x_best, _ = state
-        # One fused vector pass: both solution/residual updates...
-        x = axpy(alpha, p, x)
-        r = axpy(-alpha, s, r)
-        # ...then the only preconditioner apply and the only matvec (the
-        # sole collective site: 2 psums inside the Schur S·p)...
+        (k, x, r, p, s, alpha, rho, rho_min, x_best, refused,
+         phase, restarts, broken) = state
+        advancing = phase == 0
+        refresh = phase == 1
+        reprime = phase == 2
+        # Phase 0 applies the pending CG update; restart phases hold x/r.
+        step = jnp.where(advancing, alpha, jnp.zeros_like(alpha))
+        x = axpy(step, p, x)
+        r = axpy(-step, s, r)
         u = precond(r)
-        w = matvec(u)
-        # ...and both compensated dots on the same fresh u/w.
-        rho_new = tdot(r, u)
+        # The one matvec: A u normally, A x during the residual refresh.
+        w = matvec(select(refresh, x, u))
+        r = select(refresh, axpy(minus_one, w, b), r)  # b - A x
+        rho_new = tdot(r, u)  # garbage during refresh (u is stale): masked
         delta = tdot(u, w)
         beta = rho_new / rho
-        alpha = rho_new / (delta - beta * rho_new / alpha)
-        p = axpy(beta, p, u)  # u + beta p
-        s = axpy(beta, s, w)  # w + beta s == A p, by linearity
-        refused = jnp.abs(rho_new) > refuse_ratio * rho_min
-        improved = jnp.abs(rho_new) < rho_min
+        alpha_cg = rho_new / (delta - beta * rho_new / alpha)
+        alpha_fresh = rho_new / jnp.where(
+            delta == 0, jnp.ones_like(delta), delta)
+        # Breakdown: the SPD invariants gamma = <r, M^-1 r> >= 0 and
+        # delta = <p, A p> >= 0 broke, or the recurrence scalars left
+        # the finite range.  Refresh iterations produce no real scalars.
+        breakdown = (~refresh) & (
+            ~(jnp.isfinite(rho_new) & jnp.isfinite(delta))
+            | (rho_new < 0) | (delta < 0))
+        enter = breakdown & (restarts < max_restarts)
+        broken = broken | (breakdown & (restarts >= max_restarts))
+        phase_next = jnp.where(enter, jnp.int32(1),
+                               jnp.where(refresh, jnp.int32(2),
+                                         jnp.int32(0)))
+        restarts = restarts + enter.astype(jnp.int32)
+        ok_adv = advancing & ~breakdown
+        ok_rep = reprime & ~breakdown
+        alpha = jnp.where(ok_rep, alpha_fresh,
+                          jnp.where(ok_adv, alpha_cg, alpha))
+        rho_next = jnp.where(enter | refresh, keepalive, rho_new)
+        p = select(ok_rep, u, select(ok_adv, axpy(beta, p, u), p))
+        s = select(ok_rep, w, select(ok_adv, axpy(beta, s, w), s))
+        refused = ok_adv & (jnp.abs(rho_new) > refuse_ratio * rho_min)
+        improved = ok_adv & (jnp.abs(rho_new) < rho_min)
         rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
         x_best = select(improved, x, x_best)
-        return (k + 1, x, r, p, s, alpha, rho_new, rho_min, x_best, refused)
+        return (k + 1, x, r, p, s, alpha, rho_next, rho_min, x_best,
+                refused, phase_next, restarts, broken)
 
-    (k, x, _, _, _, _, rho, _, x_best, refused) = jax.lax.while_loop(
-        cond, body, state0)
-    return select(~refused, x, x_best), k, rho, r0_ratio
+    (k, x, _, _, _, _, rho, _, x_best, refused, _, restarts,
+     broken) = jax.lax.while_loop(cond, body, state0)
+    return (select(~refused & ~broken, x, x_best), k, rho, r0_ratio,
+            restarts, broken)
 
 
 def plain_pcg_solve(
@@ -404,6 +508,8 @@ def plain_pcg_solve(
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
     plans: Optional[DualPlans] = None,
     x0: Optional[Tuple[jax.Array, jax.Array]] = None,
+    guard: bool = False,
+    max_restarts: int = 0,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -455,11 +561,12 @@ def plain_pcg_solve(
         rc, rp = r
         return cam_block_matvec(Minv_c, rc), block_matvec_fm(Minv_p, rp)
 
-    (xc, xp), k, rho, r0_ratio = _pcg_core(
+    (xc, xp), k, rho, r0_ratio, restarts, broken = _pcg_core(
         h_matvec, precond, (system.g_cam, system.g_pt),
-        max_iter, tol, refuse_ratio, tol_relative, x0=x0)
+        max_iter, tol, refuse_ratio, tol_relative, x0=x0,
+        guard=guard, max_restarts=max_restarts)
     return PCGResult(dx_cam=xc, dx_pt=xp, iterations=k, rho=rho,
-                     r0_ratio=r0_ratio)
+                     r0_ratio=r0_ratio, breakdowns=restarts, broken=broken)
 
 
 @jax.named_scope("megba.schur_diag_precond")
@@ -515,11 +622,13 @@ def _schur_diag_precond(
     # but rounding (especially equilibrated bf16 operands) can push a
     # weakly-determined camera block indefinite -> Cholesky NaN.  Fall
     # back to the Hpp preconditioner for exactly those blocks instead of
-    # letting NaN masquerade as convergence.
+    # letting NaN masquerade as convergence.  The fallback is COUNTED,
+    # not silent: the block count rides PCGResult.precond_fallback into
+    # the SolveTrace so an indefinite drift shows up in telemetry.
     minv_hpp = block_inv(Hpp_d)
     minv_sd = block_inv(Hpp_d - corr)
     bad = ~jnp.all(jnp.isfinite(minv_sd), axis=(-2, -1), keepdims=True)
-    return jnp.where(bad, minv_hpp, minv_sd)
+    return jnp.where(bad, minv_hpp, minv_sd), jnp.sum(bad).astype(jnp.int32)
 
 
 def schur_pcg_solve(
@@ -540,6 +649,8 @@ def schur_pcg_solve(
     preconditioner: PreconditionerKind = PreconditionerKind.HPP,
     plans: Optional[DualPlans] = None,
     x0: Optional[jax.Array] = None,
+    guard: bool = False,
+    max_restarts: int = 0,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -615,11 +726,12 @@ def schur_pcg_solve(
             ]).astype(bf)
 
     Hll_inv = block_inv_fm(Hll_d)
+    precond_fallback = jnp.int32(0)
     if preconditioner == PreconditionerKind.SCHUR_DIAG:
         # The correction rows are always accumulated in full precision
         # (any bf16 operands are upcast in the body), so no precision
         # flag is threaded through.
-        Minv = _schur_diag_precond(
+        Minv, precond_fallback = _schur_diag_precond(
             Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
             compute_kind, axis_name, cam_sorted, plans=plans)
     else:
@@ -644,9 +756,10 @@ def schur_pcg_solve(
         # bring the (original-variable) warm start over.
         x0 = x0 / d_cam
 
-    x, k, rho, r0_ratio = _pcg_core(
+    x, k, rho, r0_ratio, restarts, broken = _pcg_core(
         s_matvec, lambda r: cam_block_matvec(Minv, r), v,
-        max_iter, tol, refuse_ratio, tol_relative, x0=x0)
+        max_iter, tol, refuse_ratio, tol_relative, x0=x0,
+        guard=guard, max_restarts=max_restarts)
 
     # Back-substitute the point update       [1 psum]
     dx_pt = block_matvec_fm(Hll_inv, g_pt - hlp(x))
@@ -654,4 +767,5 @@ def schur_pcg_solve(
         x = x * d_cam  # unscale back to the original variables
         dx_pt = dx_pt * d_pt
     return PCGResult(dx_cam=x, dx_pt=dx_pt, iterations=k, rho=rho,
-                     r0_ratio=r0_ratio)
+                     r0_ratio=r0_ratio, breakdowns=restarts, broken=broken,
+                     precond_fallback=precond_fallback)

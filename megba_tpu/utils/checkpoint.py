@@ -9,7 +9,18 @@ state) each accepted iteration and resume after preemption — the
 TPU-pod operational norm.
 
 Plain .npz is used (self-contained, no orbax directory layout needed for
-a handful of dense arrays); atomic via write-to-temp + rename.
+a handful of dense arrays).  Preemption safety is end to end:
+
+- **Atomic writes**: payload goes to a same-directory temp file, is
+  fsync'd (data durable BEFORE the rename commits it), then
+  `os.replace`d over the target — a SIGKILL at any byte leaves either
+  the complete old snapshot or the complete new one, never a torn file.
+- **Content checksum + schema version**: every snapshot carries a
+  blake2b digest over its arrays and a format version; `load_state`
+  recomputes and compares, so a corrupted or truncated snapshot raises
+  a clear ValueError instead of feeding garbage state into a resume.
+  (Snapshots written before the checksum existed load with a best-
+  effort pass-through — they predate the guarantee, not violate it.)
 
 To resume with full fidelity, thread the saved trust region back in:
 `AlgoOption(initial_region=float(state["region"]))` — otherwise the
@@ -19,17 +30,42 @@ few extra LM iterations, not correctness).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
+import zipfile
 from typing import Dict, Optional
 
 import numpy as np
+
+# Bumped when the on-disk layout changes incompatibly; load_state
+# refuses snapshots from a NEWER schema (an older binary must not
+# half-understand a future format).
+SCHEMA_VERSION = 2
+
+_CHECKSUM_KEY = "__checksum__"
+_SCHEMA_KEY = "__schema__"
+
+
+def _digest(payload: Dict[str, np.ndarray]) -> np.ndarray:
+    """blake2b over every array's (name, dtype, shape, bytes), key-sorted
+    — deterministic regardless of insertion order; returns uint8[16]."""
+    h = hashlib.blake2b(digest_size=16)
+    for key in sorted(payload):
+        if key == _CHECKSUM_KEY:
+            continue
+        a = np.ascontiguousarray(np.asarray(payload[key]))
+        h.update(key.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return np.frombuffer(h.digest(), np.uint8).copy()
 
 
 def save_state(path: str, cameras, points, *, region: float = None,
                cost: float = None, iteration: int = None,
                extra: Optional[Dict[str, np.ndarray]] = None) -> None:
-    """Atomically snapshot solver state to `path` (.npz)."""
+    """Atomically snapshot solver state to `path` (.npz, checksummed)."""
     payload = {
         "cameras": np.asarray(cameras),
         "points": np.asarray(points),
@@ -42,6 +78,8 @@ def save_state(path: str, cameras, points, *, region: float = None,
         payload["iteration"] = np.asarray(iteration)
     for k, v in (extra or {}).items():
         payload[f"extra_{k}"] = np.asarray(v)
+    payload[_SCHEMA_KEY] = np.asarray(SCHEMA_VERSION)
+    payload[_CHECKSUM_KEY] = _digest(payload)
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
@@ -54,9 +92,48 @@ def save_state(path: str, cameras, points, *, region: float = None,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    finally:
+        # A crash simulation that intercepts os.replace must not leak
+        # temp files next to the (still intact) previous snapshot.
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_state(path: str) -> Dict[str, np.ndarray]:
-    """Load a snapshot; returns dict with cameras/points (+ any extras)."""
-    with np.load(path) as z:
-        return {k: z[k] for k in z.files}
+    """Load + validate a snapshot; dict with cameras/points (+ extras).
+
+    Raises ValueError with a clear message when the file is truncated /
+    not an npz (a torn copy, a partial download) or when the stored
+    content checksum does not match the arrays (bit rot, a concurrent
+    writer that bypassed `save_state`).  Never returns garbage state.
+    """
+    try:
+        with np.load(path) as z:
+            state = {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        # A missing file is "no snapshot", not corruption — callers
+        # probing for an optional snapshot must see the real error.
+        raise
+    except (zipfile.BadZipFile, EOFError, OSError, ValueError) as exc:
+        raise ValueError(
+            f"checkpoint {path!r} is corrupt or truncated "
+            f"({type(exc).__name__}: {exc}); delete it and restart, or "
+            "point checkpoint_path at an intact snapshot") from exc
+    schema = state.pop(_SCHEMA_KEY, None)
+    if schema is not None and int(schema) > SCHEMA_VERSION:
+        raise ValueError(
+            f"checkpoint {path!r} was written by a newer schema "
+            f"(v{int(schema)} > supported v{SCHEMA_VERSION}); upgrade "
+            "before resuming")
+    checksum = state.pop(_CHECKSUM_KEY, None)
+    if checksum is not None:
+        full = dict(state)
+        if schema is not None:
+            full[_SCHEMA_KEY] = schema
+        want = _digest(full)
+        if not np.array_equal(np.asarray(checksum), want):
+            raise ValueError(
+                f"checkpoint {path!r} failed its content checksum — the "
+                "snapshot is corrupt; refusing to resume from garbage "
+                "state (delete it and restart)")
+    return state

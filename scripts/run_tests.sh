@@ -72,3 +72,81 @@ assert fc["cost_rel_gap"] <= 1e-2, (
     "(> 1e-2 curve gap_tol)")
 PY
 echo "inexact-LM smoke OK"
+
+# Fault-injection smoke: venice-10% with a NaN burst seeded at GLOBAL
+# LM iteration 3 — i.e. at the checkpointed driver's chunk-resume
+# relinearisation, the preemption-recovery worst case.  With
+# RobustOption guards the solve must recover on-device
+# (status=recovered) and land within rtol 1e-5 of the clean final cost,
+# single-device AND world-2; the same injection with guards off must
+# yield a non-finite cost — proving the guard, not luck, did the work.
+JAX_PLATFORMS=cpu python - <<'PY'
+import dataclasses
+import os
+import tempfile
+
+import numpy as np
+
+# World-2 on a CPU host needs forced virtual devices, exactly as
+# tests/conftest.py arranges for the pytest lanes.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.algo.checkpointed import solve_checkpointed
+from megba_tpu.common import (
+    AlgoOption, ComputeKind, JacobianMode, ProblemOption, RobustOption,
+    SolverOption, SolveStatus, status_name)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.robustness.faults import make_nan_burst
+
+s = make_synthetic_bal(num_cameras=177, num_points=99392,
+                       obs_per_point=5_001_946 / 993_923, seed=0,
+                       param_noise=1e-2, pixel_noise=0.5, dtype=np.float32)
+option = ProblemOption(
+    dtype=np.float32, compute_kind=ComputeKind.IMPLICIT,
+    jacobian_mode=JacobianMode.ANALYTICAL,
+    algo_option=AlgoOption(max_iter=14, epsilon1=1e-12, epsilon2=1e-15),
+    solver_option=SolverOption(max_iter=30, tol=1e-10, refuse_ratio=1e30))
+guarded = dataclasses.replace(option, robust_option=RobustOption(guards=True))
+f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+args = (f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx)
+plan = make_nan_burst(s.obs.shape[0], [11, 4242], start=3, stop=4)
+d = tempfile.mkdtemp(prefix="megba_fault_smoke_")
+
+
+def two_phase(opt, name, fault=None):
+    # Phase 1 runs iterations 0-2 and snapshots; phase 2 resumes —
+    # its chunk-initial relinearisation IS global iteration 3, where
+    # the burst is seeded.
+    ck = os.path.join(d, name + ".npz")
+    short = dataclasses.replace(opt, algo_option=dataclasses.replace(
+        opt.algo_option, max_iter=3))
+    solve_checkpointed(*args, short, checkpoint_path=ck,
+                       checkpoint_every=3, use_tiled=False)
+    kw = {} if fault is None else {"fault_plan": fault}
+    return solve_checkpointed(*args, opt, checkpoint_path=ck,
+                              checkpoint_every=20, use_tiled=False, **kw)
+
+
+for world in (1, 2):
+    opt_w = dataclasses.replace(option, world_size=world)
+    guard_w = dataclasses.replace(guarded, world_size=world)
+    clean = two_phase(opt_w, f"clean_w{world}")
+    off = two_phase(opt_w, f"off_w{world}", plan)
+    assert not np.isfinite(float(off.cost)), (
+        f"world {world}: guards-off injection should have poisoned the "
+        f"cost, got {float(off.cost)}")
+    on = two_phase(guard_w, f"on_w{world}", plan)
+    gap = abs(float(on.cost) - float(clean.cost)) / abs(float(clean.cost))
+    print(f"fault smoke w{world}: clean={float(clean.cost):.8e} "
+          f"guarded={float(on.cost):.8e} gap={gap:.2e} "
+          f"status={status_name(on.status)} recoveries={int(on.recoveries)}",
+          flush=True)
+    assert int(on.status) == SolveStatus.RECOVERED, status_name(on.status)
+    assert gap <= 1e-5, f"world {world}: recovered cost off by {gap:.2e}"
+PY
+echo "fault-injection smoke OK"

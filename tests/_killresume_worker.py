@@ -1,0 +1,72 @@
+"""Kill-resume worker: one checkpointed BA solve, then dump the result.
+
+Run as `python tests/_killresume_worker.py <checkpoint.npz> <result.npz>`.
+The problem is fully seeded, so two complete runs (interrupted-and-
+resumed vs uninterrupted) must produce BITWISE identical parameters and
+traces — the contract tests/test_killresume.py pins with a real SIGKILL
+(robustness/harness.py).  Everything that could differ between runs is
+pinned here: backend, device count, x64, the persistent compile cache.
+"""
+
+import os
+import sys
+
+# Runnable from any cwd: the repo root is this file's parent's parent.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
+
+from megba_tpu.algo.checkpointed import solve_checkpointed  # noqa: E402
+from megba_tpu.common import (  # noqa: E402
+    AlgoOption,
+    JacobianMode,
+    ProblemOption,
+    SolverOption,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal  # noqa: E402
+from megba_tpu.observability.trace import TRACE_FIELDS  # noqa: E402
+from megba_tpu.ops.residuals import make_residual_jacobian_fn  # noqa: E402
+
+
+def main(checkpoint_path: str, result_path: str) -> None:
+    s = make_synthetic_bal(num_cameras=6, num_points=40, obs_per_point=4,
+                           seed=7, param_noise=4e-2, pixel_noise=0.3)
+    option = ProblemOption(
+        algo_option=AlgoOption(max_iter=8, epsilon1=1e-12, epsilon2=1e-15),
+        solver_option=SolverOption(max_iter=60, tol=1e-12,
+                                   refuse_ratio=1e30))
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    res = solve_checkpointed(
+        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+        checkpoint_path=checkpoint_path, checkpoint_every=2)
+    payload = {
+        "cameras": np.asarray(res.cameras),
+        "points": np.asarray(res.points),
+        "cost": np.asarray(res.cost),
+        "iterations": np.asarray(int(res.iterations)),
+        "accepted": np.asarray(int(res.accepted)),
+        "status": np.asarray(int(res.status)),
+    }
+    for field in TRACE_FIELDS:
+        payload[f"trace_{field}"] = np.asarray(getattr(res.trace, field))
+    tmp = result_path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, result_path)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

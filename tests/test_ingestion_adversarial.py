@@ -198,3 +198,92 @@ def test_empty_and_whitespace_bal_raise(tmp_path):
     p.write_text(" \n \t \r\n ")
     with pytest.raises(ValueError):
         load_bal(p)
+
+
+# ------------------------------------------- non-finite / duplicate input
+#
+# A single NaN in user bytes poisons every psum-reduced cost in the
+# jitted solver; the robustness layer can CONTAIN that at runtime
+# (RobustOption guards), but data that arrives broken must be refused at
+# the ingestion boundary with file/line/index context, never solved.
+
+
+def test_bal_nonfinite_observation_rejected_with_index(tmp_path):
+    bal = _tiny_bal_text()
+    bal.obs[1, 0] = np.nan
+    p = tmp_path / "nan_obs.txt"
+    save_bal(p, bal)
+    with pytest.raises(ValueError) as exc:
+        load_bal(p)
+    msg = str(exc.value)
+    assert "observation 1" in msg and "non-finite" in msg
+    assert "cam 0" in msg and "pt 1" in msg  # actionable: names the edge
+    assert "nan_obs.txt" in msg  # and the file
+
+
+def test_bal_nonfinite_camera_and_point_rejected(tmp_path):
+    bal = _tiny_bal_text()
+    bal.cameras[1, 6] = np.inf
+    p = tmp_path / "inf_cam.txt"
+    save_bal(p, bal)
+    with pytest.raises(ValueError, match="camera 1.*non-finite"):
+        load_bal(p)
+    bal = _tiny_bal_text()
+    bal.points[0, 2] = -np.inf
+    p2 = tmp_path / "inf_pt.txt"
+    save_bal(p2, bal)
+    with pytest.raises(ValueError, match="point 0.*non-finite"):
+        load_bal(p2)
+
+
+def test_bal_duplicate_edge_rejected_with_both_indices(tmp_path):
+    text = (
+        "2 2 3\n"
+        "0 0 1.0 2.0\n"
+        "1 1 3.0 -2.0\n"
+        "0 0 1.5 2.5\n"  # same (cam, pt) as observation 0
+        + "\n".join(f"{0.01 * i:.17g}" for i in range(2 * 9 + 2 * 3)) + "\n")
+    with pytest.raises(ValueError) as exc:
+        loads_bal(text)
+    msg = str(exc.value)
+    assert "duplicate" in msg and "cam 0" in msg and "pt 0" in msg
+    assert "[0, 2]" in msg  # BOTH offending observation indices named
+    p = tmp_path / "dup.txt"
+    p.write_text(text)
+    with pytest.raises(ValueError, match="duplicate"):
+        load_bal(p)  # the native-parser path enforces the same contract
+
+
+def test_g2o_nonfinite_vertex_rejected_with_line():
+    text = """\
+VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1
+VERTEX_SE3:QUAT 1 nan 0 0 0 0 0 1
+"""
+    with pytest.raises(ValueError) as exc:
+        read_g2o(io.StringIO(text))
+    msg = str(exc.value)
+    assert "line 2" in msg and "VERTEX 1" in msg and "non-finite" in msg
+
+
+def test_g2o_nonfinite_edge_rejected_with_line():
+    bad_info = _EDGE_INFO.replace("1 0 0 0 0 0 1", "inf 0 0 0 0 0 1", 1)
+    text = f"""\
+VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1
+VERTEX_SE3:QUAT 1 1 0 0 0 0 0 1
+EDGE_SE3:QUAT 0 1 1 0 0 0 0 0 1 {_EDGE_INFO}
+EDGE_SE3:QUAT 0 1 1 0 0 0 0 0 1 {bad_info}
+"""
+    with pytest.raises(ValueError) as exc:
+        read_g2o(io.StringIO(text))
+    msg = str(exc.value)
+    assert "line 4" in msg and "EDGE 0 -> 1" in msg and "non-finite" in msg
+
+
+def test_g2o_se2_nonfinite_measurement_rejected():
+    text = """\
+VERTEX_SE2 0 0 0 0
+VERTEX_SE2 1 1 0 0
+EDGE_SE2 0 1 nan 0 0 1 0 0 1 0 1
+"""
+    with pytest.raises(ValueError, match="line 3.*non-finite"):
+        read_g2o(io.StringIO(text))

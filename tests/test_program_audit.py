@@ -77,15 +77,28 @@ def test_collective_census_matches_analytic_expectation(audits):
     # collectives to the CG step: the traced eta_k is pure carry math
     # and the warm-start products live outside the while body.
     assert len(audits["ba_forcing_w2_f32"].pcg_body_collectives()) == 2
+    # Fault containment (RobustOption guards) must be collective-free
+    # too: breakdown detection reads already-psum-reduced scalars and
+    # the in-loop restart reuses the body's single matvec slot.
+    assert len(audits["ba_guarded_w2_f32"].pcg_body_collectives()) == 2
     assert len(audits["pgo_sharded_w2_f64"].pcg_body_collectives()) == 1
     for name in ("ba_single_f32", "ba_tiled_f32", "pgo_single_f64"):
         assert audits[name].collectives == [], name
     # psum is the only prescribed collective: everything the SPMD
     # programs emit is an all-reduce.
     for name in ("ba_sharded_w2_f32", "ba_forcing_w2_f32",
-                 "pgo_sharded_w2_f64"):
+                 "ba_guarded_w2_f32", "pgo_sharded_w2_f64"):
         kinds = {op.kind for op in audits[name].collectives}
         assert kinds == {"all_reduce"}, (name, kinds)
+
+
+def test_guarded_program_adds_no_collectives_vs_unguarded(audits):
+    # "Guards are free" at the census level: the guarded SPMD program's
+    # TOTAL all-reduce count equals the unguarded one's — detection
+    # piggybacks on reductions that already exist.
+    n_guarded = len(audits["ba_guarded_w2_f32"].collectives)
+    n_plain = len(audits["ba_sharded_w2_f32"].collectives)
+    assert n_guarded == n_plain, (n_guarded, n_plain)
 
 
 def test_donation_materialised_in_compiled_executables(audits):
