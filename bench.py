@@ -138,6 +138,83 @@ def _status_name(res):
     return status_name(res.status)
 
 
+def fleet_head_to_head(n_problems: int, dtype, timer) -> dict:
+    """Serial flat_solve loop vs batched solve_many over one fleet.
+
+    Both sides solve the SAME `io/synthetic.make_fleet` problems to the
+    same convergence settings, and both are fully warmed (compiles +
+    host plan caches) before timing, so the comparison is steady-state
+    dispatch throughput — the regime a long-lived service runs in.  The
+    serial side pays one `flat_solve` call per problem (per-call host
+    prep + dispatch); the batched side pays one padded dispatch per
+    shape bucket.
+
+    `max_cost_rel_gap` compares the batched lanes against the serial
+    loop at each problem's NATURAL shape.  Runs at the surrounding
+    bench dtype: under the default f32 lane (x64 off) camera/point
+    padding reorders the compensated sums, so un-converged trajectories
+    drift ~1e-2 relative — a sanity band, not a parity proof.  The
+    bitwise-padding / rtol-1e-6 parity contract is pinned where it is
+    provable, in tests/test_serving.py under x64.
+    """
+    import jax
+
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.io.synthetic import make_fleet
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving import FleetProblem, FleetStats, solve_many
+    from megba_tpu.solve import flat_solve
+
+    opt = ProblemOption(
+        dtype=dtype,
+        algo_option=AlgoOption(max_iter=8),
+        solver_option=SolverOption(max_iter=12, tol=1e-8))
+    fleet = make_fleet(n_problems, size_range=(16, 64), seed=0, dtype=dtype)
+    probs = [FleetProblem.from_synthetic(s, name=f"fleet{i}")
+             for i, s in enumerate(fleet)]
+    f = make_residual_jacobian_fn(mode=opt.jacobian_mode)
+
+    def serial_pass():
+        out = [flat_solve(f, p.cameras, p.points, p.obs, p.cam_idx,
+                          p.pt_idx, opt, use_tiled=False) for p in probs]
+        jax.block_until_ready([r.cost for r in out])
+        return out
+
+    with timer.phase("fleet_warm_serial"):
+        serial_pass()
+    t0 = time.perf_counter()
+    with timer.phase("fleet_serial"):
+        serial = serial_pass()
+    serial_s = time.perf_counter() - t0
+
+    with timer.phase("fleet_warm_batched"):
+        solve_many(probs, opt)
+    stats = FleetStats()
+    t0 = time.perf_counter()
+    with timer.phase("fleet_batched"):
+        batched = solve_many(probs, opt, stats=stats)
+    batched_s = time.perf_counter() - t0
+
+    cost_gap = max(
+        abs(float(b.cost) - float(s.cost)) / max(abs(float(s.cost)), 1e-30)
+        for b, s in zip(batched, serial))
+    d = stats.as_dict()
+    return {
+        "problems": n_problems,
+        "problems_per_sec_serial": round(n_problems / serial_s, 2),
+        "problems_per_sec_batched": round(n_problems / batched_s, 2),
+        "speedup": round(serial_s / batched_s, 3),
+        "serial_s": round(serial_s, 4),
+        "batched_s": round(batched_s, 4),
+        "buckets": len(d["per_bucket"]),
+        "padding_waste": round(d["padding_waste"], 4),
+        "statuses": sorted({b.status_name for b in batched}),
+        "serial_statuses": sorted(
+            {_status_name(r) for r in serial}),
+        "max_cost_rel_gap": cost_gap,
+    }
+
+
 def main() -> None:
     import sys
 
@@ -342,6 +419,17 @@ def main() -> None:
             "elapsed_s": round(f_elapsed, 3),
             "speedup_vs_fixed_tol": round(elapsed / f_elapsed, 3),
         }
+    # Fleet head-to-head (MEGBA_BENCH_FLEET=<n>): n heterogeneous small
+    # problems (io/synthetic.make_fleet) solved as a serial flat_solve
+    # loop vs one batched solve_many pass (serving/batcher.py), both
+    # warmed first so the metric is steady-state problems/sec at fixed
+    # convergence — the roadmap's fleet throughput observable — not
+    # compile amortisation.  scripts/run_tests.sh asserts batched > serial
+    # and a terminal per-lane SolveStatus.
+    fleet_cmp = None
+    n_fleet = int(os.environ.get("MEGBA_BENCH_FLEET", "0") or "0")
+    if n_fleet:
+        fleet_cmp = fleet_head_to_head(n_fleet, dtype, timer)
     # Charge the reference model the S·p products this run actually
     # executed (the PCG can exit below the 30-iteration cap), so both
     # sides of vs_baseline do the same algorithmic work.  The fused
@@ -443,6 +531,9 @@ def main() -> None:
                     # Inexact-LM head-to-head (MEGBA_BENCH_FORCING=1):
                     # forcing+warm_start vs the fixed tight-tol regime.
                     "forcing": forcing_cmp,
+                    # Fleet head-to-head (MEGBA_BENCH_FLEET=<n>):
+                    # batched solve_many vs serial flat_solve loop.
+                    "fleet": fleet_cmp,
                     # Per-phase wall clocks (compile vs solve, per pass)
                     # so BENCH_*.json artifacts carry phase timings.
                     "phases": {

@@ -53,6 +53,15 @@ def solve_pgo(*args, **kwargs):
     return _solve_pgo(*args, **kwargs)
 
 
+def solve_many(*args, **kwargs):
+    """Solve many independent BA problems through the serving layer's
+    shape-bucketed batched programs — see serving/batcher.py (lazy
+    import: the serving layer is optional for single-problem users)."""
+    from megba_tpu.serving import solve_many as _solve_many
+
+    return _solve_many(*args, **kwargs)
+
+
 def solve_g2o(*args, **kwargs):
     """Read + solve a .g2o pose-graph file — see io/g2o.py."""
     from megba_tpu.io.g2o import solve_g2o as _solve_g2o

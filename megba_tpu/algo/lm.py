@@ -180,6 +180,20 @@ def lm_solve(
     order so both Hessian sides and both coupling products reduce over
     sorted block-aligned segments.
 
+    BATCH-AXIS CONTRACT (serving layer): this loop is `jax.vmap`-safe
+    over a leading problem axis on every array operand — the carry is a
+    pure pytree of per-problem values (no host scalars, no cross-lane
+    reductions when `axis_name is None`), so JAX's while_loop batching
+    rule gives per-lane convergence masking for free: the lifted
+    predicate keeps the loop running while ANY lane is live, and a lane
+    whose `cond` has cleared freezes BITWISE (per-lane select on the
+    carry) while its batch-mates keep iterating.  Each lane's
+    trajectory is a function of its own slice only; `derive_status`,
+    the trace and the final scalars all come back per lane.
+    `serving/compile_pool._build_batched_solve` is the production
+    consumer; verbose emission is the one vmap-hostile feature (host
+    callback), so batched programs run `verbose=False`.
+
     `fault_plan` (robustness.faults.FaultPlan, edge_nan already in this
     call's edge order) injects deterministic faults at the residual /
     linear-system boundary — the CI harness for the RobustOption guards.
@@ -555,6 +569,11 @@ def lm_solve(
                                    pcg.iterations, axis_name)
         return s_next
 
+    # Under vmap (serving's batched mega-solve) this while_loop batches
+    # per-lane: cond lifts to any(pred) and the body's new carry is
+    # selected lane-wise against the old one, so a stopped lane costs
+    # its share of the batched body's FLOPs but its VALUES are frozen
+    # bitwise until the last lane finishes.
     out = jax.lax.while_loop(cond, body, state0)
     dx_final = None
     if warm_start:

@@ -263,6 +263,29 @@ def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
                       option, use_tiled=use_tiled, lower_only=True)
 
 
+def _lower_batched(lanes: int):
+    """The serving layer's batched mega-solve (vmapped LM, lane axis 4).
+
+    Lowered through the compile pool's own AOT entry point
+    (serving/compile_pool.lower_bucket) — the same builder, operand
+    layout and donation flags every fleet dispatch uses — at the shape
+    class the canonical tiny BA problem buckets to under the default
+    ladder."""
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving.compile_pool import lower_bucket
+    from megba_tpu.serving.shape_class import BucketLadder, classify
+
+    s = _ba_problem()
+    option = _ba_option()
+    shape = classify(s.cameras0.shape[0], s.points0.shape[0],
+                     s.obs.shape[0], option.dtype, BucketLadder())
+    engine = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    return lower_bucket(engine, option, shape, lanes,
+                        cd=s.cameras0.shape[1], pd=s.points0.shape[1],
+                        od=s.obs.shape[1])
+
+
 def _lower_pgo(world: int):
     from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
     from megba_tpu.models.pgo import make_synthetic_pose_graph, solve_pgo
@@ -335,6 +358,17 @@ def program_specs() -> Dict[str, ProgramSpec]:
             donate_leaves=_sharded_donation(),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     guarded=True)),
+        "ba_batched_b4_f32": ProgramSpec(
+            name="ba_batched_b4_f32", float_family="f32", world=1,
+            # The batched program is a vmap over a LANE axis on one
+            # device: per-lane convergence masking is pure selects, so
+            # a collective (or a host transfer) appearing here means
+            # the serving layer broke the fleet contract.
+            pcg_psums=0,
+            # The batcher donates the stacked parameter lanes
+            # (compile_pool._build_batched_solve donate_argnums=(0, 1)).
+            donate_leaves=(0, 1),
+            build=lambda: _lower_batched(lanes=4)),
         "pgo_single_f64": ProgramSpec(
             name="pgo_single_f64", float_family="f64", world=1, pcg_psums=0,
             donate_leaves=(0,),

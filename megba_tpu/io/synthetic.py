@@ -9,7 +9,7 @@ are generated procedurally with known ground truth.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -136,3 +136,58 @@ def make_synthetic_bal(
         cam_idx=cam_idx,
         pt_idx=pt_idx,
     )
+
+
+def make_fleet(
+    n_problems: int,
+    size_range: Tuple[int, int] = (12, 96),
+    rng: Optional[np.random.Generator] = None,
+    *,
+    seed: int = 0,
+    obs_per_point_range: Tuple[float, float] = (2.0, 3.5),
+    pixel_noise: float = 0.4,
+    param_noise: float = 2e-2,
+    dtype: np.dtype = np.float64,
+) -> List[SyntheticBAL]:
+    """Generate a heterogeneous fleet of small BA problems, reproducibly.
+
+    The one generator the serving tests AND the fleet bench draw from,
+    so "16 synthetic problems" means the same 16 scenes everywhere.
+    `size_range` bounds the per-problem POINT count (inclusive); the
+    camera count scales with it (~1 camera per 8 points, >= 3) and
+    `obs_per_point_range` bounds the edge density, so problem i's
+    (n_cam, n_pt, n_edge) triple is drawn from `rng` — pass a
+    `np.random.default_rng(seed)` or let `seed` build one.
+
+    Determinism contract: problem i's SCENE seed is derived from `seed`
+    and i alone (not from the rng draw order), so
+    `make_fleet(8, ...)[:4]` and `make_fleet(4, ...)` produce the same
+    first four scenes for the same seed — fleets compose and shrink
+    without reshuffling their members.
+    """
+    if n_problems < 1:
+        raise ValueError(f"n_problems must be >= 1, got {n_problems}")
+    lo, hi = int(size_range[0]), int(size_range[1])
+    if not 1 <= lo <= hi:
+        raise ValueError(f"bad size_range {size_range}")
+    olo, ohi = float(obs_per_point_range[0]), float(obs_per_point_range[1])
+    if not 1.0 <= olo <= ohi:
+        raise ValueError(f"bad obs_per_point_range {obs_per_point_range}")
+
+    fleet: List[SyntheticBAL] = []
+    for i in range(n_problems):
+        # Per-problem rng: sizes AND scene content both derive from
+        # (seed, i) only — stable under fleet growth.
+        r_i = np.random.default_rng(np.random.SeedSequence([seed, i]))
+        n_pt = int(r_i.integers(lo, hi + 1))
+        n_cam = max(3, n_pt // 8)
+        opp = float(r_i.uniform(olo, ohi))
+        fleet.append(make_synthetic_bal(
+            num_cameras=n_cam, num_points=n_pt, obs_per_point=opp,
+            pixel_noise=pixel_noise, param_noise=param_noise,
+            seed=int(r_i.integers(0, 2**31 - 1)), dtype=dtype))
+    if rng is not None:
+        # Caller-supplied rng only shuffles the ORDER (heterogeneous
+        # arrival patterns for queue tests); scene content stays pinned.
+        rng.shuffle(fleet)
+    return fleet

@@ -102,9 +102,11 @@ def weight_system_inputs(
     Jc = Jc * m
     Jp = Jp * m
     if cam_fixed is not None:
-        Jc = jnp.where(cam_fixed[cam_idx][None, :], 0.0, Jc)
+        # zeros_like: the weak literal 0.0 would ride in as a f64
+        # constant tensor in f32 programs (dtype-census leak).
+        Jc = jnp.where(cam_fixed[cam_idx][None, :], jnp.zeros_like(Jc), Jc)
     if pt_fixed is not None:
-        Jp = jnp.where(pt_fixed[pt_idx][None, :], 0.0, Jp)
+        Jp = jnp.where(pt_fixed[pt_idx][None, :], jnp.zeros_like(Jp), Jp)
     return r, Jc, Jp
 
 
@@ -229,10 +231,13 @@ def build_schur_system(
         [1.0 if i % (pd + 1) == 0 else 0.0 for i in range(pd * pd)], dtype)
     if cam_fixed is not None:
         Hpp = jnp.where(cam_fixed[:, None, None], eye_c, Hpp)
-        g_cam = jnp.where(cam_fixed[None, :], 0.0, g_cam)
+        # zeros_like, not the literal 0.0: a weak f64 scalar constant
+        # would materialise as tensor<f64> in f32 programs (the dtype
+        # census flags it — same class of leak as the ops/geo.py ones).
+        g_cam = jnp.where(cam_fixed[None, :], jnp.zeros_like(g_cam), g_cam)
     if pt_fixed is not None:
         Hll = jnp.where(pt_fixed[None, :], eye_p_rows[:, None], Hll)
-        g_pt = jnp.where(pt_fixed[None, :], 0.0, g_pt)
+        g_pt = jnp.where(pt_fixed[None, :], jnp.zeros_like(g_pt), g_pt)
 
     # Edge-less vertices (possible in filtered real datasets) would leave
     # a zero block that stays singular through multiplicative damping and

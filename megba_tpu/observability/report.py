@@ -93,6 +93,11 @@ class SolveReport:
     # "programs": {name: ProgramAudit.summary(), ...}} (or {"backend",
     # "error"} when the audit itself failed).
     program_audit: Optional[Dict[str, Any]] = None
+    # Optional serving-layer context (serving/batcher.py): bucket/lane
+    # placement, batch latency and a FleetStats snapshot for reports
+    # emitted by `solve_many` / `FleetQueue` — the fields the
+    # `summarize --aggregate` fleet view keys on.
+    fleet: Optional[Dict[str, Any]] = None
     schema: str = SCHEMA
     created_unix: float = 0.0
 
@@ -108,7 +113,8 @@ class SolveReport:
 
 def build_report(option, result, phases: Dict[str, Any],
                  problem: Dict[str, Any],
-                 audit: Optional[Dict[str, Any]] = None) -> SolveReport:
+                 audit: Optional[Dict[str, Any]] = None,
+                 fleet: Optional[Dict[str, Any]] = None) -> SolveReport:
     """Assemble a SolveReport from a finished solve.
 
     `result` is an LMResult (trace included when the solve populated
@@ -151,6 +157,7 @@ def build_report(option, result, phases: Dict[str, Any],
         trace=None if trace is None else trace_to_dict(trace, iterations),
         memory=device_memory_stats(),
         program_audit=audit,
+        fleet=fleet,
         created_unix=time.time(),
     )
 

@@ -1,11 +1,17 @@
 """Render recorded SolveReports as convergence tables + phase breakdowns.
 
-Usage: python -m megba_tpu.observability.summarize <report.jsonl> [...]
+Usage: python -m megba_tpu.observability.summarize [--aggregate] <report.jsonl> [...]
 
 Reads JSONL files written by the `MEGBA_TELEMETRY` sink (one SolveReport
 per line) and prints, per report: a header (problem shape, backend,
 config essentials), the result summary, the per-iteration convergence
 table, the phase wall-clock breakdown, and memory stats when present.
+
+`--aggregate` switches to the FLEET view: one block over all reports in
+all given files — per-status counts, problems/sec, p50/p95 solve
+latency, and (when the reports carry the serving layer's `fleet`
+context) per-bucket problem counts — so a multi-problem run's JSONL is
+readable without ad-hoc scripts.
 """
 
 from __future__ import annotations
@@ -87,6 +93,73 @@ def format_report(rep: SolveReport, index: int = 0) -> str:
     return "\n".join(lines)
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list (q in [0, 100])."""
+    if not sorted_vals:
+        return float("nan")
+    rank = max(int(math.ceil(q / 100.0 * len(sorted_vals))) - 1, 0)
+    return sorted_vals[min(rank, len(sorted_vals) - 1)]
+
+
+def _report_latency(rep: SolveReport) -> float:
+    """One report's solve latency: the serving layer's submit-to-result
+    latency when present, else the summed phase wall clock."""
+    if rep.fleet and rep.fleet.get("latency_s") is not None:
+        return float(rep.fleet["latency_s"])
+    if rep.phases:
+        return sum(ph.get("total_s", 0.0) for ph in rep.phases.values())
+    return float("nan")
+
+
+def aggregate_reports(reports: List[SolveReport]) -> str:
+    """The fleet view: status counts, throughput, latency percentiles."""
+    if not reports:
+        return "no reports"
+    lines = []
+    by_status: dict = {}
+    for rep in reports:
+        name = (rep.result or {}).get("status_name") or "unknown"
+        by_status[name] = by_status.get(name, 0) + 1
+    lats = sorted(l for l in (_report_latency(r) for r in reports)
+                  if math.isfinite(l))
+
+    # Throughput: wall span of the run when the reports spread over
+    # time; a single batch's reports share one timestamp, so the span
+    # is floored by the widest single solve so the rate stays finite
+    # and honest.
+    stamps = [r.created_unix for r in reports if r.created_unix]
+    span = (max(stamps) - min(stamps)) if len(stamps) > 1 else 0.0
+    if lats:
+        span = max(span, lats[-1])
+    rate = len(reports) / span if span > 0 else float("nan")
+
+    lines.append(f"== fleet aggregate: {len(reports)} solves ==")
+    for name in sorted(by_status):
+        lines.append(f"   status {name}: {by_status[name]}")
+    lines.append(f"   throughput: {rate:.2f} problems/s "
+                 f"over {span:.3f}s span")
+    if lats:
+        lines.append(
+            f"   latency: p50 {1e3 * _percentile(lats, 50):.1f} ms / "
+            f"p95 {1e3 * _percentile(lats, 95):.1f} ms / "
+            f"max {1e3 * lats[-1]:.1f} ms")
+    buckets: dict = {}
+    for rep in reports:
+        if rep.fleet and rep.fleet.get("bucket"):
+            buckets[rep.fleet["bucket"]] = (
+                buckets.get(rep.fleet["bucket"], 0) + 1)
+    for bucket in sorted(buckets):
+        lines.append(f"   bucket {bucket}: {buckets[bucket]} solves")
+    return "\n".join(lines)
+
+
+def aggregate_paths(paths: Iterable[str]) -> str:
+    reports: List[SolveReport] = []
+    for path in paths:
+        reports.extend(load_reports(path))
+    return aggregate_reports(reports)
+
+
 def summarize_paths(paths: Iterable[str]) -> str:
     blocks = []
     for path in paths:
@@ -101,7 +174,12 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__.strip())
         return 0 if argv else 2
-    print(summarize_paths(argv))
+    aggregate = "--aggregate" in argv
+    paths = [a for a in argv if a != "--aggregate"]
+    if not paths:
+        print(__doc__.strip())
+        return 2
+    print(aggregate_paths(paths) if aggregate else summarize_paths(paths))
     return 0
 
 

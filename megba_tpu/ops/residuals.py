@@ -314,7 +314,10 @@ def build_residual_jacobian_fn(
     return fm_fn
 
 
-@functools.lru_cache(maxsize=64)
+_cached_residual_jacobian_fn = functools.lru_cache(maxsize=64)(
+    build_residual_jacobian_fn)
+
+
 def make_residual_jacobian_fn(
     residual_fn: ResidualFn = bal_residual,
     mode: JacobianMode = JacobianMode.AUTODIFF,
@@ -324,8 +327,16 @@ def make_residual_jacobian_fn(
     the identical callable, keeping jax.jit / the distributed solve cache
     hot across separate solves.  Only pass long-lived hashable
     `residual_fn`s (module-level functions); per-problem closures go
-    through `build_residual_jacobian_fn` to avoid cache retention."""
-    return build_residual_jacobian_fn(residual_fn, mode, analytical_fn)
+    through `build_residual_jacobian_fn` to avoid cache retention.
+
+    Call-shape normalised: the lru cache sits BEHIND this wrapper with
+    every argument bound positionally, so `make_residual_jacobian_fn()`
+    and `make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)` return
+    the IDENTICAL object (raw functools.lru_cache keys keyword and
+    positional spellings separately — two engines for one config would
+    silently double every jit/program cache keyed on engine identity,
+    e.g. the serving compile pool)."""
+    return _cached_residual_jacobian_fn(residual_fn, mode, analytical_fn)
 
 
 def apply_sqrt_info(

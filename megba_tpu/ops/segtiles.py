@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import sys
 from typing import Optional, Tuple
 
@@ -987,7 +988,39 @@ def squeeze_plans(plans: DualPlans) -> DualPlans:
 # Python's hash(): a collision would silently solve the wrong graph.
 
 _PLAN_CACHE: "dict" = {}
-_PLAN_CACHE_MAX = 8  # LRU bound: plans pin host+device index arrays
+_PLAN_CACHE_DEFAULT_MAX = 8  # LRU bound: plans pin host+device index arrays
+# Monotone eviction counter: a fleet of mixed shape classes churning a
+# too-small cache shows up here (solve.flat_solve surfaces the delta as
+# a `plan_cache_evict` PhaseTimer event next to `plan_cache_hit`).
+_PLAN_CACHE_EVICTIONS = 0
+
+
+def plan_cache_capacity() -> int:
+    """LRU capacity of the host plan cache.
+
+    `MEGBA_PLAN_CACHE=<n>` overrides the default of
+    `_PLAN_CACHE_DEFAULT_MAX` (8): a fleet serving many shape classes
+    evicts pathologically at 8, while a single-problem pipeline gains
+    nothing from more.  Read at insertion time so tests (and long-lived
+    services) can retune without reimporting; `<n> >= 1`.
+    """
+    env = os.environ.get("MEGBA_PLAN_CACHE")
+    if env is None:
+        return _PLAN_CACHE_DEFAULT_MAX
+    try:
+        cap = int(env)
+    except ValueError as e:
+        raise ValueError(
+            f"MEGBA_PLAN_CACHE must be an integer >= 1, got {env!r}") from e
+    if cap < 1:
+        raise ValueError(
+            f"MEGBA_PLAN_CACHE must be an integer >= 1, got {env!r}")
+    return cap
+
+
+def plan_cache_evictions() -> int:
+    """Total plan-cache evictions this process (monotone counter)."""
+    return _PLAN_CACHE_EVICTIONS
 
 
 def _array_digest(a: np.ndarray) -> bytes:
@@ -1011,8 +1044,11 @@ def _plan_cache_get(key):
 
 
 def _plan_cache_put(key, value):
-    while len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+    global _PLAN_CACHE_EVICTIONS
+    cap = plan_cache_capacity()
+    while len(_PLAN_CACHE) >= cap:
         _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+        _PLAN_CACHE_EVICTIONS += 1
     _PLAN_CACHE[key] = value
 
 

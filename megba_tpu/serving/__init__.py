@@ -1,0 +1,47 @@
+"""Serving layer: megba_tpu as a many-problem solver service.
+
+The solver library (solve.flat_solve) makes ONE problem saturate the
+hardware; this package makes THOUSANDS of independent small-to-mid
+problems do it:
+
+- shape_class.py — canonical padded buckets (a configurable
+  power-of-two ladder) so a heterogeneous fleet maps onto a small,
+  closed set of compiled programs; padding is bitwise-exact no-op work.
+- batcher.py — `solve_many`: stack a bucket's problems on a leading
+  lane axis and drive one jitted vmapped LM solve with per-lane
+  convergence masking and per-problem SolveStatus/trace fan-out.
+- compile_pool.py — bucket programs AOT-precompiled at service start
+  from persisted warmup manifests; first-request latency is
+  dispatch-only.
+- queue.py — `FleetQueue`: async submission with Future handles and
+  deadline-based batch flush (max-wait / max-batch knobs).
+- stats.py — `FleetStats`: problems/sec at fixed convergence, bucket
+  occupancy, padding waste, compile-pool hit rate.
+"""
+
+from megba_tpu.serving.batcher import FleetProblem, FleetResult, solve_many
+from megba_tpu.serving.compile_pool import CompilePool, lower_bucket
+from megba_tpu.serving.queue import FleetQueue
+from megba_tpu.serving.shape_class import (
+    BucketLadder,
+    PaddedProblem,
+    ShapeClass,
+    classify,
+    pad_to_class,
+)
+from megba_tpu.serving.stats import FleetStats
+
+__all__ = [
+    "BucketLadder",
+    "CompilePool",
+    "FleetProblem",
+    "FleetQueue",
+    "FleetResult",
+    "FleetStats",
+    "PaddedProblem",
+    "ShapeClass",
+    "classify",
+    "lower_bucket",
+    "pad_to_class",
+    "solve_many",
+]

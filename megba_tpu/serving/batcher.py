@@ -1,0 +1,305 @@
+"""Batched mega-solve: many independent BA problems, one compiled program.
+
+`solve_many` is the synchronous public entry point of the serving
+layer: it buckets problems by shape class (serving/shape_class.py),
+stacks each bucket into a leading lane axis, and drives ONE jitted
+`vmap`'d LM solve per bucket (serving/compile_pool.py).  Per-problem
+convergence masking is native: JAX's while_loop batching freezes a
+converged lane's carry bitwise (per-lane select) while the other lanes
+keep iterating, and per-problem `SolveStatus`, trace and cost come back
+per lane.  Results are returned in submission order; the async
+dispatch queue (serving/queue.py) reuses `_solve_bucket` for its
+deadline-flushed batches.
+
+Padding guarantees (shape_class.py) make a lane's result bitwise
+identical to the same problem solved alone at the same shape class —
+the batched path changes WHERE a problem computes, never what.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from megba_tpu.common import ProblemOption, status_name, validate_options
+from megba_tpu.observability.trace import SolveTrace
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.serving.compile_pool import CompilePool
+from megba_tpu.serving.shape_class import (
+    BucketLadder,
+    PaddedProblem,
+    ShapeClass,
+    classify,
+    pad_to_class,
+)
+from megba_tpu.serving.stats import FleetStats
+from megba_tpu.utils.backend import warn_if_x64_unavailable
+from megba_tpu.utils.timing import PhaseTimer
+
+
+@dataclasses.dataclass
+class FleetProblem:
+    """One independent BA problem in the fleet (edge-major host arrays).
+
+    The serving layer's ingestion unit: conventional [N, d] numpy
+    layouts, exactly what `solve.flat_solve` accepts.  `name` tags the
+    problem through stats/telemetry fan-out."""
+
+    cameras: np.ndarray  # [Nc, cd]
+    points: np.ndarray  # [Np, pd]
+    obs: np.ndarray  # [nE, od]
+    cam_idx: np.ndarray  # [nE]
+    pt_idx: np.ndarray  # [nE]
+    name: str = ""
+
+    @classmethod
+    def from_synthetic(cls, s, name: str = "") -> "FleetProblem":
+        """Wrap an io.synthetic.SyntheticBAL (initial parameters)."""
+        return cls(cameras=s.cameras0, points=s.points0, obs=s.obs,
+                   cam_idx=s.cam_idx, pt_idx=s.pt_idx, name=name)
+
+    def dims(self) -> Tuple[int, int, int]:
+        return (int(self.cameras.shape[0]), int(self.points.shape[0]),
+                int(self.obs.shape[0]))
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """One problem's slice of a batched solve (host numpy, unpadded)."""
+
+    name: str
+    shape: ShapeClass  # the bucket this problem solved in
+    lane: int  # its lane in the batched dispatch
+    lanes: int  # total lanes dispatched in that batch
+    cameras: np.ndarray  # [Nc, cd] solved parameters
+    points: np.ndarray  # [Np, pd]
+    cost: np.ndarray  # final accepted cost (0-d, solve dtype)
+    initial_cost: np.ndarray
+    iterations: int
+    accepted: int
+    pcg_iterations: int
+    status: int  # common.SolveStatus code
+    recoveries: int
+    latency_s: float  # batch wall clock this problem rode
+    trace: Optional[SolveTrace] = None  # per-lane convergence history
+
+    @property
+    def status_name(self) -> str:
+        return status_name(self.status)
+
+
+def _strip_telemetry(option: ProblemOption) -> Tuple[ProblemOption, Optional[str], ProblemOption]:
+    """Resolve the telemetry sink and strip the knob (same contract as
+    solve.flat_solve: program caches must stay telemetry-agnostic)."""
+    telemetry = option.telemetry or os.environ.get("MEGBA_TELEMETRY") or None
+    report_option = option
+    if option.telemetry is not None:
+        option = dataclasses.replace(option, telemetry=None)
+    return option, telemetry, report_option
+
+
+def _check_option(option: ProblemOption) -> None:
+    validate_options(option)
+    if option.world_size != 1:
+        raise ValueError(
+            "serving batches over a leading lane axis on a single "
+            "program; world_size must be 1 (got "
+            f"{option.world_size}) — shard the FLEET across hosts, not "
+            "one problem across devices")
+
+
+def _group_by_bucket(problems: Sequence[FleetProblem], option: ProblemOption,
+                     ladder: BucketLadder):
+    """index-preserving grouping: (shape, cd, pd, od) -> [(i, problem)]."""
+    groups: Dict[Tuple, List[Tuple[int, FleetProblem]]] = {}
+    for i, p in enumerate(problems):
+        n_cam, n_pt, n_edge = p.dims()
+        sc = classify(n_cam, n_pt, n_edge, option.dtype, ladder)
+        dims = (int(p.cameras.shape[1]), int(p.points.shape[1]),
+                int(p.obs.shape[1]))
+        groups.setdefault((sc, dims), []).append((i, p))
+    return groups
+
+
+def _stack_bucket(padded: Sequence[PaddedProblem], lanes: int, dtype):
+    """Stack padded problems into lane-axis operands (feature-major).
+
+    Lane padding (to the lane ladder) REPEATS lane 0: a duplicate lane
+    is shape-correct, converges exactly like its original (so it can
+    never extend the while loop beyond the real lanes' horizon), and is
+    dropped on fan-out."""
+    idx = list(range(len(padded))) + [0] * (lanes - len(padded))
+    cams = np.stack([np.ascontiguousarray(padded[k].cameras.T) for k in idx])
+    pts = np.stack([np.ascontiguousarray(padded[k].points.T) for k in idx])
+    obs = np.stack([np.ascontiguousarray(padded[k].obs.T) for k in idx])
+    cam_idx = np.stack([padded[k].cam_idx for k in idx])
+    pt_idx = np.stack([padded[k].pt_idx for k in idx])
+    mask = np.stack([padded[k].mask for k in idx]).astype(dtype)
+    cam_fixed = np.stack([padded[k].cam_fixed for k in idx])
+    pt_fixed = np.stack([padded[k].pt_fixed for k in idx])
+    return cams, pts, obs, cam_idx, pt_idx, mask, cam_fixed, pt_fixed
+
+
+def _lane_result(batched, i: int):
+    """Slice lane i out of a batched LMResult pytree."""
+    return jax.tree_util.tree_map(lambda a: a[i], batched)
+
+
+def _phase_delta(before: Dict[str, Any], after: Dict[str, Any]):
+    """This batch's slice of a (possibly long-lived, cumulative)
+    PhaseTimer: `after - before` per phase, zero-delta phases dropped —
+    so every telemetry report carries its OWN batch's wall clock, not
+    the service's lifetime totals."""
+    out: Dict[str, Any] = {}
+    for name, v in after.items():
+        b = before.get(name, {"total_s": 0.0, "calls": 0})
+        d = {"total_s": v["total_s"] - b["total_s"],
+             "calls": v["calls"] - b["calls"]}
+        if d["total_s"] or d["calls"]:
+            out[name] = d
+    return out
+
+
+def _solve_bucket(
+    items: Sequence[Tuple[int, FleetProblem]],
+    shape: ShapeClass,
+    option: ProblemOption,
+    engine,
+    ladder: BucketLadder,
+    pool: CompilePool,
+    stats: FleetStats,
+    timer: PhaseTimer,
+    telemetry: Optional[str],
+    report_option: ProblemOption,
+) -> List[Tuple[int, FleetResult]]:
+    """Solve one bucket's problems in a single batched dispatch."""
+    dtype = np.dtype(option.dtype)
+    n_real = len(items)
+    lanes = ladder.bucket_lanes(n_real)
+    phases_before = timer.as_dict()
+    with timer.phase("lowering"):
+        padded = [pad_to_class(p.cameras, p.points, p.obs, p.cam_idx,
+                               p.pt_idx, shape) for _, p in items]
+        operands = _stack_bucket(padded, lanes, dtype)
+    cd = operands[0].shape[1]
+    pd = operands[1].shape[1]
+    od = operands[2].shape[1]
+
+    with timer.phase("program"):
+        program = pool.program(engine, option, shape, lanes, cd, pd, od)
+    ir = jnp.asarray(option.algo_option.initial_region, dtype)
+    iv = jnp.asarray(2.0, dtype)
+
+    t0 = time.perf_counter()
+    with timer.phase("dispatch"):
+        result = program(*operands, ir, iv)
+    with timer.phase("execute") as ph:
+        ph.sync(result.cost)
+    wall = time.perf_counter() - t0
+
+    edges_real = sum(p.n_edge for p in padded)
+    stats.record_batch(str(shape), lanes, n_real, edges_real,
+                       shape.n_edge, wall)
+
+    out: List[Tuple[int, FleetResult]] = []
+    for lane, ((orig_i, prob), pp) in enumerate(zip(items, padded)):
+        lane_res = _lane_result(result, lane)
+        fr = FleetResult(
+            name=prob.name,
+            shape=shape,
+            lane=lane,
+            lanes=lanes,
+            cameras=np.asarray(lane_res.cameras).T[:pp.n_cam],
+            points=np.asarray(lane_res.points).T[:pp.n_pt],
+            cost=np.asarray(lane_res.cost),
+            initial_cost=np.asarray(lane_res.initial_cost),
+            iterations=int(lane_res.iterations),
+            accepted=int(lane_res.accepted),
+            pcg_iterations=int(lane_res.pcg_iterations),
+            status=int(lane_res.status),
+            recoveries=int(lane_res.recoveries),
+            latency_s=wall,
+            trace=lane_res.trace,
+        )
+        out.append((orig_i, fr))
+        if telemetry and jax.process_index() == 0:
+            from megba_tpu.observability.report import (
+                append_report,
+                build_report,
+            )
+
+            problem_shape = {
+                "num_cameras": pp.n_cam,
+                "num_points": pp.n_pt,
+                "num_edges": pp.n_edge,
+                "num_edges_padded": shape.n_edge,
+                "world_size": 1,
+            }
+            fleet = {
+                "name": prob.name,
+                "bucket": str(shape),
+                "lane": lane,
+                "lanes": lanes,
+                "batch_problems": n_real,
+                "latency_s": wall,
+                "batch_problems_per_sec": n_real / wall if wall > 0 else 0.0,
+                "stats": stats.as_dict(),
+            }
+            append_report(
+                build_report(report_option, lane_res,
+                             _phase_delta(phases_before, timer.as_dict()),
+                             problem_shape, fleet=fleet), telemetry)
+    return out
+
+
+def solve_many(
+    problems: Sequence[FleetProblem],
+    option: Optional[ProblemOption] = None,
+    *,
+    ladder: Optional[BucketLadder] = None,
+    pool: Optional[CompilePool] = None,
+    stats: Optional[FleetStats] = None,
+    timer: Optional[PhaseTimer] = None,
+) -> List[FleetResult]:
+    """Solve many independent BA problems through bucketed batched
+    programs; results come back in submission order.
+
+    PUBLIC BOUNDARY of the serving layer.  Problems are grouped by
+    shape class (ladder-padded (n_cam, n_pt, n_edge, dtype)); each
+    group runs as ONE batched dispatch of the vmapped LM program, so a
+    fleet of N problems costs `len(buckets)` dispatches, not N — and,
+    with a warmed `pool`, zero compilations.  Each result carries the
+    problem's own convergence story (`SolveStatus`, cost, trace): one
+    slow lane never changes its neighbours' answers (bitwise), it only
+    rides the same program longer.
+
+    `ladder` / `pool` / `stats` default to fresh instances; long-lived
+    services pass their own so programs, manifests and counters persist
+    across calls.  Telemetry (option knob or MEGBA_TELEMETRY) appends
+    one SolveReport per PROBLEM with a `fleet` block (bucket, lane,
+    batch latency, service counters).
+    """
+    option = option or ProblemOption()
+    _check_option(option)
+    option, telemetry, report_option = _strip_telemetry(option)
+    warn_if_x64_unavailable(np.dtype(option.dtype))
+    ladder = ladder or BucketLadder()
+    stats = stats or FleetStats()
+    pool = pool or CompilePool(stats=stats)
+    timer = PhaseTimer() if timer is None else timer
+    engine = make_residual_jacobian_fn(mode=option.jacobian_mode)
+
+    results: List[Optional[FleetResult]] = [None] * len(problems)
+    for (shape, _dims), items in _group_by_bucket(
+            problems, option, ladder).items():
+        for orig_i, fr in _solve_bucket(
+                items, shape, option, engine, ladder, pool, stats, timer,
+                telemetry, report_option):
+            results[orig_i] = fr
+    return results  # type: ignore[return-value]

@@ -1,0 +1,250 @@
+"""Compile pool: the batched LM program, AOT-precompiled per bucket.
+
+One vmapped, jitted LM solve serves every problem of a shape bucket
+(serving/shape_class.py).  This module owns that program:
+
+- `batched_solve_program` builds the jitted `vmap`'d `lm_solve` for an
+  (engine, option) pair — ONE callable per configuration, memoised
+  module-level exactly like `solve._cached_single_solve`, so repeated
+  batches can never rebuild it around a fresh closure (the silent
+  retrace bug the sentinel polices).
+- `CompilePool.program(...)` hands the batcher a callable for a
+  (shape class, lanes) bucket.  If the bucket was warmed, that callable
+  IS the AOT `jax.stages.Compiled` executable — dispatch-only latency,
+  no tracing on the request path.  Otherwise the shared jitted callable
+  compiles on first dispatch and the pool records the bucket as ready.
+- `CompilePool.warm(...)` AOT-lowers + compiles buckets from abstract
+  `jax.ShapeDtypeStruct`s — no problem data needed — through the same
+  builder the dispatch path uses, so what the pool warms is
+  byte-for-byte the program requests will run.  With the persistent
+  compile cache enabled (utils/backend.enable_persistent_compile_cache)
+  the XLA compile itself is a disk hit across service restarts.
+- Warmup manifests (`save_manifest` / `warm_from_manifest`) persist the
+  observed buckets as JSON so a restarted service precompiles its whole
+  working set before taking traffic.
+
+The AOT store is MODULE-level (shared by every pool instance in the
+process): two pools warming/dispatching the same bucket must reuse one
+trace, or the retrace sentinel would rightly flag the duplicate.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from megba_tpu.algo.lm import lm_solve
+from megba_tpu.analysis.retrace import static_key, traced
+from megba_tpu.serving.shape_class import ShapeClass
+
+MANIFEST_SCHEMA = "megba_tpu.fleet_manifest/v1"
+
+# (engine, option, shape, lanes, cd, pd, od) -> jax.stages.Compiled
+_AOT: Dict[Tuple, Any] = {}
+# keys already compiled through the jitted dispatch path (jit-cache hot)
+_DISPATCHED: set = set()
+# keys a warm() is compiling right now (reservation against duplicate
+# AOT compiles when warms race each other)
+_WARMING: set = set()
+_LOCK = threading.Lock()
+
+
+def _build_batched_solve(residual_jac_fn, option):
+    """The batched mega-solve: `vmap`'d LM over a leading problem axis.
+
+    Every lane carries its own problem (parameters, observations,
+    indices, masks); the trust-region start state is shared (fresh
+    solves).  Per-lane convergence masking falls out of JAX's
+    while_loop batching rule: a lane whose `cond` has gone False keeps
+    its carry through a per-lane select — it freezes BITWISE while the
+    other lanes keep iterating — and the loop runs until every lane's
+    predicate clears.  Per-lane `SolveStatus`, trace and cost come back
+    as leading-axis stacks on the returned LMResult pytree.
+
+    The parameter stacks are donated (same rationale as
+    solve._build_single_solve): the batcher stacks fresh operands per
+    batch and never reads them back.
+    """
+
+    def one(cameras, points, obs, cam_idx, pt_idx, mask, cam_fixed,
+            pt_fixed, init_region, init_v):
+        return lm_solve(
+            residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
+            option, cam_fixed=cam_fixed, pt_fixed=pt_fixed,
+            cam_sorted=True, initial_region=init_region,
+            initial_v=init_v)
+
+    batched = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None))
+    return jax.jit(
+        traced("serving.batched", batched,
+               static=static_key(residual_jac_fn, option, "batched")),
+        donate_argnums=(0, 1))
+
+
+# Long-lived engines only (make_residual_jacobian_fn is itself memoised,
+# so the default BAL engines qualify); mirrors _cached_single_solve.
+batched_solve_program = functools.lru_cache(maxsize=64)(_build_batched_solve)
+
+
+def _abstract_args(shape: ShapeClass, lanes: int, cd: int, pd: int,
+                   od: int) -> Tuple:
+    """ShapeDtypeStructs matching the batcher's operand layout
+    (feature-major stacks, leading lane axis)."""
+    dt = np.dtype(shape.dtype)
+    s = jax.ShapeDtypeStruct
+    return (
+        s((lanes, cd, shape.n_cam), dt),  # cameras
+        s((lanes, pd, shape.n_pt), dt),  # points
+        s((lanes, od, shape.n_edge), dt),  # obs
+        s((lanes, shape.n_edge), np.int32),  # cam_idx
+        s((lanes, shape.n_edge), np.int32),  # pt_idx
+        s((lanes, shape.n_edge), dt),  # mask
+        s((lanes, shape.n_cam), np.bool_),  # cam_fixed
+        s((lanes, shape.n_pt), np.bool_),  # pt_fixed
+        s((), dt),  # init_region
+        s((), dt),  # init_v
+    )
+
+
+def pool_key(engine, option, shape: ShapeClass, lanes: int, cd: int,
+             pd: int, od: int) -> Tuple:
+    return (engine, option, shape, int(lanes), int(cd), int(pd), int(od))
+
+
+def lower_bucket(engine, option, shape: ShapeClass, lanes: int,
+                 cd: int = 9, pd: int = 3, od: int = 2):
+    """AOT-lower one bucket program (`jax.stages.Lowered`).
+
+    The compiled-program auditor's entry point for the batched canonical
+    program (`ba_batched_b4_f32`): same builder, same operand layout,
+    same donation flags as production dispatch.
+    """
+    jitted = batched_solve_program(engine, option)
+    return jitted.lower(*_abstract_args(shape, lanes, cd, pd, od))
+
+
+class CompilePool:
+    """Bucket-program registry + warmup for one fleet service.
+
+    `stats` (serving.stats.FleetStats) receives a hit/miss per
+    `program()` request: a hit means the request rode an
+    already-compiled program (AOT-warmed or previously dispatched) —
+    the compile-pool hit rate a service's first-request latency lives
+    and dies by.
+    """
+
+    def __init__(self, stats=None) -> None:
+        self._stats = stats
+        self._seen: Dict[Tuple, Dict[str, Any]] = {}  # key -> manifest entry
+        self._lock = threading.Lock()
+
+    # -- dispatch path ---------------------------------------------------
+    def program(self, engine, option, shape: ShapeClass, lanes: int,
+                cd: int, pd: int, od: int):
+        """Callable for one bucket; prefers the AOT executable."""
+        key = pool_key(engine, option, shape, lanes, cd, pd, od)
+        self._note(key, shape, lanes, cd, pd, od)
+        with _LOCK:
+            compiled = _AOT.get(key)
+            hit = compiled is not None or key in _DISPATCHED
+        if self._stats is not None:
+            self._stats.record_pool(hit)
+        if compiled is not None:
+            return compiled
+        jitted = batched_solve_program(engine, option)
+
+        def run(*args):
+            out = jitted(*args)
+            # Mark the bucket jit-cache hot only once a dispatch has
+            # actually compiled and returned: a failed first dispatch
+            # must leave warm() able to build the bucket, and must not
+            # count later requests as pool hits.
+            with _LOCK:
+                _DISPATCHED.add(key)
+            return out
+
+        return run
+
+    # -- warmup ----------------------------------------------------------
+    def warm(self, engine, option, entries: Sequence[Dict[str, Any]]) -> int:
+        """AOT-compile the given buckets; returns how many were built.
+
+        `entries` are manifest-entry dicts ({"shape": {...}, "lanes": n,
+        "cd": .., "pd": .., "od": ..}).  Buckets already in the AOT
+        store are skipped (idempotent warmup)."""
+        built = 0
+        for e in entries:
+            shape = ShapeClass.from_dict(e["shape"])
+            lanes = int(e["lanes"])
+            cd, pd, od = int(e.get("cd", 9)), int(e.get("pd", 3)), \
+                int(e.get("od", 2))
+            key = pool_key(engine, option, shape, lanes, cd, pd, od)
+            self._note(key, shape, lanes, cd, pd, od)
+            with _LOCK:
+                if key in _AOT or key in _DISPATCHED or key in _WARMING:
+                    continue
+                _WARMING.add(key)
+            try:
+                compiled = lower_bucket(engine, option, shape, lanes,
+                                        cd, pd, od).compile()
+                with _LOCK:
+                    _AOT[key] = compiled
+            finally:
+                with _LOCK:
+                    _WARMING.discard(key)
+            built += 1
+        return built
+
+    # -- manifests -------------------------------------------------------
+    def _note(self, key: Tuple, shape: ShapeClass, lanes: int, cd: int,
+              pd: int, od: int) -> None:
+        with self._lock:
+            self._seen.setdefault(key, {
+                "shape": shape.to_dict(), "lanes": int(lanes),
+                "cd": int(cd), "pd": int(pd), "od": int(od)})
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(v) for v in self._seen.values()]
+
+    def save_manifest(self, path: str, option=None) -> None:
+        """Persist every bucket this pool has seen (atomic write)."""
+        doc = {
+            "schema": MANIFEST_SCHEMA,
+            "option": None if option is None else static_key(option),
+            "entries": self.entries(),
+        }
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    def warm_from_manifest(self, path: str, engine, option) -> int:
+        """Load a manifest and AOT-compile its buckets for `option`.
+
+        A manifest recorded under a different option fingerprint still
+        names valid SHAPES, but the programs it warmed are not the ones
+        this service will run — warn and compile for the given option
+        anyway (the shapes are the expensive knowledge)."""
+        with open(path) as fh:
+            doc = json.load(fh)
+        if doc.get("schema") != MANIFEST_SCHEMA:
+            raise ValueError(
+                f"{path}: not a fleet warmup manifest "
+                f"(schema={doc.get('schema')!r})")
+        recorded = doc.get("option")
+        if recorded is not None and recorded != static_key(option):
+            warnings.warn(
+                f"{path}: manifest was recorded under a different option "
+                "configuration; warming its shape classes for the current "
+                "options", stacklevel=2)
+        return self.warm(engine, option, doc.get("entries", ()))

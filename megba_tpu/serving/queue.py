@@ -1,0 +1,194 @@
+"""Async dispatch queue: submit problems, get Future-style handles.
+
+The latency-shaping half of the serving layer.  `FleetQueue.submit`
+enqueues one problem and returns a `concurrent.futures.Future`
+resolving to its `FleetResult`; a dispatcher thread groups pending
+problems by shape class and flushes a bucket when either
+
+- it holds `max_batch` problems (occupancy-driven flush), or
+- its OLDEST problem has waited `max_wait_s` (deadline-driven flush —
+  the knob trading per-problem latency against batch occupancy).
+
+All JAX work happens on the dispatcher thread (one dispatch at a time,
+matching the single-device serving contract); submitters only touch
+host queues.  A failed batch propagates its exception to every future
+in that batch and the queue keeps serving — one poisoned problem never
+wedges the service.
+
+`close()` drains everything still pending, then joins the thread;
+`FleetQueue` is a context manager (`with FleetQueue(...) as q:`), and
+futures from a drained close still resolve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from megba_tpu.common import ProblemOption
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.serving.batcher import (
+    FleetProblem,
+    _check_option,
+    _solve_bucket,
+    _strip_telemetry,
+)
+from megba_tpu.serving.compile_pool import CompilePool
+from megba_tpu.serving.shape_class import BucketLadder, ShapeClass, classify
+from megba_tpu.serving.stats import FleetStats
+from megba_tpu.utils.timing import PhaseTimer
+
+
+@dataclasses.dataclass
+class _Pending:
+    problem: FleetProblem
+    future: Future
+    enqueued: float  # monotonic seconds
+
+
+class FleetQueue:
+    """Deadline-batched async front door for `solve_many`-style solves.
+
+    Knobs: `max_batch` caps a bucket's flush size (also the occupancy
+    trigger); `max_wait_s` bounds how long a lone problem waits for
+    batch-mates.  `ladder`/`pool`/`stats` default to fresh instances —
+    a production service passes a warmed pool so the dispatch path
+    never compiles.
+    """
+
+    def __init__(
+        self,
+        option: Optional[ProblemOption] = None,
+        *,
+        max_batch: int = 16,
+        max_wait_s: float = 0.02,
+        ladder: Optional[BucketLadder] = None,
+        pool: Optional[CompilePool] = None,
+        stats: Optional[FleetStats] = None,
+        timer: Optional[PhaseTimer] = None,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        option = option or ProblemOption()
+        _check_option(option)
+        self._option, self._telemetry, self._report_option = (
+            _strip_telemetry(option))
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self.ladder = ladder or BucketLadder()
+        self.stats = stats or FleetStats()
+        self.pool = pool or CompilePool(stats=self.stats)
+        self.timer = PhaseTimer() if timer is None else timer
+        self._engine = make_residual_jacobian_fn(
+            mode=self._option.jacobian_mode)
+
+        self._lock = threading.Condition()
+        self._pending: Dict[Tuple[ShapeClass, Tuple[int, int, int]],
+                            List[_Pending]] = {}
+        self._closing = False
+        self._force = False
+        self._thread = threading.Thread(
+            target=self._run, name="megba-fleet-dispatch", daemon=True)
+        self._thread.start()
+
+    # -- submission ------------------------------------------------------
+    def submit(self, problem: FleetProblem) -> "Future":
+        """Enqueue one problem; the Future resolves to its FleetResult
+        (or raises what its batch raised)."""
+        n_cam, n_pt, n_edge = problem.dims()
+        sc = classify(n_cam, n_pt, n_edge, self._option.dtype, self.ladder)
+        dims = (int(problem.cameras.shape[1]), int(problem.points.shape[1]),
+                int(problem.obs.shape[1]))
+        item = _Pending(problem=problem, future=Future(),
+                        enqueued=time.monotonic())
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("FleetQueue is closed")
+            self._pending.setdefault((sc, dims), []).append(item)
+            self._lock.notify()
+        return item.future
+
+    def flush(self) -> None:
+        """Dispatch everything pending NOW (ignore deadlines) and block
+        until it has been handed to the solver."""
+        with self._lock:
+            self._force = True
+            self._lock.notify()
+            while any(self._pending.values()):
+                self._lock.wait(timeout=0.01)
+            self._force = False
+
+    def close(self) -> None:
+        """Drain pending work, then stop the dispatcher thread."""
+        with self._lock:
+            self._closing = True
+            self._lock.notify()
+        self._thread.join()
+
+    def __enter__(self) -> "FleetQueue":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- dispatcher ------------------------------------------------------
+    def _ripe_buckets(self, now: float, drain: bool):
+        """Buckets due for flush + the sleep until the next deadline."""
+        ripe = []
+        next_deadline = None
+        for key, items in self._pending.items():
+            if not items:
+                continue
+            deadline = items[0].enqueued + self.max_wait_s
+            if drain or len(items) >= self.max_batch or now >= deadline:
+                ripe.append(key)
+            elif next_deadline is None or deadline < next_deadline:
+                next_deadline = deadline
+        timeout = (None if next_deadline is None
+                   else max(next_deadline - now, 0.0))
+        return ripe, timeout
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                ripe, timeout = self._ripe_buckets(
+                    time.monotonic(), drain=self._closing or self._force)
+                if not ripe:
+                    if self._closing:
+                        return
+                    self._lock.wait(timeout=timeout)
+                    continue
+                batches = []
+                for key in ripe:
+                    items = self._pending[key]
+                    take, rest = items[:self.max_batch], items[self.max_batch:]
+                    self._pending[key] = rest
+                    batches.append((key, take))
+                self._lock.notify_all()
+            for (sc, _dims), taken in batches:
+                self._dispatch(sc, taken)
+
+    def _dispatch(self, shape: ShapeClass, taken: List[_Pending]) -> None:
+        items = [(i, p.problem) for i, p in enumerate(taken)]
+        try:
+            solved = _solve_bucket(
+                items, shape, self._option, self._engine, self.ladder,
+                self.pool, self.stats, self.timer, self._telemetry,
+                self._report_option)
+        except Exception as exc:  # fan the failure out, keep serving
+            for p in taken:
+                if not p.future.cancelled():
+                    p.future.set_exception(exc)
+            return
+        for lane_i, fr in solved:
+            fut = taken[lane_i].future
+            fr.latency_s = time.monotonic() - taken[lane_i].enqueued
+            if not fut.cancelled():
+                fut.set_result(fr)

@@ -1,0 +1,216 @@
+"""Shape classes: canonical padded buckets for fleet solves.
+
+The fleet traffic shape (thousands of independent small-to-mid BA
+problems) would naively compile one XLA program per distinct
+(n_cam, n_pt, n_edge) triple — unbounded compile volume, exactly the
+shape instability the retrace sentinel (analysis/retrace.py) polices.
+This module quantises a problem's dimensions onto a configurable
+bucketing ladder so that EVERY problem maps to one of a small, closed
+set of padded shapes, and one compiled program per bucket serves all of
+them, forever.
+
+The padding is built from the machinery the solver already trusts:
+
+- the edge axis is padded exactly like `solve.flat_solve` does
+  (core/types.pad_edges: masked-out edges repeating the last edge's
+  vertex indices, so camera-sortedness survives and segment reductions
+  see in-range indices), just to the bucket size instead of the minimal
+  EDGE_QUANTUM multiple;
+- padded cameras/points are appended as ZERO parameter blocks flagged
+  through the existing `cam_fixed` / `pt_fixed` masks, which zero their
+  Jacobian columns and pin their Hessian blocks to identity
+  (linear_system/builder.weight_system_inputs / build_schur_system) —
+  their gradient is identically zero, so PCG leaves their components at
+  exactly 0.0 and the LM carry never moves them.
+
+Both mechanisms contribute literal zeros to every reduction, so a
+padded solve is BITWISE identical to the unpadded one on this backend
+(tests/test_serving.py pins this; the edge ladder grows by powers of
+two on top of EDGE_QUANTUM, which keeps the compensated-sum fold
+pattern of real data unchanged when zero rows are appended).
+
+All buckets are powers of two times a floor, so the ladder is monotone
+(more of anything never lands in a smaller bucket) and its size is
+logarithmic in the problem-size range.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from megba_tpu.core.fm import EDGE_QUANTUM
+
+
+def _round_up_pow2_multiple(n: int, floor: int) -> int:
+    """Smallest `floor * 2**k` (k >= 0) that is >= n."""
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """The bucketing ladder: floors + power-of-two growth per axis.
+
+    `edge_floor` must be a multiple of EDGE_QUANTUM: the solver's
+    chunked edge reductions require it, and power-of-two growth on top
+    of the quantum keeps zero-padding bitwise-neutral through the
+    compensated-sum trees (ops/accum.comp_sum folds whole zero rows
+    away exactly).  `lane_floor` buckets the BATCH axis the same way so
+    a bucket's compiled program count stays logarithmic in the batch
+    sizes the dispatch queue produces.
+    """
+
+    cam_floor: int = 4
+    pt_floor: int = 16
+    edge_floor: int = EDGE_QUANTUM
+    lane_floor: int = 1
+
+    def __post_init__(self) -> None:
+        for name in ("cam_floor", "pt_floor", "edge_floor", "lane_floor"):
+            v = getattr(self, name)
+            if v < 1:
+                raise ValueError(f"{name} must be >= 1, got {v}")
+        if self.edge_floor % EDGE_QUANTUM:
+            raise ValueError(
+                f"edge_floor must be a multiple of EDGE_QUANTUM "
+                f"({EDGE_QUANTUM}), got {self.edge_floor}")
+
+    def bucket_cams(self, n: int) -> int:
+        return _round_up_pow2_multiple(int(n), self.cam_floor)
+
+    def bucket_points(self, n: int) -> int:
+        return _round_up_pow2_multiple(int(n), self.pt_floor)
+
+    def bucket_edges(self, n: int) -> int:
+        return _round_up_pow2_multiple(int(n), self.edge_floor)
+
+    def bucket_lanes(self, n: int) -> int:
+        return _round_up_pow2_multiple(int(n), self.lane_floor)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeClass:
+    """One padded bucket: the static shape every member solves at.
+
+    Hashable + orderable; the dict key the batcher groups problems
+    under and the compile pool keys programs by (together with the lane
+    count and the option fingerprint).  `dtype` is the numpy dtype NAME
+    so the class is JSON-serializable for warmup manifests.
+    """
+
+    n_cam: int
+    n_pt: int
+    n_edge: int
+    dtype: str
+
+    def __str__(self) -> str:  # manifest / stats key
+        return f"c{self.n_cam}_p{self.n_pt}_e{self.n_edge}_{self.dtype}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"n_cam": self.n_cam, "n_pt": self.n_pt,
+                "n_edge": self.n_edge, "dtype": self.dtype}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ShapeClass":
+        return cls(n_cam=int(d["n_cam"]), n_pt=int(d["n_pt"]),
+                   n_edge=int(d["n_edge"]), dtype=str(d["dtype"]))
+
+
+def classify(n_cam: int, n_pt: int, n_edge: int, dtype,
+             ladder: BucketLadder) -> ShapeClass:
+    """Canonicalize raw problem dimensions onto the ladder."""
+    if n_cam < 1 or n_pt < 1 or n_edge < 1:
+        raise ValueError(
+            f"degenerate problem: n_cam={n_cam} n_pt={n_pt} n_edge={n_edge}")
+    return ShapeClass(
+        n_cam=ladder.bucket_cams(n_cam),
+        n_pt=ladder.bucket_points(n_pt),
+        n_edge=ladder.bucket_edges(n_edge),
+        dtype=np.dtype(dtype).name,
+    )
+
+
+@dataclasses.dataclass
+class PaddedProblem:
+    """One problem lowered to its shape class (host numpy, edge-major).
+
+    Edges are camera-sorted and padded to `shape.n_edge` with mask-0
+    slots; cameras/points are zero-padded to the bucket with the pad
+    region flagged in `cam_fixed` / `pt_fixed`.  `n_cam/n_pt/n_edge`
+    remember the REAL sizes for slicing results back out.
+    """
+
+    shape: ShapeClass
+    cameras: np.ndarray  # [n_cam_bucket, cd]
+    points: np.ndarray  # [n_pt_bucket, pd]
+    obs: np.ndarray  # [n_edge_bucket, od]
+    cam_idx: np.ndarray  # [n_edge_bucket] int32
+    pt_idx: np.ndarray  # [n_edge_bucket] int32
+    mask: np.ndarray  # [n_edge_bucket] dtype 0/1
+    cam_fixed: np.ndarray  # [n_cam_bucket] bool, True on padding
+    pt_fixed: np.ndarray  # [n_pt_bucket] bool, True on padding
+    n_cam: int
+    n_pt: int
+    n_edge: int
+
+
+def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
+                 cam_idx: np.ndarray, pt_idx: np.ndarray,
+                 shape: ShapeClass) -> PaddedProblem:
+    """Lower one problem's host arrays onto its shape class.
+
+    Mirrors `solve.flat_solve`'s host prep for the non-tiled path:
+    dtype cast, camera sort (native counting sort), edge padding — then
+    the bucket's camera/point zero-padding with fixed-mask flags on the
+    pad region.  Padded edges repeat the last REAL edge's vertex
+    indices (pad_edges), which point at real vertices, so the masked
+    residual evaluation stays finite.
+    """
+    from megba_tpu.core.types import is_cam_sorted, pad_edges
+    from megba_tpu.native import sort_edges_by_camera
+
+    dtype = np.dtype(shape.dtype)
+    cameras = np.asarray(cameras).astype(dtype, copy=False)
+    points = np.asarray(points).astype(dtype, copy=False)
+    obs = np.asarray(obs).astype(dtype, copy=False)
+    cam_idx = np.asarray(cam_idx, dtype=np.int32)
+    pt_idx = np.asarray(pt_idx, dtype=np.int32)
+    n_cam, n_pt, n_edge = cameras.shape[0], points.shape[0], obs.shape[0]
+    if n_cam > shape.n_cam or n_pt > shape.n_pt or n_edge > shape.n_edge:
+        raise ValueError(
+            f"problem ({n_cam} cams, {n_pt} pts, {n_edge} edges) does not "
+            f"fit shape class {shape}")
+
+    if not is_cam_sorted(cam_idx):
+        perm = sort_edges_by_camera(cam_idx, n_cam)
+        cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+
+    # pad_edges pads to a MULTIPLE of its argument; the bucket size is
+    # the multiple here, and n_edge <= shape.n_edge, so the result is
+    # exactly one bucket long.
+    obs, cam_idx, pt_idx, mask = pad_edges(
+        obs, cam_idx, pt_idx, shape.n_edge, dtype=dtype)
+
+    pad_c = shape.n_cam - n_cam
+    pad_p = shape.n_pt - n_pt
+    if pad_c:
+        cameras = np.concatenate(
+            [cameras, np.zeros((pad_c, cameras.shape[1]), dtype)])
+    if pad_p:
+        points = np.concatenate(
+            [points, np.zeros((pad_p, points.shape[1]), dtype)])
+    cam_fixed = np.zeros(shape.n_cam, dtype=bool)
+    cam_fixed[n_cam:] = True
+    pt_fixed = np.zeros(shape.n_pt, dtype=bool)
+    pt_fixed[n_pt:] = True
+
+    return PaddedProblem(
+        shape=shape, cameras=cameras, points=points, obs=obs,
+        cam_idx=cam_idx, pt_idx=pt_idx, mask=mask,
+        cam_fixed=cam_fixed, pt_fixed=pt_fixed,
+        n_cam=n_cam, n_pt=n_pt, n_edge=n_edge)

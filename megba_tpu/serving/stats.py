@@ -1,0 +1,127 @@
+"""FleetStats: service-level counters for the many-problem solver.
+
+The per-solve observability story (SolveTrace / SolveReport /
+PhaseTimer) answers "what did THIS solve do"; a fleet service needs the
+aggregate view: problems/sec at fixed convergence (the roadmap's
+throughput metric — NOT LM iters/sec), how full the shape buckets run,
+how much padded work the ladder wastes, and whether the compile pool is
+actually absorbing compilations.
+
+One `FleetStats` instance is shared by the batcher, the compile pool
+and the dispatch queue; every mutation is lock-protected (the queue's
+dispatcher thread and caller threads both touch it).  `as_dict()` is
+the JSON view embedded in telemetry SolveReports (the `fleet` field)
+and `report()` the human-readable block.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+
+class FleetStats:
+    """Aggregate fleet counters; thread-safe; cheap enough to always on."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.problems = 0  # real problems solved (padding lanes excluded)
+        self.batches = 0  # batched dispatches
+        self.solve_seconds = 0.0  # wall clock inside batched dispatches
+        self.lane_slots = 0  # lanes dispatched, padding lanes included
+        self.edge_slots = 0  # lane-edge slots dispatched (lanes * bucket)
+        self.edges_real = 0  # raw (unpadded) edges across real problems
+        self.pool_hits = 0  # dispatches served by an already-built program
+        self.pool_misses = 0  # dispatches that had to build/compile
+        self.per_bucket: Dict[str, Dict[str, int]] = {}
+
+    # -- recording -------------------------------------------------------
+    def record_batch(self, bucket: str, lanes: int, n_real: int,
+                     edges_real: int, edge_bucket: int,
+                     wall_s: float) -> None:
+        with self._lock:
+            self.problems += n_real
+            self.batches += 1
+            self.solve_seconds += wall_s
+            self.lane_slots += lanes
+            self.edge_slots += lanes * edge_bucket
+            self.edges_real += edges_real
+            b = self.per_bucket.setdefault(
+                bucket, {"problems": 0, "batches": 0, "lane_slots": 0})
+            b["problems"] += n_real
+            b["batches"] += 1
+            b["lane_slots"] += lanes
+
+    def record_pool(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.pool_hits += 1
+            else:
+                self.pool_misses += 1
+
+    # -- derived metrics -------------------------------------------------
+    def problems_per_sec(self) -> float:
+        with self._lock:
+            if self.solve_seconds <= 0.0:
+                return 0.0
+            return self.problems / self.solve_seconds
+
+    def padding_waste(self) -> float:
+        """Fraction of dispatched lane-edge slots that carried no real
+        edge — the price of the ladder's quantisation (padded edges AND
+        whole padding lanes both count as waste)."""
+        with self._lock:
+            if self.edge_slots == 0:
+                return 0.0
+            return 1.0 - self.edges_real / self.edge_slots
+
+    def occupancy(self) -> Dict[str, float]:
+        """bucket -> mean real problems per dispatched lane slot."""
+        with self._lock:
+            return {
+                k: (b["problems"] / b["lane_slots"] if b["lane_slots"] else 0.0)
+                for k, b in self.per_bucket.items()
+            }
+
+    def pool_hit_rate(self) -> float:
+        with self._lock:
+            n = self.pool_hits + self.pool_misses
+            return self.pool_hits / n if n else 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            base = {
+                "problems": self.problems,
+                "batches": self.batches,
+                "solve_seconds": self.solve_seconds,
+                "lane_slots": self.lane_slots,
+                "edge_slots": self.edge_slots,
+                "edges_real": self.edges_real,
+                "pool_hits": self.pool_hits,
+                "pool_misses": self.pool_misses,
+                "per_bucket": {k: dict(v)
+                               for k, v in self.per_bucket.items()},
+            }
+        base["problems_per_sec"] = self.problems_per_sec()
+        base["padding_waste"] = self.padding_waste()
+        base["bucket_occupancy"] = self.occupancy()
+        base["pool_hit_rate"] = self.pool_hit_rate()
+        return base
+
+    def report(self) -> str:
+        d = self.as_dict()
+        lines = [
+            f"fleet: {d['problems']} problems in {d['batches']} batches "
+            f"({d['solve_seconds']:.3f}s solve wall, "
+            f"{d['problems_per_sec']:.1f} problems/s)",
+            f"  padding waste: {100 * d['padding_waste']:.1f}% of "
+            f"lane-edge slots",
+            f"  compile pool: {d['pool_hits']} hits / {d['pool_misses']} "
+            f"misses ({100 * d['pool_hit_rate']:.0f}% hit rate)",
+        ]
+        for bucket, occ in sorted(d["bucket_occupancy"].items()):
+            b = d["per_bucket"][bucket]
+            lines.append(
+                f"  {bucket}: {b['problems']} problems / "
+                f"{b['batches']} batches, occupancy {100 * occ:.0f}%")
+        return "\n".join(lines)

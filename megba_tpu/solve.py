@@ -101,6 +101,7 @@ def flat_solve(
     pt_idx: np.ndarray,
     option: ProblemOption,
     sqrt_info: Optional[np.ndarray] = None,
+    edge_mask: Optional[np.ndarray] = None,
     cam_fixed: Optional[np.ndarray] = None,
     pt_fixed: Optional[np.ndarray] = None,
     verbose: bool = False,
@@ -125,7 +126,17 @@ def flat_solve(
     already; `sqrt_info` rides the same permutation.  The edge axis is
     padded to a multiple of world_size * EDGE_QUANTUM (masked-out edges)
     so chunked builds, the Pallas assembly tiles and equal shards all get
-    static shapes.  `option.world_size` selects the mesh; jitted programs
+    static shapes.
+
+    `edge_mask` ([nE] 0/1, caller's edge order) multiplies into that
+    internal padding mask: a 0 edge is EXACTLY the no-op a padded edge
+    is (zero residual weight, zero cost contribution) without changing
+    the program's static shape — so callers can soft-delete edges, and
+    the serving layer's pre-padded buckets (serving/shape_class.py) can
+    be replayed through this entry point bit-for-bit (the fleet parity
+    tests drive a bucket lane and `flat_solve(..., edge_mask=...)` on
+    identical operands).  Purely an operand: toggling it never
+    recompiles.  `option.world_size` selects the mesh; jitted programs
     are cached per configuration — globally for long-lived engines, or in
     the caller-owned `jit_cache` dict when the engine is a per-problem
     closure whose lifetime must not exceed its problem's (BaseProblem
@@ -186,6 +197,13 @@ def flat_solve(
         cam_idx = np.asarray(cam_idx)
         pt_idx = np.asarray(pt_idx)
     n_edges_raw = int(cam_idx.shape[0])
+    em = None
+    if edge_mask is not None:
+        em = np.asarray(edge_mask).astype(dtype, copy=False).reshape(-1)
+        if em.shape[0] != n_edges_raw:
+            raise ValueError(
+                f"edge_mask has {em.shape[0]} entries for a problem "
+                f"with {n_edges_raw} edges")
     fault_edge = None
     if fault_plan is not None:
         fault_edge = np.asarray(fault_plan.edge_nan)
@@ -203,14 +221,21 @@ def flat_solve(
         # Sharded tiled lowering: contiguous per-shard edge chunks, each
         # with its own dual plans; the concatenated per-shard slot
         # streams form the edge axis (equal shard sizes by construction).
-        from megba_tpu.ops.segtiles import cached_sharded_dual_plans
+        from megba_tpu.ops.segtiles import (
+            cached_sharded_dual_plans,
+            plan_cache_evictions,
+        )
 
         with timer.phase("plan"):
+            evict0 = plan_cache_evictions()
             (perms, masks, cam_segs, plans), plan_hit = (
                 cached_sharded_dual_plans(
                     cam_idx, pt_idx, cameras.shape[0], points.shape[0], ws))
             if plan_hit:
                 timer.count_event("plan_cache_hit")
+            evicted = plan_cache_evictions() - evict0
+            if evicted:
+                timer.count_event("plan_cache_evict", evicted)
             obs = np.concatenate([
                 obs[perms[k]] * masks[k][:, None].astype(dtype)
                 for k in range(ws)])
@@ -232,23 +257,40 @@ def flat_solve(
                     lower_edge_vector(fault_edge, perms[k], masks[k])
                     for k in range(ws)])
             cam_idx, pt_idx = cam_idx_sh, pt_idx_sh
-            mask = masks.reshape(-1).astype(dtype)
+            if em is not None:
+                # Each shard's slot stream permutes the caller's edge
+                # order; the soft-delete mask rides the same perms and
+                # lands multiplicatively on the shard padding mask.
+                mask = np.concatenate([
+                    masks[k].astype(dtype) * em[perms[k]]
+                    for k in range(ws)])
+            else:
+                mask = masks.reshape(-1).astype(dtype)
             n_padded = obs.shape[0]
     elif use_tiled:
         # Tiled lowering: the cam plan's slot order IS the edge axis from
         # here on (it subsumes the camera sort and quantum padding).
-        from megba_tpu.ops.segtiles import cached_dual_plans
+        from megba_tpu.ops.segtiles import (
+            cached_dual_plans,
+            plan_cache_evictions,
+        )
 
         with timer.phase("plan"):
+            evict0 = plan_cache_evictions()
             (plan_c, plans), plan_hit = cached_dual_plans(
                 cam_idx, pt_idx, cameras.shape[0], points.shape[0])
             if plan_hit:
                 timer.count_event("plan_cache_hit")
+            evicted = plan_cache_evictions() - evict0
+            if evicted:
+                timer.count_event("plan_cache_evict", evicted)
             perm, pmask = plan_c.perm, plan_c.mask
             obs = obs[perm] * pmask[:, None].astype(dtype)
             cam_idx = plan_c.seg
             pt_idx = np.where(pmask > 0, pt_idx[perm], 0).astype(np.int32)
             mask = pmask.astype(dtype)
+            if em is not None:
+                mask = mask * em[perm]
             if sqrt_info is not None:
                 sqrt_info = np.asarray(sqrt_info)[perm]
             if fault_edge is not None:
@@ -267,12 +309,20 @@ def flat_solve(
                     sqrt_info = np.asarray(sqrt_info)[perm]
                 if fault_edge is not None:
                     fault_edge = fault_edge[perm]
+                if em is not None:
+                    em = em[perm]
 
             # Pad the edge axis: every shard must be a multiple of
             # EDGE_QUANTUM so chunk slices and shards are static-shape.
             obs, cam_idx, pt_idx, mask = pad_edges(
                 obs, cam_idx, pt_idx, ws * EDGE_QUANTUM, dtype=dtype)
             n_padded = obs.shape[0]
+            if em is not None:
+                # 1*em on the real region, 0 on the pad region — for an
+                # already-quantum-sized input this IS the caller's mask
+                # bit-for-bit (1.0 * {0.0, 1.0} is exact).
+                mask = mask * np.concatenate(
+                    [em, np.zeros(n_padded - em.shape[0], dtype)])
             if fault_edge is not None:
                 from megba_tpu.robustness.faults import lower_edge_vector
 

@@ -51,11 +51,16 @@ echo "observability smoke OK"
 # MEGBA_BENCH_FORCING=1 head-to-head — adaptive forcing + warm starts
 # must cut total PCG iterations >= 30% at an unchanged final cost
 # (the curve-parity gap_tol regime, utils/curves), and the comparison
-# rides the bench JSON line.
+# rides the bench JSON line.  MEGBA_BENCH_FLEET=16 rides the SAME bench
+# run: 16 heterogeneous synthetic problems (io/synthetic.make_fleet)
+# solved as a serial flat_solve loop vs one batched solve_many pass
+# (serving layer) — steady-state batched problems/sec must strictly
+# beat the serial loop and every lane must report a terminal
+# SolveStatus.
 FORCING_OUT=$(mktemp /tmp/megba_forcing_smoke.XXXXXX.json)
 trap 'rm -f "$SMOKE" "$FORCING_OUT"' EXIT
 JAX_PLATFORMS=cpu MEGBA_BENCH_CONFIG=venice MEGBA_BENCH_SCALE=0.1 \
-MEGBA_BENCH_CONVERGENCE=0 MEGBA_BENCH_FORCING=1 \
+MEGBA_BENCH_CONVERGENCE=0 MEGBA_BENCH_FORCING=1 MEGBA_BENCH_FLEET=16 \
   python bench.py > "$FORCING_OUT"
 python - "$FORCING_OUT" <<'PY'
 import json
@@ -70,8 +75,27 @@ assert fc["pcg_reduction"] >= 0.30, (
 assert fc["cost_rel_gap"] <= 1e-2, (
     f"forcing moved the final cost by {fc['cost_rel_gap']:.2e} "
     "(> 1e-2 curve gap_tol)")
+
+fl = json.loads(line)["extra"]["fleet"]
+print("fleet smoke:", json.dumps(fl))
+TERMINAL = {"converged", "max_iter", "stalled", "recovered",
+            "fatal_nonfinite"}
+assert fl["problems"] >= 16, fl
+assert set(fl["statuses"]) <= TERMINAL and fl["statuses"], (
+    f"non-terminal per-lane status in {fl['statuses']}")
+# Sanity band, not a parity proof: this lane runs f32/x64-off, where
+# camera/point bucket padding reorders compensated sums and the
+# un-converged trajectories drift ~1e-2 relative.  The strict contract
+# (bitwise padding, rtol 1e-6 vs flat_solve) is pinned under x64 by
+# tests/test_serving.py.
+assert fl["max_cost_rel_gap"] <= 5e-2, (
+    f"batched final costs drifted {fl['max_cost_rel_gap']:.2e} from the "
+    "serial loop (> 5e-2 f32 sanity band)")
+assert fl["problems_per_sec_batched"] > fl["problems_per_sec_serial"], (
+    f"batched {fl['problems_per_sec_batched']} problems/s did not beat "
+    f"the serial loop at {fl['problems_per_sec_serial']} problems/s")
 PY
-echo "inexact-LM smoke OK"
+echo "inexact-LM + fleet smoke OK"
 
 # Fault-injection smoke: venice-10% with a NaN burst seeded at GLOBAL
 # LM iteration 3 — i.e. at the checkpointed driver's chunk-resume
