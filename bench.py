@@ -341,19 +341,19 @@ def main() -> None:
         jnp.asarray(mask),
     )
 
-    def timed_solve(opt, label):
+    def timed_solve(opt, label, cluster_plan=None):
         solve = jax.jit(
-            lambda cams, pts, obs, ci, pi, m, pl: lm_solve(
+            lambda cams, pts, obs, ci, pi, m, pl, cp: lm_solve(
                 f, cams, pts, obs, ci, pi, m, opt, cam_sorted=cam_sorted,
-                plans=pl)
+                plans=pl, cluster_plan=cp)
         )
         # Warmup (compile) — not part of the metric, but recorded as a
         # phase so the compile cost is visible in the artifact.
         with timer.phase(f"compile_{label}") as ph:
-            ph.sync(solve(*args, plans).cost)
+            ph.sync(solve(*args, plans, cluster_plan).cost)
         t0 = time.perf_counter()
         with timer.phase(f"solve_{label}") as ph:
-            res = ph.sync(solve(*args, plans))
+            res = ph.sync(solve(*args, plans, cluster_plan))
         return res, time.perf_counter() - t0
 
     res, elapsed = timed_solve(option, "throughput")
@@ -374,12 +374,22 @@ def main() -> None:
         conv_option = _dc.replace(option, solver_option=SolverOption())
         conv_res, conv_elapsed = timed_solve(conv_option, "convergence")
         conv_iters = int(conv_res.iterations)
+        conv_pcg = int(conv_res.pcg_iterations)
         conv = {
             "lm_iters_per_sec": round(conv_iters / conv_elapsed, 3),
             "lm_iters": conv_iters,
             "accepted": int(conv_res.accepted),
             "pcg_iters_per_lm": round(
                 float(conv_res.pcg_iterations) / max(conv_iters, 1), 2),
+            # Plateau-metric context (ISSUE 7): WHICH preconditioner
+            # operator produced this pcg_iters_per_lm, and what one
+            # inner iteration costs wall-clock (each fused PCG
+            # iteration performs exactly one precond apply + one S·p,
+            # so this is the per-apply cost ceiling) — tracked in the
+            # artifact instead of only in round prose.
+            "precond": conv_option.solver_option.precond.name.lower(),
+            "pcg_iter_ms": round(
+                1000.0 * conv_elapsed / max(conv_pcg, 1), 3),
             "cost_reduction": round(
                 float(conv_res.initial_cost)
                 / max(float(conv_res.cost), 1e-30), 3),
@@ -418,6 +428,69 @@ def main() -> None:
                 / max(abs(base_cost), 1e-30), 6),
             "elapsed_s": round(f_elapsed, 3),
             "speedup_vs_fixed_tol": round(elapsed / f_elapsed, 3),
+        }
+    # Preconditioner head-to-head (MEGBA_BENCH_PRECOND=<kind>): the
+    # SAME inexact-LM production config (forcing + warm starts — the
+    # regime PR 4 made the default optimum) solved twice, differing
+    # ONLY in the preconditioner operator family, so the comparison
+    # attributes iterations and wall-clock to the operator and nothing
+    # else.  This is the ISSUE 7 plateau observable: total PCG
+    # iterations, relative final-cost gap, wall-clock ratio, and the
+    # per-inner-iteration cost delta (= what one stronger apply costs).
+    # MEGBA_BENCH_CLUSTERS / MEGBA_BENCH_NEUMANN_ORDER tune the knobs.
+    precond_cmp = None
+    precond_kind_env = os.environ.get("MEGBA_BENCH_PRECOND", "")
+    if precond_kind_env:
+        import dataclasses as _dcp
+
+        from megba_tpu.common import PrecondKind
+
+        cand_kind = PrecondKind[precond_kind_env.upper()]
+        n_clusters = int(os.environ.get("MEGBA_BENCH_CLUSTERS", "0") or "0")
+        n_order = int(os.environ.get("MEGBA_BENCH_NEUMANN_ORDER", "1"))
+        base_opt = _dcp.replace(option, solver_option=SolverOption(
+            max_iter=100, refuse_ratio=1e30, forcing=True, warm_start=True))
+        cand_opt = _dcp.replace(option, solver_option=SolverOption(
+            max_iter=100, refuse_ratio=1e30, forcing=True, warm_start=True,
+            precond=cand_kind, neumann_order=n_order,
+            coarse_clusters=n_clusters))
+        cand_cluster_plan = None
+        if cand_kind == PrecondKind.TWO_LEVEL:
+            from megba_tpu.ops.segtiles import cached_cluster_plan
+
+            with timer.phase("plan"):
+                (_, cand_cluster_plan), _hit = cached_cluster_plan(
+                    np.asarray(cam_idx_p), np.asarray(pt_idx_p),
+                    NUM_CAMERAS, NUM_POINTS, n_clusters,
+                    mask=np.asarray(mask))
+        p_base, p_base_s = timed_solve(base_opt, "precond_base")
+        p_cand, p_cand_s = timed_solve(cand_opt, "precond_cand",
+                                       cluster_plan=cand_cluster_plan)
+        b_pcg, c_pcg = int(p_base.pcg_iterations), int(p_cand.pcg_iterations)
+        b_cost = float(p_base.cost)
+        b_iter_ms = 1000.0 * p_base_s / max(b_pcg, 1)
+        c_iter_ms = 1000.0 * p_cand_s / max(c_pcg, 1)
+        precond_cmp = {
+            "kind": cand_kind.name.lower(),
+            "baseline_kind": "jacobi",
+            "coarse_clusters": n_clusters,
+            "neumann_order": n_order,
+            "pcg_iters_total": c_pcg,
+            "pcg_iters_total_jacobi": b_pcg,
+            "pcg_reduction": round(1.0 - c_pcg / max(b_pcg, 1), 4),
+            "cost": float(p_cand.cost),
+            "cost_jacobi": b_cost,
+            "cost_rel_gap": round(
+                abs(float(p_cand.cost) - b_cost) / max(abs(b_cost), 1e-30),
+                6),
+            "elapsed_s": round(p_cand_s, 3),
+            "elapsed_s_jacobi": round(p_base_s, 3),
+            "speedup_vs_jacobi": round(p_base_s / p_cand_s, 3),
+            # Per-inner-iteration wall cost (one precond apply + one
+            # S·p each): the delta is what the stronger apply costs.
+            "pcg_iter_ms": round(c_iter_ms, 3),
+            "pcg_iter_ms_jacobi": round(b_iter_ms, 3),
+            "precond_apply_extra_ms": round(c_iter_ms - b_iter_ms, 3),
         }
     # Fleet head-to-head (MEGBA_BENCH_FLEET=<n>): n heterogeneous small
     # problems (io/synthetic.make_fleet) solved as a serial flat_solve
@@ -531,6 +604,11 @@ def main() -> None:
                     # Inexact-LM head-to-head (MEGBA_BENCH_FORCING=1):
                     # forcing+warm_start vs the fixed tight-tol regime.
                     "forcing": forcing_cmp,
+                    # Preconditioner head-to-head
+                    # (MEGBA_BENCH_PRECOND=<kind>): the candidate
+                    # operator vs block-Jacobi under the same
+                    # inexact-LM config.
+                    "precond": precond_cmp,
                     # Fleet head-to-head (MEGBA_BENCH_FLEET=<n>):
                     # batched solve_many vs serial flat_solve loop.
                     "fleet": fleet_cmp,

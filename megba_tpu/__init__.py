@@ -22,6 +22,7 @@ from megba_tpu.common import (
     Device,
     JacobianMode,
     LinearSystemKind,
+    PrecondKind,
     PreconditionerKind,
     ProblemOption,
     RobustOption,
@@ -87,6 +88,7 @@ __all__ = [
     "LinearSystemKind",
     "PointVertex",
     "PoseVertex",
+    "PrecondKind",
     "PreconditionerKind",
     "ProblemOption",
     "RobustKind",

@@ -26,7 +26,13 @@ import jax
 import jax.numpy as jnp
 
 from megba_tpu.analysis.retrace import note_trace, static_key
-from megba_tpu.common import ComputeKind, ProblemOption, SolveStatus
+from megba_tpu.common import (
+    ComputeKind,
+    PrecondKind,
+    PreconditionerKind,
+    ProblemOption,
+    SolveStatus,
+)
 from megba_tpu.linear_system.builder import (
     SchurSystem,
     build_schur_system,
@@ -158,6 +164,7 @@ def lm_solve(
     verbose_token=None,
     initial_dx=None,
     fault_plan=None,
+    cluster_plan=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -193,6 +200,14 @@ def lm_solve(
     `serving/compile_pool._build_batched_solve` is the production
     consumer; verbose emission is the one vmap-hostile feature (host
     callback), so batched programs run `verbose=False`.
+
+    `cluster_plan` (ops/segtiles.DeviceClusterPlan) is the host-planned
+    camera-cluster coarse space consumed by the TWO_LEVEL
+    preconditioner (solver/precond.py); its per-edge `pc_slot` stream
+    is in this call's edge order (shard-local when `axis_name` names a
+    mesh axis), everything else replicated.  Required when
+    `SolverOption.precond == PrecondKind.TWO_LEVEL`, ignored otherwise
+    — the flat_solve lowering threads it automatically.
 
     `fault_plan` (robustness.faults.FaultPlan, edge_nan already in this
     call's edge order) injects deterministic faults at the residual /
@@ -329,6 +344,13 @@ def lm_solve(
     def cond(s):
         return (s["k"] < algo_opt.max_iter) & (~s["stop"])
 
+    if (option.use_schur and cluster_plan is None
+            and solver_opt.precond == PrecondKind.TWO_LEVEL):
+        raise ValueError(
+            "SolverOption.precond=TWO_LEVEL needs a camera-cluster plan "
+            "operand: solve through flat_solve (which plans + caches it) "
+            "or pass cluster_plan=ops.segtiles.device_cluster_plan(...)")
+
     pcg_solve = schur_pcg_solve if option.use_schur else plain_pcg_solve
 
     def body(s):
@@ -351,7 +373,10 @@ def lm_solve(
                 preconditioner=solver_opt.preconditioner, plans=plans,
                 x0=s["dx0"] if warm_start else None,
                 guard=guards,
-                max_restarts=robust_opt.pcg_max_restarts if guards else 0)
+                max_restarts=robust_opt.pcg_max_restarts if guards else 0,
+                precond=solver_opt.precond,
+                neumann_order=solver_opt.neumann_order,
+                cluster_plan=cluster_plan, cam_fixed=cam_fixed)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply
@@ -508,12 +533,15 @@ def lm_solve(
             fatal = fail_streak > robust_opt.max_recoveries
             stop = stop | fatal
         # Robustness trace fields stay None (zero-fill, zero update ops)
-        # with guards off; the precond-fallback count is recorded
-        # whenever the SCHUR_DIAG preconditioner is live.
+        # with guards off; the (enum-coded per-level) precond-fallback
+        # count is recorded whenever a preconditioner with a fallback
+        # ladder is live — the SCHUR_DIAG block diagonal or any
+        # non-JACOBI operator family (solver/precond.py).
         trace_robust = dict(
             precond_fallback=(
                 pcg.precond_fallback
-                if solver_opt.preconditioner.name == "SCHUR_DIAG" else None))
+                if (solver_opt.preconditioner == PreconditionerKind.SCHUR_DIAG
+                    or solver_opt.precond != PrecondKind.JACOBI) else None))
         if guards:
             trace_robust.update(recovery=recover,
                                 pcg_breakdown=pcg.breakdowns)

@@ -238,7 +238,7 @@ def _ba_option():
 
 
 def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
-              guarded: bool = False):
+              guarded: bool = False, twolevel: bool = False):
     import dataclasses as _dc
 
     from megba_tpu.common import JacobianMode, RobustOption, SolverOption
@@ -258,6 +258,15 @@ def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
         # Fault-containment canonical program: LM rollback/recovery +
         # PCG breakdown restarts armed (robustness layer).
         option = _dc.replace(option, robust_option=RobustOption(guards=True))
+    if twolevel:
+        # Two-level preconditioner canonical program: the camera-graph
+        # coarse space rides as a DeviceClusterPlan operand (flat_solve
+        # plans + caches it) and the cycle runs inside the fused PCG
+        # body (solver/precond.py).
+        from megba_tpu.common import PrecondKind
+
+        option = _dc.replace(option, solver_option=_dc.replace(
+            option.solver_option, precond=PrecondKind.TWO_LEVEL))
     f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
                       option, use_tiled=use_tiled, lower_only=True)
@@ -358,6 +367,20 @@ def program_specs() -> Dict[str, ProgramSpec]:
             donate_leaves=_sharded_donation(),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     guarded=True)),
+        "ba_twolevel_w2_f32": ProgramSpec(
+            name="ba_twolevel_w2_f32", float_family="f32", world=2,
+            # Two-level Schur preconditioner: the coarse-space build
+            # psums V and G ONCE per PCG solve (outside the while
+            # body), and the per-apply cycle is replicated dense work
+            # on materialised G/A_c — so the while-BODY census stays
+            # exactly two all-reduces per S·p, identical to plain
+            # block-Jacobi.  A coarse correction that added an in-body
+            # collective (e.g. a naive matrix-free R S Rᵀ apply) is
+            # precisely the regression this spec pins against.
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            build=lambda: _lower_ba(world=2, use_tiled=False,
+                                    twolevel=True)),
         "ba_batched_b4_f32": ProgramSpec(
             name="ba_batched_b4_f32", float_family="f32", world=1,
             # The batched program is a vmap over a LANE axis on one

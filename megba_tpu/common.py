@@ -77,6 +77,46 @@ class JacobianMode(enum.Enum):
     AUTODIFF_FORWARD = 2
 
 
+class PrecondKind(enum.Enum):
+    """Preconditioner OPERATOR family for the Schur PCG (solver/precond.py).
+
+    Orthogonal to `PreconditionerKind` (which picks the block DIAGONAL —
+    Hpp or the true Schur diagonal — used as the base/smoothing
+    operator by every family):
+
+    JACOBI = the base block-Jacobi apply alone — the extracted baseline,
+    bitwise identical to the pre-subsystem solver.
+    NEUMANN = truncated Neumann/power-series expansion of S applied
+    matrix-free: M⁻¹ = Σ_{i<=k} (I − D⁻¹S)^i D⁻¹ with k =
+    `SolverOption.neumann_order`.  Each apply costs k extra S·p
+    products INSIDE the PCG body (2k extra all-reduces per iteration
+    when sharded) — it trades communication for iterations, so wins
+    must be pinned in wall-clock, not iteration counts alone.
+    TWO_LEVEL = camera-graph two-level scheme: a greedy co-observation-
+    weighted aggregation of cameras into O(√Nc) clusters (host plan,
+    cached — ops/segtiles.py), the EXACT Galerkin coarse operator
+    A_c = R·S_damped·Rᵀ and the coarse coupling G = S_damped·Rᵀ both
+    assembled once per PCG solve from the materialised camera blocks +
+    per-point aggregated coupling (no black-box S applications), a
+    small replicated spectrally-filtered eigendecomposition of A_c
+    (solver/dense.dense_filtered_factor), and the block-Jacobi base as
+    smoother, combined MULTIPLICATIVELY (symmetrized V(0,1)-ish
+    cycle): M⁻¹ = Rᵀ A_c⁺ R + Pᵀ D⁻¹ P with P = I − G A_c⁺ R.  Because
+    G is materialised, the per-apply cycle is two tiny dense solves +
+    two [cd·Nc, C·cd] contractions + one block smooth — ZERO
+    collectives inside the PCG while body (pinned by the
+    `ba_twolevel_w2_f32` canonical audit program).  Fallback ladder on
+    a non-finite coarse spectrum: two-level → block-Jacobi (the cycle
+    becomes exactly the base apply) → Hpp (per-block, SCHUR_DIAG
+    only), each level COUNTED in `PCGResult.precond_fallback`
+    (enum-coded per level — solver/precond.py encode/decode).
+    """
+
+    JACOBI = 0
+    NEUMANN = 1
+    TWO_LEVEL = 2
+
+
 class PreconditionerKind(enum.Enum):
     """Block-Jacobi preconditioner for the Schur PCG.
 
@@ -187,6 +227,15 @@ class SolverOption:
     forcing: bool = False
     eta_min: float = 1e-6
     warm_start: bool = False
+    # Preconditioner operator family (solver/precond.py): JACOBI is the
+    # extracted baseline (bitwise-identical programs); NEUMANN /
+    # TWO_LEVEL are the stronger operators targeting the PCG-iteration
+    # plateau.  `neumann_order` is the (static) series order k;
+    # `coarse_clusters` the two-level coarse-space size target
+    # (0 = auto, ~ceil(sqrt(num_cameras))).  BA/Schur path only.
+    precond: PrecondKind = PrecondKind.JACOBI
+    neumann_order: int = 2
+    coarse_clusters: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -291,6 +340,21 @@ def validate_options(option: ProblemOption) -> None:
             "forcing=True clamps eta_k to [eta_min, tol]; need "
             f"eta_min <= tol, got eta_min={option.solver_option.eta_min} "
             f"> tol={option.solver_option.tol}")
+    if (option.solver_option.precond == PrecondKind.NEUMANN
+            and option.solver_option.neumann_order < 1):
+        raise ValueError(
+            f"neumann_order must be >= 1, got "
+            f"{option.solver_option.neumann_order}")
+    if option.solver_option.coarse_clusters < 0:
+        raise ValueError(
+            f"coarse_clusters must be >= 0 (0 = auto sqrt(Nc)), got "
+            f"{option.solver_option.coarse_clusters}")
+    if (not option.use_schur
+            and option.solver_option.precond != PrecondKind.JACOBI):
+        raise ValueError(
+            "precond=NEUMANN/TWO_LEVEL is only implemented for the Schur "
+            "solver (use_schur=True); the plain full-system solver's "
+            "exact block diagonal IS its preconditioner")
     if option.robust_option.max_recoveries < 1:
         raise ValueError(
             f"max_recoveries must be >= 1, got "

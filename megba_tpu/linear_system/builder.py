@@ -262,6 +262,77 @@ def build_schur_system(
     return SchurSystem(Hpp=Hpp, Hll=Hll, g_cam=g_cam, g_pt=g_pt, W=W)
 
 
+def coupling_row_provider(
+    W: Optional[jax.Array],
+    Jc: Optional[jax.Array],
+    Jp: Optional[jax.Array],
+    od: int,
+    compute_kind: ComputeKind,
+    dtype,
+    plans: Optional[DualPlans] = None,
+):
+    """Chunk accessor for the per-edge coupling block rows W_e = Jc_eᵀJp_e.
+
+    Returns `rows(start, size) -> [cd*pd, size]` in the CAM edge order
+    and the solve dtype, reading the materialised `W` rows in EXPLICIT
+    mode and recomputing from the stored Jacobians in IMPLICIT mode
+    (upcast from bf16 under mixed precision) — the ONE definition of
+    "give me this edge chunk's coupling blocks" shared by the
+    Schur-diagonal preconditioner build and the two-level coarse
+    operator assembly (solver/precond.py), so the two consumers can
+    never disagree about layout or precision.  Under `plans`, `Jp` is
+    carried PT-ordered (algo/lm.py) and is brought to cam order once
+    here.
+    """
+    if compute_kind == ComputeKind.EXPLICIT:
+        def rows(start, size):
+            return slice_fm(W, start, size).astype(dtype)
+
+        return rows
+    if plans is not None and Jp is not None:
+        Jp = plans.to_cam(Jp)
+
+    def rows(start, size):
+        jc = slice_fm(Jc, start, size).astype(dtype)
+        jp = slice_fm(Jp, start, size).astype(dtype)
+        return coupling_rows(jc, jp, od)
+
+    return rows
+
+
+def coupling_row_gather(
+    W: Optional[jax.Array],
+    Jc: Optional[jax.Array],
+    Jp: Optional[jax.Array],
+    od: int,
+    compute_kind: ComputeKind,
+    dtype,
+    plans: Optional[DualPlans] = None,
+):
+    """`coupling_row_provider`'s random-access sibling: returns
+    `rows_at(idx) -> [cd*pd, len(idx)]` gathering the coupling block
+    rows at arbitrary (quasi-sorted) edge indices instead of contiguous
+    chunks — the access pattern of the two-level coarse build's
+    ec-pair stream, where each edge appears once per cluster of its
+    point (solver/precond.py)."""
+    from megba_tpu.core.fm import gather_fm
+
+    if compute_kind == ComputeKind.EXPLICIT:
+        def rows_at(idx):
+            return gather_fm(W, idx).astype(dtype)
+
+        return rows_at
+    if plans is not None and Jp is not None:
+        Jp = plans.to_cam(Jp)
+
+    def rows_at(idx):
+        jc = gather_fm(Jc, idx).astype(dtype)
+        jp = gather_fm(Jp, idx).astype(dtype)
+        return coupling_rows(jc, jp, od)
+
+    return rows_at
+
+
 def damp_blocks(H: jax.Array, region: jax.Array) -> jax.Array:
     """LM damping on batched [N, d, d] blocks: diagonal scales by
     (1 + 1/region).

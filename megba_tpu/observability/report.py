@@ -111,6 +111,21 @@ class SolveReport:
         return cls(**{k: v for k, v in d.items() if k in known})
 
 
+def _decode_fallback_totals(trace, iterations: int) -> Optional[Dict[str, int]]:
+    """Sum the enum-coded per-iteration precond_fallback codes into
+    per-level totals ({'block': n, 'coarse': n}); None without a trace."""
+    if trace is None or getattr(trace, "precond_fallback", None) is None:
+        return None
+    from megba_tpu.solver.precond import decode_precond_fallback
+
+    block = coarse = 0
+    for code in np.asarray(trace.precond_fallback)[:iterations]:
+        level = decode_precond_fallback(int(code))
+        block += level["block"]
+        coarse += level["coarse"]
+    return {"block": int(block), "coarse": int(coarse)}
+
+
 def build_report(option, result, phases: Dict[str, Any],
                  problem: Dict[str, Any],
                  audit: Optional[Dict[str, Any]] = None,
@@ -153,6 +168,12 @@ def build_report(option, result, phases: Dict[str, Any],
             "recoveries": (
                 None if getattr(result, "recoveries", None) is None
                 else int(result.recoveries)),
+            # Per-LEVEL preconditioner fallback totals decoded from the
+            # trace's enum-coded per-iteration counts (solver/precond):
+            # "block" = SCHUR_DIAG camera blocks fallen back to Hpp,
+            # "coarse" = two-level coarse factors degraded to
+            # block-Jacobi.  None without a trace.
+            "precond_fallback": _decode_fallback_totals(trace, iterations),
         },
         trace=None if trace is None else trace_to_dict(trace, iterations),
         memory=device_memory_stats(),

@@ -1114,6 +1114,301 @@ def cached_sharded_dual_plans(
     return value, False
 
 
+# ---------------------------------------------------------------------------
+# Camera-graph cluster plan (two-level Schur preconditioner coarse space)
+# ---------------------------------------------------------------------------
+#
+# The two-level preconditioner (solver/precond.py) needs three pieces of
+# pure GRAPH structure, all host-computable at plan time and cacheable
+# behind the same content-fingerprint LRU as the tile plans:
+#
+#   1. an aggregation of cameras into O(sqrt(Nc)) clusters — greedy,
+#      co-observation-weighted (cameras that share many points merge
+#      first), so a point's edges concentrate in few clusters;
+#   2. the distinct (point, cluster) incidences ("pc-slots"): the
+#      coarse-projected coupling R·Hpl has one [cd, pd] block per
+#      incidence (V_{p,I} = Σ_{e: pt(e)=p, cluster(cam(e))=I} W_e), and
+#      the device build scatter-adds per-edge W rows into them via the
+#      per-edge `pc_slot` stream;
+#   3. the (edge, pc-slot-of-same-point) incidence pairs ("ec-pairs"):
+#      the columns of G = S_d·Rᵀ pick up one W_e·Hll⁻¹·V_sᵀ block per
+#      pair — enumerated once here (Σ_e k_{pt(e)} entries, k_p =
+#      clusters seeing point p, small under co-observation clustering)
+#      so the device side is a plain gather → block product → segment
+#      scatter into the [cd·cd, Nc·C] coarse-coupling table.  G is what
+#      makes the MULTIPLICATIVE two-level cycle collective-free inside
+#      the PCG body: the cycle's S applications only ever hit vectors
+#      in range(Rᵀ), which G materialises once per build.
+#
+# Everything downstream is selects/gathers/scatter-adds over these
+# static index arrays.  Sharding story: `pc_slot` and the ec arrays
+# follow the edge shards; V and G are each psum-combined once per build
+# (OUTSIDE the PCG body — the all-reduce kind the solver already
+# emits), and everything after is identical tiny replicated work per
+# shard.  The per-apply cycle adds no collectives at all.
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterPlan:
+    """Host half of the camera-cluster coarse-space plan.
+
+    The ec arrays are laid out in `world_size` equal-length contiguous
+    shard groups (each padded to the common max with inert entries —
+    local edge 0, slot 0, out-of-range segment), and `ec_edge` holds
+    SHARD-LOCAL edge indices, so a `P(EDGE_AXIS)` split hands every
+    shard exactly the pairs of its own edges.
+    """
+
+    num_cameras: int
+    num_clusters: int  # actual cluster count C (>= the target)
+    n_pc: int  # distinct (point, cluster) incidences
+    n_ec: int  # real (unpadded) edge-incidence pairs
+    world_size: int
+    cluster: np.ndarray  # [Nc] int32 cluster id per camera
+    pc_slot: np.ndarray  # [nE] int32 incidence per edge (n_pc = inert)
+    pc_pt: np.ndarray  # [n_pc] int32 point of each incidence
+    ec_edge: np.ndarray  # [ws*L] int32 shard-LOCAL edge per pair
+    ec_slot: np.ndarray  # [ws*L] int32 pc-slot per pair
+    ec_seg: np.ndarray  # [ws*L] int32 cam*C+cluster (Nc*C on padding)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceClusterPlan:
+    """Device half: static ints + index arrays, registered as a pytree
+    so it rides jit/shard_map operands like DualPlans does."""
+
+    num_clusters: int
+    n_pc: int
+    cluster: jax.Array  # [Nc] int32
+    pc_slot: jax.Array  # [nE] int32 (edge axis; shard-local when sharded)
+    pc_pt: jax.Array  # [n_pc] int32
+    ec_edge: jax.Array  # [ws*L] int32 (edge-sharded; local edge ids)
+    ec_slot: jax.Array  # [ws*L] int32 (edge-sharded)
+    ec_seg: jax.Array  # [ws*L] int32 (edge-sharded)
+
+
+jax.tree_util.register_dataclass(
+    DeviceClusterPlan,
+    data_fields=["cluster", "pc_slot", "pc_pt", "ec_edge", "ec_slot",
+                 "ec_seg"],
+    meta_fields=["num_clusters", "n_pc"],
+)
+
+
+def device_cluster_plan(plan: ClusterPlan) -> DeviceClusterPlan:
+    return DeviceClusterPlan(
+        num_clusters=plan.num_clusters,
+        n_pc=plan.n_pc,
+        cluster=jnp.asarray(plan.cluster),
+        pc_slot=jnp.asarray(plan.pc_slot),
+        pc_pt=jnp.asarray(plan.pc_pt),
+        ec_edge=jnp.asarray(plan.ec_edge),
+        ec_slot=jnp.asarray(plan.ec_slot),
+        ec_seg=jnp.asarray(plan.ec_seg),
+    )
+
+
+def cluster_partition_specs(cplan: DeviceClusterPlan):
+    """shard_map in_specs tree for a DeviceClusterPlan operand: the
+    per-edge `pc_slot` stream and the per-pair ec arrays follow the
+    edge shards (the plan builder laid the pairs out in equal-length
+    shard groups with shard-local edge ids); the cluster table and
+    incidence tables ride replicated (the coarse assembly after the V/G
+    psums is identical tiny work per shard)."""
+    from jax.sharding import PartitionSpec as P
+
+    from megba_tpu.parallel.mesh import EDGE_AXIS
+
+    return DeviceClusterPlan(
+        num_clusters=cplan.num_clusters, n_pc=cplan.n_pc,
+        cluster=P(), pc_slot=P(EDGE_AXIS), pc_pt=P(),
+        ec_edge=P(EDGE_AXIS), ec_slot=P(EDGE_AXIS), ec_seg=P(EDGE_AXIS))
+
+
+def build_camera_clusters(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    target: int = 0,
+    mask: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Greedy co-observation-weighted aggregation into ~target clusters.
+
+    target = 0 selects the two-level default, ceil(sqrt(Nc)).  Camera
+    pairs are weighted by how many points they co-observe (counted over
+    consecutive cameras in each point's sorted camera list — Σ(deg_p−1)
+    pairs total, so the host cost stays O(nE log nE) at any scale) and
+    merged heaviest-first under a size cap of ceil(Nc / target) via
+    union-find.  Returns [Nc] int32 cluster ids in [0, C); C >= target
+    whenever the cap binds, and every camera (including edge-less ones)
+    gets a cluster.
+    """
+    cam_idx = np.asarray(cam_idx, np.int64)
+    pt_idx = np.asarray(pt_idx, np.int64)
+    if mask is not None:
+        keep = np.asarray(mask) > 0
+        cam_idx, pt_idx = cam_idx[keep], pt_idx[keep]
+    if target <= 0:
+        target = max(1, int(np.ceil(np.sqrt(num_cameras))))
+    target = min(target, num_cameras)
+    cap = max(1, -(-num_cameras // target))
+
+    parent = np.arange(num_cameras, dtype=np.int64)
+    size = np.ones(num_cameras, np.int64)
+
+    def find(i):
+        root = i
+        while parent[root] != root:
+            root = parent[root]
+        while parent[i] != root:  # path compression
+            parent[i], i = root, parent[i]
+        return root
+
+    if cam_idx.size and cap > 1:
+        order = np.argsort(pt_idx, kind="stable")
+        ps, cs = pt_idx[order], cam_idx[order]
+        adj = ps[1:] == ps[:-1]
+        a, b = cs[:-1][adj], cs[1:][adj]
+        neq = a != b
+        a, b = a[neq], b[neq]
+        lo, hi = np.minimum(a, b), np.maximum(a, b)
+        pairs, counts = np.unique(lo * num_cameras + hi, return_counts=True)
+        for key in pairs[np.argsort(-counts, kind="stable")]:
+            ra, rb = find(key // num_cameras), find(key % num_cameras)
+            if ra != rb and size[ra] + size[rb] <= cap:
+                parent[rb] = ra
+                size[ra] += size[rb]
+
+    roots = np.asarray([find(i) for i in range(num_cameras)])
+    _, cluster = np.unique(roots, return_inverse=True)
+    return cluster.astype(np.int32)
+
+
+def build_cluster_plan(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    target: int = 0,
+    mask: Optional[np.ndarray] = None,
+    world_size: int = 1,
+) -> ClusterPlan:
+    """Plan the two-level coarse space over one (possibly padded) edge
+    stream.  `cam_idx`/`pt_idx` are in the SOLVER's final edge order
+    (post-sort/-plan, padding included, `world_size` equal contiguous
+    shards when sharded); `mask` marks real edges — padding edges get
+    the inert pc-slot n_pc, so the device scatter drops them (their
+    data rows are zero anyway)."""
+    cam_idx = np.asarray(cam_idx, np.int64)
+    pt_idx = np.asarray(pt_idx, np.int64)
+    n_edges = int(cam_idx.shape[0])
+    cluster = build_camera_clusters(
+        cam_idx, pt_idx, num_cameras, target, mask)
+    C = int(cluster.max()) + 1 if num_cameras else 1
+
+    real = (np.ones(n_edges, bool) if mask is None
+            else np.asarray(mask) > 0)
+    key = pt_idx * C + cluster[cam_idx]  # (point, cluster) incidence id
+    uniq, inv = np.unique(key[real], return_inverse=True)
+    n_pc = int(uniq.shape[0])
+    pc_slot = np.full(n_edges, n_pc, np.int32)
+    pc_slot[real] = inv.astype(np.int32)
+    pc_pt = (uniq // C).astype(np.int32)
+    pc_cluster = (uniq % C).astype(np.int32)
+
+    # ec-pairs: for every real edge e, one entry per pc-slot of pt(e)
+    # (the incidences of one point are contiguous in the sorted uniq
+    # keys).  Σ_e k_{pt(e)} entries, k_p = number of distinct clusters
+    # seeing point p — a small multiple of nE under co-observation
+    # clustering.
+    pts, pstarts, pcounts = np.unique(pc_pt, return_index=True,
+                                      return_counts=True)
+    start_of_pt = np.zeros(max(num_points, 1), np.int64)
+    count_of_pt = np.zeros(max(num_points, 1), np.int64)
+    start_of_pt[pts] = pstarts
+    count_of_pt[pts] = pcounts
+    edge_ids = np.nonzero(real)[0]
+    k_of_edge = count_of_pt[pt_idx[edge_ids]]
+    n_ec = int(k_of_edge.sum())
+    ec_edge_g = np.repeat(edge_ids, k_of_edge)
+    off = np.arange(n_ec, dtype=np.int64) - np.repeat(
+        np.cumsum(k_of_edge) - k_of_edge, k_of_edge)
+    ec_slot = (start_of_pt[pt_idx[ec_edge_g]] + off).astype(np.int32)
+    ec_seg = (cam_idx[ec_edge_g] * C
+              + pc_cluster[ec_slot]).astype(np.int32)
+
+    # Shard-group the pairs: each pair belongs to its edge's shard
+    # (equal contiguous edge shards), shard groups are padded to the
+    # common max with inert entries and edge ids are made SHARD-LOCAL,
+    # so a P(EDGE_AXIS) split of the ec arrays is self-consistent.
+    ws = max(1, int(world_size))
+    if n_edges % ws:
+        # The documented precondition, made LOUD: a ragged edge stream
+        # would silently assign the tail edges to a shard the grouping
+        # loop never collects, dropping their coupling terms from G.
+        # flat_solve always pads to ws*EDGE_QUANTUM before planning;
+        # direct callers must do the same.
+        raise ValueError(
+            f"cluster plan needs world_size ({ws}) equal contiguous "
+            f"edge shards, got {n_edges} edges (not divisible); pad "
+            "the edge stream first (core.types.pad_edges)")
+    shard_edges = n_edges // ws
+    shard_of = ec_edge_g // max(shard_edges, 1)
+    groups = []
+    for k in range(ws):
+        sel = shard_of == k
+        groups.append((ec_edge_g[sel] - k * shard_edges,
+                       ec_slot[sel], ec_seg[sel]))
+    L = max(1, max(g[0].shape[0] for g in groups))
+    ee, es, eg = [], [], []
+    for local_e, slot, seg in groups:
+        pad = L - local_e.shape[0]
+        ee.append(np.concatenate(
+            [local_e, np.zeros(pad, np.int64)]).astype(np.int32))
+        es.append(np.concatenate([slot, np.zeros(pad, np.int32)]))
+        # Out-of-range segment: the scatter (mode="drop") ignores it.
+        eg.append(np.concatenate(
+            [seg, np.full(pad, num_cameras * C, np.int32)]))
+    return ClusterPlan(
+        num_cameras=num_cameras, num_clusters=C, n_pc=max(n_pc, 1),
+        n_ec=n_ec, world_size=ws, cluster=cluster, pc_slot=pc_slot,
+        pc_pt=(pc_pt if n_pc else np.zeros(1, np.int32)),
+        ec_edge=np.concatenate(ee), ec_slot=np.concatenate(es),
+        ec_seg=np.concatenate(eg))
+
+
+def cached_cluster_plan(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    target: int = 0,
+    mask: Optional[np.ndarray] = None,
+    world_size: int = 1,
+):
+    """`build_cluster_plan` behind the host plan cache.
+
+    Returns ((ClusterPlan, DeviceClusterPlan), cache_hit) — keyed by a
+    blake2b content fingerprint of the index arrays + mask + target +
+    world_size, exactly like the tile plans, so repeated solves of one
+    problem (bench reruns, chunked drivers, the auditor's canonical
+    lowerings) build the cluster graph once."""
+    key = ("cluster", _array_digest(np.asarray(cam_idx)),
+           _array_digest(np.asarray(pt_idx)),
+           (None if mask is None
+            else _array_digest(np.asarray(mask) > 0)),
+           int(num_cameras), int(num_points), int(target),
+           int(world_size))
+    hit = _plan_cache_get(key)
+    if hit is not None:
+        return hit, True
+    plan = build_cluster_plan(cam_idx, pt_idx, num_cameras, num_points,
+                              target, mask, world_size=world_size)
+    value = (plan, device_cluster_plan(plan))
+    _plan_cache_put(key, value)
+    return value, False
+
+
 @functools.lru_cache(maxsize=1)
 def probe_kernels() -> bool:
     """True iff ALL five Pallas kernels compile AND match on this backend.

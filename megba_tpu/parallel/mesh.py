@@ -127,6 +127,7 @@ def distributed_lm_solve(
     initial_v=None,
     initial_dx=None,
     fault_plan=None,
+    cluster_plan=None,
     jit_cache: Optional[dict] = None,
     donate: bool = False,
     lower_only: bool = False,
@@ -200,6 +201,15 @@ def distributed_lm_solve(
         from megba_tpu.robustness.faults import fault_partition_specs
 
         optional.append(("fault_plan", fault_plan, fault_partition_specs()))
+    if cluster_plan is not None:
+        # Two-level preconditioner coarse-space plan (ops/segtiles.py):
+        # the per-edge pc_slot stream follows the edge shards, the
+        # cluster/incidence/pair tables ride replicated (the coarse
+        # assembly after the V psum is identical tiny work per shard).
+        from megba_tpu.ops.segtiles import cluster_partition_specs
+
+        optional.append(("cluster_plan", cluster_plan,
+                         cluster_partition_specs(cluster_plan)))
     keys = tuple(k for k, v, _ in optional if v is not None)
     args += [v for _, v, _ in optional if v is not None]
     in_specs += [spec for _, v, spec in optional if v is not None]

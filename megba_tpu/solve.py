@@ -22,7 +22,7 @@ import numpy as np
 
 from megba_tpu.algo.lm import LMResult, lm_solve
 from megba_tpu.analysis.retrace import static_key, traced
-from megba_tpu.common import ProblemOption, validate_options
+from megba_tpu.common import PrecondKind, ProblemOption, validate_options
 from megba_tpu.core.fm import EDGE_QUANTUM
 from megba_tpu.core.types import is_cam_sorted, pad_edges
 from megba_tpu.io.bal import BALFile, load_bal
@@ -328,6 +328,26 @@ def flat_solve(
 
                 fault_edge = lower_edge_vector(fault_edge,
                                                n_padded=n_padded)
+    # Two-level preconditioner coarse space: the camera-cluster plan is
+    # pure graph structure over the FINAL (post-sort/-plan, padded) edge
+    # stream, planned on host once and cached behind the same
+    # content-fingerprint LRU as the tile plans; it rides the program as
+    # an ordinary pytree operand (like `plans`), so toggling precond
+    # kinds never bakes indices into the compiled program.
+    cluster_plan_j = None
+    if (option.use_schur
+            and option.solver_option.precond == PrecondKind.TWO_LEVEL):
+        from megba_tpu.ops.segtiles import cached_cluster_plan
+
+        with timer.phase("plan"):
+            (_, cluster_plan_j), cl_hit = cached_cluster_plan(
+                np.asarray(cam_idx), np.asarray(pt_idx),
+                int(cameras.shape[0]), int(points.shape[0]),
+                option.solver_option.coarse_clusters,
+                mask=np.asarray(mask), world_size=ws)
+            if cl_hit:
+                timer.count_event("cluster_plan_cache_hit")
+
     if sqrt_info is not None:
         si = np.asarray(sqrt_info).astype(dtype, copy=False)
         if si.shape[0] != n_padded:
@@ -387,6 +407,7 @@ def flat_solve(
                 verbose=verbose, cam_sorted=True, plans=plans,
                 initial_region=initial_region, initial_v=initial_v,
                 initial_dx=initial_dx_j, fault_plan=fault_j,
+                cluster_plan=cluster_plan_j,
                 jit_cache=jit_cache, donate=True, lower_only=lower_only)
         if lower_only:
             return result
@@ -397,7 +418,7 @@ def flat_solve(
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
                 ("pt_fixed", pt_fixed_j), ("initial_dx", initial_dx_j),
-                ("fault_plan", fault_j)]
+                ("fault_plan", fault_j), ("cluster_plan", cluster_plan_j)]
     keys = tuple(k for k, v in optional if v is not None)
     extras = [v for _, v in optional if v is not None]
     with timer.phase("program"):
@@ -442,12 +463,22 @@ def _maybe_emit_report(telemetry, option, result, timer, problem) -> None:
     if trace is not None:
         # Surface the robustness counters as PhaseTimer events (the
         # report is already paying the device sync): how many contained
-        # recoveries the guards performed and how many preconditioner
-        # blocks fell back to Hpp after a Cholesky NaN.
+        # recoveries the guards performed, and the per-LEVEL
+        # preconditioner fallback counts — the trace carries one
+        # enum-coded int32 per iteration (solver/precond.py
+        # encode/decode: low bits = SCHUR_DIAG blocks fallen back to
+        # Hpp, high bits = two-level coarse factors degraded to
+        # block-Jacobi), decoded so a coarse-level degrade is visible
+        # as its own event, not laundered into a block count.  (The
+        # report module is imported below anyway — telemetry is on.)
+        from megba_tpu.observability.report import _decode_fallback_totals
+
         iters = int(result.iterations)
-        fallbacks = int(np.sum(np.asarray(trace.precond_fallback)[:iters]))
-        if fallbacks:
-            timer.count_event("precond_fallback", fallbacks)
+        level = _decode_fallback_totals(trace, iters) or {}
+        if level.get("block"):
+            timer.count_event("precond_fallback", level["block"])
+        if level.get("coarse"):
+            timer.count_event("precond_fallback_coarse", level["coarse"])
         recov = getattr(result, "recoveries", None)
         if recov is not None and int(recov):
             timer.count_event("fault_recovery", int(recov))

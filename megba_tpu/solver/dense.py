@@ -19,6 +19,44 @@ from megba_tpu.core.fm import coupling_rows, damp_rows_fm
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 
 
+def dense_filtered_factor(
+    A: jax.Array, rel_floor: float
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """Spectrally filtered pseudo-inverse factor of a small symmetric A.
+
+    Eigendecomposes A (replicated, a few hundred dofs — cheap once per
+    build) and keeps only eigenvalues above `rel_floor * lambda_max`:
+    `solve` then applies A⁺ = Q diag(1/lambda_kept, 0) Qᵀ.  The floor
+    serves two masters at once: eigenvalues below ~1e-6·lambda_max are
+    under the f32 assembly noise anyway, and near-null directions
+    (gauge modes under weak LM damping) must NOT be inverted — the
+    two-level preconditioner measurably LOSES to block-Jacobi when the
+    coarse solve amplifies modes the Krylov iteration never needed to
+    resolve (solver/precond.py has the numbers).  A⁺ is symmetric PSD
+    by construction, so the preconditioner built on it stays SPD.
+
+    Returns ((Q, inv_lam), ok): `ok` is False when the spectrum is
+    non-finite or has no positive part (assembly produced garbage —
+    the fallback ladder's coarse level).
+    """
+    lam, Q = jnp.linalg.eigh(A)
+    lam_max = lam[-1]  # eigh returns ascending eigenvalues
+    ok = jnp.all(jnp.isfinite(lam)) & jnp.all(jnp.isfinite(Q)) & (lam_max > 0)
+    inv = jnp.where(lam > rel_floor * lam_max, 1.0 / lam,
+                    jnp.zeros_like(lam))
+    inv = jnp.where(jnp.isfinite(inv), inv, jnp.zeros_like(inv))
+    Q = jnp.where(ok, Q, jnp.zeros_like(Q))
+    return (Q, inv), ok
+
+
+def dense_filtered_solve(
+    factor: Tuple[jax.Array, jax.Array], b: jax.Array
+) -> jax.Array:
+    """Apply the filtered pseudo-inverse of `dense_filtered_factor`."""
+    Q, inv = factor
+    return Q @ (inv * (Q.T @ b))
+
+
 def dense_reference_solve(
     system: SchurSystem,
     Jc: jax.Array,

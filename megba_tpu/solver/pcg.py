@@ -37,20 +37,26 @@ import jax
 import jax.numpy as jnp
 
 from megba_tpu.analysis.retrace import note_trace, static_key
-from megba_tpu.common import ComputeKind, PreconditionerKind
+from megba_tpu.common import ComputeKind, PrecondKind, PreconditionerKind
 from megba_tpu.core.fm import (
     block_inv_fm,
     block_matvec_fm,
-    chunked_edge_reduce,
-    coupling_rows,
     damp_rows_fm,
     gather_fm,
     segsum_fm,
-    slice_fm,
 )
 from megba_tpu.linear_system.builder import SchurSystem, damp_blocks
 from megba_tpu.ops.accum import comp_dot
 from megba_tpu.ops.segtiles import DualPlans, seg_expand, seg_reduce
+# The preconditioner subsystem (solver/precond.py) owns the operator
+# family; block_inv / cam_block_matvec / _schur_diag_precond are
+# re-exported here for the historical import path.
+from megba_tpu.solver.precond import (  # noqa: F401  (re-exports)
+    _schur_diag_precond,
+    block_inv,
+    cam_block_matvec,
+    make_schur_preconditioner,
+)
 
 HI = jax.lax.Precision.HIGHEST
 
@@ -83,25 +89,6 @@ class PCGResult:
         default_factory=lambda: jnp.bool_(False))
     precond_fallback: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.int32(0))
-
-
-def cam_block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
-    """[Nc, d, d] camera blocks times [d, Nc] rows -> [d, Nc] rows."""
-    return jnp.einsum("nij,jn->in", H, x, precision=HI)
-
-
-def block_inv(H: jax.Array) -> jax.Array:
-    """Batched inverse of SPD camera blocks [N, d, d] via Cholesky.
-
-    The analog of the reference's cublasGmatinvBatched calls
-    (schur_pcg_solver.cu:60-97); stable on the damped SPD blocks.
-    Point blocks use the row-form closed-form `core.fm.block_inv_fm`.
-    """
-    d = H.shape[-1]
-    chol = jnp.linalg.cholesky(H)
-    eye = jnp.broadcast_to(jnp.eye(d, dtype=H.dtype), H.shape)
-    inv_l = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
-    return jnp.einsum("nki,nkj->nij", inv_l, inv_l, precision=HI)
 
 
 def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -510,6 +497,10 @@ def plain_pcg_solve(
     x0: Optional[Tuple[jax.Array, jax.Array]] = None,
     guard: bool = False,
     max_restarts: int = 0,
+    precond: PrecondKind = PrecondKind.JACOBI,
+    neumann_order: int = 2,
+    cluster_plan=None,
+    cam_fixed=None,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -519,7 +510,12 @@ def plain_pcg_solve(
 
     `preconditioner` is accepted for signature parity and ignored: the
     full system's exact block diagonal (Hpp, Hll) IS this solver's
-    preconditioner, so both kinds coincide here.
+    preconditioner, so both kinds coincide here.  The same goes for the
+    `precond` operator family and its knobs (`neumann_order`,
+    `cluster_plan`, `cam_fixed`): the stronger Schur operators are
+    BA/Schur-path features (validate_options rejects them with
+    use_schur=False), accepted here only so the LM loop can call both
+    solvers through one signature.
 
     The path the reference left as `// TODO(Jie Ren)` behind
     `useSchur=false` (base_problem.cpp:112-123) — implemented here: PCG
@@ -569,68 +565,6 @@ def plain_pcg_solve(
                      r0_ratio=r0_ratio, breakdowns=restarts, broken=broken)
 
 
-@jax.named_scope("megba.schur_diag_precond")
-def _schur_diag_precond(
-    Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
-    compute_kind, axis_name, cam_sorted, plans=None,
-):
-    """True Schur block diagonal: Hpp_c - sum_e W_e Hll^-1 W_e^T.
-
-    Chunked over edges (like the Hessian build) so the [cd*cd, chunk]
-    correction rows never materialise at full edge scale — the round-1
-    [nE, 9, 9] transient that made this preconditioner unusable at
-    Final scale is gone.
-    """
-    cd = Hpp_d.shape[-1]
-    pd = int(round(Hll_inv.shape[0] ** 0.5))
-    dtype = Hpp_d.dtype
-    nE = cam_idx.shape[0]
-    od = None if Jc is None else Jc.shape[0] // cd
-    if plans is not None and Jp is not None:
-        # The correction is assembled edge-chunked in cam order; under
-        # plans Jp lives pt-ordered, so bring it over once per build.
-        Jp = plans.to_cam(Jp)
-
-    def body(start, size, accs):
-        (corr_a,) = accs
-        ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
-        pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
-        hinv = gather_fm(Hll_inv, pi)  # [pd*pd, size]
-        if compute_kind == ComputeKind.EXPLICIT:
-            w_rows = slice_fm(W, start, size)
-        else:
-            w_rows = coupling_rows(
-                slice_fm(Jc, start, size).astype(dtype),
-                slice_fm(Jp, start, size).astype(dtype), od)
-        w = [w_rows[i].astype(dtype) for i in range(cd * pd)]
-        # t[a, q] = sum_p w[a, p] hinv[p, q]
-        t = [sum(w[a * pd + p] * hinv[p * pd + q] for p in range(pd))
-             for a in range(cd) for q in range(pd)]
-        corr = jnp.stack([
-            sum(t[a * pd + q] * w[b * pd + q] for q in range(pd))
-            for a in range(cd) for b in range(cd)
-        ])
-        return (corr_a.at[:, ci].add(
-            corr, indices_are_sorted=cam_sorted, mode="drop"),)
-
-    (corr_rows,) = chunked_edge_reduce(
-        nE, (jnp.zeros((cd * cd, num_cameras), dtype),), body)
-    if axis_name is not None:
-        corr_rows = jax.lax.psum(corr_rows, axis_name)
-    corr = jnp.moveaxis(corr_rows.reshape(cd, cd, num_cameras), -1, 0)
-    # In exact arithmetic Hpp_d - corr is SPD (a principal block of S),
-    # but rounding (especially equilibrated bf16 operands) can push a
-    # weakly-determined camera block indefinite -> Cholesky NaN.  Fall
-    # back to the Hpp preconditioner for exactly those blocks instead of
-    # letting NaN masquerade as convergence.  The fallback is COUNTED,
-    # not silent: the block count rides PCGResult.precond_fallback into
-    # the SolveTrace so an indefinite drift shows up in telemetry.
-    minv_hpp = block_inv(Hpp_d)
-    minv_sd = block_inv(Hpp_d - corr)
-    bad = ~jnp.all(jnp.isfinite(minv_sd), axis=(-2, -1), keepdims=True)
-    return jnp.where(bad, minv_hpp, minv_sd), jnp.sum(bad).astype(jnp.int32)
-
-
 def schur_pcg_solve(
     system: SchurSystem,
     Jc: jax.Array,
@@ -651,6 +585,10 @@ def schur_pcg_solve(
     x0: Optional[jax.Array] = None,
     guard: bool = False,
     max_restarts: int = 0,
+    precond: PrecondKind = PrecondKind.JACOBI,
+    neumann_order: int = 2,
+    cluster_plan=None,
+    cam_fixed=None,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -665,12 +603,20 @@ def schur_pcg_solve(
     `x0` ([cd, Nc] rows, original variables) warm-starts the reduced CG
     iteration; `tol` may be a traced scalar (the inexact-LM forcing path
     passes eta_k^2 per LM iteration).
+
+    `precond` selects the preconditioner operator family
+    (solver/precond.py): JACOBI (the block diagonal picked by
+    `preconditioner`, bitwise the historical solver), NEUMANN
+    (`neumann_order` extra S applications per apply), or TWO_LEVEL
+    (needs the host-planned `cluster_plan` operand —
+    ops/segtiles.cached_cluster_plan; `cam_fixed` keeps the coarse
+    correction off pinned cameras).
     """
     # Retrace sentinel hook (analysis/retrace.py): counts only under an
     # active jax trace — eager calls are not compilations.
     note_trace("solver.schur_pcg", system.g_cam, system.g_pt, Jc, Jp,
                static=static_key(compute_kind, axis_name, mixed_precision,
-                                 preconditioner))
+                                 preconditioner, precond, neumann_order))
     num_cameras = system.Hpp.shape[0]
     num_points = system.Hll.shape[1]
     pd = int(round(system.Hll.shape[0] ** 0.5))
@@ -726,16 +672,6 @@ def schur_pcg_solve(
             ]).astype(bf)
 
     Hll_inv = block_inv_fm(Hll_d)
-    precond_fallback = jnp.int32(0)
-    if preconditioner == PreconditionerKind.SCHUR_DIAG:
-        # The correction rows are always accumulated in full precision
-        # (any bf16 operands are upcast in the body), so no precision
-        # flag is threaded through.
-        Minv, precond_fallback = _schur_diag_precond(
-            Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
-            compute_kind, axis_name, cam_sorted, plans=plans)
-    else:
-        Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
 
     hpl, hlp = make_coupling_matvecs(
         W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
@@ -748,6 +684,20 @@ def schur_pcg_solve(
         t = block_matvec_fm(Hll_inv, hlp(p))
         return cam_block_matvec(Hpp_d, p) - hpl(t)
 
+    # Preconditioner operator family (solver/precond.py).  The
+    # correction/coarse rows are always accumulated in full precision
+    # (any bf16 operands are upcast inside the builds), so no precision
+    # flag is threaded through.  JACOBI reproduces the historical
+    # solver bitwise; `precond_fallback` is the enum-coded per-level
+    # fallback count (two-level -> block-Jacobi, SCHUR_DIAG block ->
+    # Hpp).
+    precond_apply, precond_fallback = make_schur_preconditioner(
+        precond, preconditioner, Hpp_d, Hll_inv, W, Jc, Jp,
+        cam_idx, pt_idx, num_cameras, compute_kind, axis_name,
+        cam_sorted, neumann_order=neumann_order, plans=plans,
+        cluster_plan=cluster_plan, cam_fixed=cam_fixed,
+        s_matvec=s_matvec)
+
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
     v = g_cam - hpl(block_matvec_fm(Hll_inv, g_pt))
 
@@ -757,7 +707,7 @@ def schur_pcg_solve(
         x0 = x0 / d_cam
 
     x, k, rho, r0_ratio, restarts, broken = _pcg_core(
-        s_matvec, lambda r: cam_block_matvec(Minv, r), v,
+        s_matvec, precond_apply, v,
         max_iter, tol, refuse_ratio, tol_relative, x0=x0,
         guard=guard, max_restarts=max_restarts)
 

@@ -1,0 +1,523 @@
+"""Pluggable Schur-complement preconditioners (the 29.6-iters/LM lever).
+
+Bench history (BENCH_r02-r05) pinned the tol-mode inner solve at ~29.6
+PCG iterations per LM step across four rounds: after the fused
+Chronopoulos-Gear body and Eisenstat-Walker forcing (PR 4) removed the
+outer-loop waste, the BLOCK-JACOBI preconditioner — not the matvec — is
+the measured ceiling.  This module makes the preconditioner a pluggable
+operator family (`SolverOption.precond`, common.PrecondKind) with three
+matrix-free members that all run inside the single fused PCG program:
+
+JACOBI — the extracted baseline: apply the inverted block diagonal
+  (damped Hpp, or the true Schur diagonal under
+  `PreconditionerKind.SCHUR_DIAG`).  Bitwise identical to the
+  pre-subsystem solver.
+
+NEUMANN — truncated Neumann/power-series expansion of S⁻¹ around the
+  block diagonal D:  M⁻¹ = Σ_{i=0..k} (I − D⁻¹S)^i D⁻¹, applied by
+  Horner recursion (z ← z + D⁻¹(r − S z), k times).  Symmetric by
+  construction (each term E^i D⁻¹ is — D and S are), positive definite
+  whenever the D-preconditioned spectrum stays in (0, 2) (block-Jacobi
+  on damped BA systems clusters it near 1).  Each apply costs k extra
+  S applications INSIDE the PCG while body — 2k extra all-reduces per
+  iteration when sharded — so it trades communication for iterations
+  and must be judged on wall-clock, never iteration counts alone.
+
+TWO_LEVEL — a BA-shaped two-level (multigrid-flavoured) scheme:
+  cameras are aggregated into O(sqrt(Nc)) clusters by a greedy
+  co-observation-weighted host plan (ops/segtiles.build_cluster_plan,
+  cached behind the plan-fingerprint LRU), R is the piecewise-constant
+  aggregation over camera blocks (fixed cameras masked out), and the
+  coarse operator is the EXACT Galerkin projection of the damped Schur
+  complement
+
+      A_c = R S_d Rᵀ = R G,      G = S_d Rᵀ,
+      G[n, (J,b)] = (Hpp_d)_n R[n,J] − Σ_{e: cam(e)=n} W_e Hll⁻¹ V_Jᵀ,
+      V_{p,I} = Σ_{e: pt(e)=p, cluster(cam(e))=I} W_e,
+
+  assembled once per PCG solve from already-materialised quantities:
+  the damped camera blocks, Hll⁻¹, and the per-edge coupling rows W_e
+  (read in EXPLICIT mode, recomputed chunk-wise from the stored
+  Jacobians in IMPLICIT mode — linear_system.coupling_row_provider /
+  coupling_row_gather).  No black-box S applications, no new
+  collective kinds: ONE psum each for V and G when sharded, both
+  OUTSIDE the PCG while body.  The coarse system (a few hundred
+  unknowns) is factored by a small replicated spectrally-FILTERED
+  eigendecomposition (solver/dense.dense_filtered_factor — see
+  _COARSE_EIG_FLOOR for why near-null modes are dropped, not inverted)
+  and the apply is the SYMMETRIZED MULTIPLICATIVE two-level cycle
+  (coarse correction + block-Jacobi smoothing + coarse re-correction —
+  V(0,1)-cycle with exact-on-the-kept-spectrum coarse solve):
+
+      M⁻¹ = Rᵀ A_c⁻¹ R + Pᵀ D⁻¹ P,     P = I − S_d Rᵀ A_c⁻¹ R
+
+  Because P's S application only ever hits vectors in range(Rᵀ), the
+  materialised G = S_d Rᵀ turns both "S applies" of the cycle into
+  tiny replicated [cd·Nc, C·cd] matmuls — the per-apply work is two
+  coarse triangular solves, two G contractions and one block-diagonal
+  smooth: ZERO collectives inside the while body (the
+  `ba_twolevel_w2_f32` canonical program pins exactly 2 all-reduces
+  per S·p there).  Unlike the ADDITIVE combination D⁻¹ + RᵀA_c⁻¹R
+  (which re-widens the spectrum wherever coarse and fine ranges
+  overlap — measured 1.5x MORE iterations on the venice bench), the
+  multiplicative cycle leaves coarse modes with eigenvalue exactly 1.
+  M⁻¹ is SPD: both terms are PSD and their kernels are disjoint
+  (P r = r on ker(R), where D⁻¹ is PD).
+
+Fallback ladder (extends PR 5's Cholesky-NaN semantics one level up):
+a non-finite coarse spectrum degrades TWO_LEVEL to plain block-Jacobi
+(the cycle becomes EXACTLY the base apply), and — independently, per
+camera block — an indefinite SCHUR_DIAG block falls back to the Hpp
+preconditioner.  Both levels are COUNTED, not silent:
+`PCGResult.precond_fallback` carries an enum-coded per-level count
+(encode/decode below) into `SolveTrace`/`SolveReport`.
+
+Measured (venice-10% synthetic bench, CPU lane, inexact-LM config):
+NEUMANN k=1 cuts total PCG iterations 40% (70 -> 42) at 9e-8 relative
+cost gap — the run_tests.sh smoke gates on >= 30%.  TWO_LEVEL is
+dense-verified exact and cuts the preconditioned condition number
+54 -> 4.3 on small systems, but the bench SYNTHETIC's camera graph is
+an expander ((base + j*stride) mod Nc observation assignment — no
+cluster structure), so its coarse space captures nothing there and
+block-Jacobi stays the better default on that lane; it targets
+spatially-local real scenes.  See ARCHITECTURE.md "Preconditioner
+hierarchy".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.common import ComputeKind, PrecondKind, PreconditionerKind
+from megba_tpu.core.fm import chunked_edge_reduce, gather_fm
+from megba_tpu.linear_system.builder import (
+    coupling_row_gather,
+    coupling_row_provider,
+)
+from megba_tpu.solver.dense import dense_filtered_factor, dense_filtered_solve
+
+HI = jax.lax.Precision.HIGHEST
+
+# Per-pair-chunk transient bound for the coarse correction contraction:
+# [cd*cd, chunk] rows (~21 MB f32 at the default — same class as the
+# Hessian build chunks).
+_PAIR_CHUNK = 65_536
+
+# Relative eigenvalue floor of the filtered coarse solve
+# (dense.dense_filtered_factor).  Two jobs: (1) eigenvalues under
+# ~1e-6·lambda_max are below the f32 assembly noise of A_c; (2) under
+# weak LM damping (trust region >= ~1e4 — where the venice trajectory
+# spends most accepted iterations) the gauge-like near-null modes of S
+# survive into A_c, and INVERTING them amplifies directions the Krylov
+# iteration never needed to resolve — measured: unfiltered coarse
+# solves cost 66-78 PCG iters/LM vs block-Jacobi's flat ~43 at region
+# 1e5-3e5 on the venice-3% bench, flipping the two-level win into a
+# loss.  Filtered, those modes fall through to the smoother, which
+# treats them exactly as block-Jacobi always has.
+_COARSE_EIG_FLOOR = 1e-5
+
+# --------------------------------------------------------------------------
+# Per-level fallback encoding (SolveTrace / SolveReport observable)
+# --------------------------------------------------------------------------
+#
+# `precond_fallback` is ONE int32 so the trace layout is unchanged; the
+# two ladder levels ride fixed radixes:
+#   low  16 bits — BLOCK level: camera blocks whose SCHUR_DIAG Cholesky
+#                  went NaN and fell back to the Hpp preconditioner;
+#   high bits    — COARSE level: 1 when the two-level coarse factor was
+#                  non-finite and the apply degraded to block-Jacobi.
+
+FALLBACK_BLOCK_RADIX = 1 << 16
+
+
+def encode_precond_fallback(block_count, coarse_count=0):
+    """Pack per-level fallback counts into one int32 trace code."""
+    block = jnp.minimum(jnp.asarray(block_count, jnp.int32),
+                        FALLBACK_BLOCK_RADIX - 1)
+    return (jnp.asarray(coarse_count, jnp.int32)
+            * FALLBACK_BLOCK_RADIX + block)
+
+
+def decode_precond_fallback(code) -> dict:
+    """Unpack a trace code into {'block': n, 'coarse': n} (host ints)."""
+    c = int(code)
+    return {"block": c % FALLBACK_BLOCK_RADIX,
+            "coarse": c // FALLBACK_BLOCK_RADIX}
+
+
+# --------------------------------------------------------------------------
+# Block-diagonal bases (the extracted JACOBI baseline)
+# --------------------------------------------------------------------------
+
+
+def cam_block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
+    """[Nc, d, d] camera blocks times [d, Nc] rows -> [d, Nc] rows."""
+    return jnp.einsum("nij,jn->in", H, x, precision=HI)
+
+
+def block_inv(H: jax.Array) -> jax.Array:
+    """Batched inverse of SPD camera blocks [N, d, d] via Cholesky.
+
+    The analog of the reference's cublasGmatinvBatched calls
+    (schur_pcg_solver.cu:60-97); stable on the damped SPD blocks.
+    Point blocks use the row-form closed-form `core.fm.block_inv_fm`.
+    """
+    d = H.shape[-1]
+    chol = jnp.linalg.cholesky(H)
+    eye = jnp.broadcast_to(jnp.eye(d, dtype=H.dtype), H.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(chol, eye, lower=True)
+    return jnp.einsum("nki,nkj->nij", inv_l, inv_l, precision=HI)
+
+
+@jax.named_scope("megba.schur_diag_precond")
+def _schur_diag_precond(
+    Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
+    compute_kind, axis_name, cam_sorted, plans=None,
+):
+    """True Schur block diagonal: Hpp_c - sum_e W_e Hll^-1 W_e^T.
+
+    Chunked over edges (like the Hessian build) so the [cd*cd, chunk]
+    correction rows never materialise at full edge scale — the round-1
+    [nE, 9, 9] transient that made this preconditioner unusable at
+    Final scale is gone.
+    """
+    cd = Hpp_d.shape[-1]
+    pd = int(round(Hll_inv.shape[0] ** 0.5))
+    dtype = Hpp_d.dtype
+    nE = cam_idx.shape[0]
+    od = None if Jc is None else Jc.shape[0] // cd
+    rows_of = coupling_row_provider(
+        W, Jc, Jp, 0 if od is None else od, compute_kind, dtype,
+        plans=plans)
+
+    def body(start, size, accs):
+        (corr_a,) = accs
+        ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
+        pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
+        hinv = gather_fm(Hll_inv, pi)  # [pd*pd, size]
+        w = rows_of(start, size)  # [cd*pd, size]
+        # t[a, q] = sum_p w[a, p] hinv[p, q]
+        t = [sum(w[a * pd + p] * hinv[p * pd + q] for p in range(pd))
+             for a in range(cd) for q in range(pd)]
+        corr = jnp.stack([
+            sum(t[a * pd + q] * w[b * pd + q] for q in range(pd))
+            for a in range(cd) for b in range(cd)
+        ])
+        return (corr_a.at[:, ci].add(
+            corr, indices_are_sorted=cam_sorted, mode="drop"),)
+
+    (corr_rows,) = chunked_edge_reduce(
+        nE, (jnp.zeros((cd * cd, num_cameras), dtype),), body)
+    if axis_name is not None:
+        corr_rows = jax.lax.psum(corr_rows, axis_name)
+    corr = jnp.moveaxis(corr_rows.reshape(cd, cd, num_cameras), -1, 0)
+    # In exact arithmetic Hpp_d - corr is SPD (a principal block of S),
+    # but rounding (especially equilibrated bf16 operands) can push a
+    # weakly-determined camera block indefinite -> Cholesky NaN.  Fall
+    # back to the Hpp preconditioner for exactly those blocks instead of
+    # letting NaN masquerade as convergence.  The fallback is COUNTED,
+    # not silent: the block count rides PCGResult.precond_fallback into
+    # the SolveTrace so an indefinite drift shows up in telemetry.
+    minv_hpp = block_inv(Hpp_d)
+    minv_sd = block_inv(Hpp_d - corr)
+    bad = ~jnp.all(jnp.isfinite(minv_sd), axis=(-2, -1), keepdims=True)
+    return jnp.where(bad, minv_hpp, minv_sd), jnp.sum(bad).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# Two-level coarse operator (Galerkin R S_d Rᵀ from materialised blocks)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TwoLevelCoarse:
+    """Assembled coarse-space state of one two-level preconditioner.
+
+    `coarse_matrix` [C*cd, C*cd] is the exact Galerkin A_c = R S_d Rᵀ
+    (cluster-major unknown ordering: coarse dof (I, a) -> I*cd + a);
+    `eig_q`/`eig_inv` its spectrally-filtered pseudo-inverse factor
+    (dense.dense_filtered_factor — see _COARSE_EIG_FLOOR for why the
+    near-null modes are dropped rather than inverted), `ok` the health
+    flag the fallback ladder keys on, `restrict_sel` the [C, Nc]
+    fixed-masked aggregation matrix (R at scalar granularity), `G` the
+    materialised coarse coupling S_d Rᵀ as [cd, Nc, C, cd] (fine dof
+    (a, n) by coarse dof (J, b)).  Exposed as a dataclass so the
+    dense-parity property tests can compare `coarse_matrix`/`G`
+    against explicitly projected dense operators.
+    """
+
+    coarse_matrix: jax.Array
+    eig_q: jax.Array  # [C*cd, C*cd] eigenvectors
+    eig_inv: jax.Array  # [C*cd] filtered inverse eigenvalues
+    ok: jax.Array  # traced bool: coarse factor finite
+    restrict_sel: jax.Array  # [C, Nc]
+    cluster: jax.Array  # [Nc] int32
+    G: jax.Array  # [cd, Nc, C, cd] = S_d Rᵀ
+
+
+@jax.named_scope("megba.precond_coarse_build")
+def build_two_level_coarse(
+    Hpp_d: jax.Array,
+    Hll_inv: jax.Array,
+    W: Optional[jax.Array],
+    Jc: Optional[jax.Array],
+    Jp: Optional[jax.Array],
+    cluster_plan,
+    compute_kind: ComputeKind,
+    axis_name: Optional[str] = None,
+    cam_fixed: Optional[jax.Array] = None,
+    plans=None,
+) -> TwoLevelCoarse:
+    """Assemble + factor G = S_d Rᵀ and A_c = R G = R S_d Rᵀ.
+
+    Pure gathers/scatter-adds over the host-planned index arrays
+    (ops/segtiles.ClusterPlan) + one small dense Cholesky; when the
+    edge axis is sharded the per-shard V rows are psum-combined BEFORE
+    the ec-pair contraction (cross-shard edges of one point are why —
+    W_e Hll⁻¹ (ΣV)ᵀ needs the globally-summed V) and the per-shard G
+    contributions are psum-combined after it.  Two all-reduces per
+    BUILD (once per PCG solve), both outside the PCG while body, both
+    the collective kind the solver already emits.
+    """
+    cd = Hpp_d.shape[-1]
+    pd = int(round(Hll_inv.shape[0] ** 0.5))
+    dtype = Hpp_d.dtype
+    num_cameras = Hpp_d.shape[0]
+    C = cluster_plan.num_clusters
+    n_pc = cluster_plan.n_pc
+    od = None if Jc is None else Jc.shape[0] // cd
+    rows_of = coupling_row_provider(
+        W, Jc, Jp, 0 if od is None else od, compute_kind, dtype,
+        plans=plans)
+    rows_at = coupling_row_gather(
+        W, Jc, Jp, 0 if od is None else od, compute_kind, dtype,
+        plans=plans)
+    n_edges = cluster_plan.pc_slot.shape[0]
+
+    # V rows [cd*pd, n_pc]: per-(point, cluster) aggregated coupling.
+    # Padding / masked edges carry the inert slot n_pc -> dropped (their
+    # rows are zero anyway — the Jacobians are mask-multiplied).
+    def vbody(start, size, accs):
+        (v_a,) = accs
+        sl = jax.lax.dynamic_slice_in_dim(cluster_plan.pc_slot, start, size)
+        return (v_a.at[:, sl].add(rows_of(start, size), mode="drop"),)
+
+    (V,) = chunked_edge_reduce(
+        n_edges, (jnp.zeros((cd * pd, n_pc), dtype),), vbody)
+    if axis_name is not None:
+        V = jax.lax.psum(V, axis_name)
+
+    # T = V · Hll⁻¹ per incidence (the point block is shared by every
+    # incidence of its point; Hll⁻¹ is symmetric, so T's columns double
+    # as the Hll⁻¹ Vᵀ blocks the ec contraction needs).
+    hinv = gather_fm(Hll_inv, cluster_plan.pc_pt)  # [pd*pd, n_pc]
+    T = jnp.stack([
+        sum(V[a * pd + p] * hinv[p * pd + q] for p in range(pd))
+        for a in range(cd) for q in range(pd)
+    ])  # [cd*pd, n_pc]
+
+    # ec-pair contraction: corrG[(a,b), (n,J)] += Σ_q W_e[a,q] T_s[b,q]
+    # over the host-enumerated (edge, same-point-slot) pairs — the
+    # coupling half of G = S_d Rᵀ, chunked so the [cd*cd, chunk] block
+    # rows stay VMEM-sized.  Inert padding pairs scatter to the
+    # out-of-range segment Nc*C and are dropped.
+    NcC = num_cameras * C
+
+    def gbody(start, size, accs):
+        (g_a,) = accs
+        le = jax.lax.dynamic_slice_in_dim(cluster_plan.ec_edge, start, size)
+        ls = jax.lax.dynamic_slice_in_dim(cluster_plan.ec_slot, start, size)
+        sg = jax.lax.dynamic_slice_in_dim(cluster_plan.ec_seg, start, size)
+        w = rows_at(le)  # [cd*pd, size]
+        t = jnp.take(T, ls, axis=1, mode="clip")  # [cd*pd, size]
+        block = jnp.stack([
+            sum(w[a * pd + q] * t[b * pd + q] for q in range(pd))
+            for a in range(cd) for b in range(cd)
+        ])  # [cd*cd, size]
+        return (g_a.at[:, sg].add(block, mode="drop"),)
+
+    (corrg_rows,) = chunked_edge_reduce(
+        cluster_plan.ec_edge.shape[0],
+        (jnp.zeros((cd * cd, NcC), dtype),), gbody, target=_PAIR_CHUNK)
+    if axis_name is not None:
+        corrg_rows = jax.lax.psum(corrg_rows, axis_name)
+    corrg = corrg_rows.reshape(cd, cd, num_cameras, C).transpose(0, 2, 3, 1)
+
+    # Fine half Hpp_d Rᵀ: Hpp is block diagonal, so camera n contributes
+    # its own block to coarse column cluster(n) only.  Fixed cameras are
+    # excluded from R (their identity blocks would pollute the cluster
+    # sums, and the coarse correction must never move a pinned camera);
+    # their W rows are already zero, so G's rows/cols there vanish and
+    # the cycle degrades to pure block-Jacobi for them.
+    sel = (cluster_plan.cluster[None, :]
+           == jnp.arange(C, dtype=jnp.int32)[:, None]).astype(dtype)
+    if cam_fixed is not None:
+        sel = sel * (1.0 - cam_fixed.astype(dtype))[None, :]
+    fine = jnp.einsum("nab,Jn->anJb", Hpp_d, sel, precision=HI)
+    G = fine - corrg  # [cd, Nc, C, cd] = S_d Rᵀ
+
+    # A_c = R G (Galerkin): tiny replicated contraction.
+    A = jnp.einsum("In,anJb->IaJb", sel, G,
+                   precision=HI).reshape(C * cd, C * cd)
+    A = 0.5 * (A + A.T)  # symmetrise away accumulation-order roundoff
+    # Filtered pseudo-inverse instead of a Cholesky: all-fixed /
+    # edge-less clusters (exactly-zero rows) and gauge-like near-null
+    # modes both land UNDER the eigenvalue floor and simply receive no
+    # coarse correction, rather than NaN-ing the factor or amplifying
+    # noise (_COARSE_EIG_FLOOR).
+    (Q, inv), ok = dense_filtered_factor(A, _COARSE_EIG_FLOOR)
+    return TwoLevelCoarse(coarse_matrix=A, eig_q=Q, eig_inv=inv, ok=ok,
+                          restrict_sel=sel, cluster=cluster_plan.cluster,
+                          G=G)
+
+
+def _coarse_solve_inject(coarse: TwoLevelCoarse, rc: jax.Array):
+    """A_c⁺ on a [C, cd] coarse residual, plus its Rᵀ injection.
+
+    Returns (y [C, cd], z [cd, Nc]) — the injection gathers each
+    camera's cluster value and re-applies the fixed-camera mask (selᵀ y
+    == gather + mask, without materialising selᵀ)."""
+    C, cd = rc.shape
+    y = dense_filtered_solve((coarse.eig_q, coarse.eig_inv),
+                             rc.reshape(C * cd)).reshape(C, cd)
+    z = jnp.swapaxes(jnp.take(y, coarse.cluster, axis=0), 0, 1)
+    z = z * jnp.max(coarse.restrict_sel, axis=0)[None, :]
+    return y, z
+
+
+def two_level_cycle(
+    coarse: TwoLevelCoarse,
+    base_apply: Callable[[jax.Array], jax.Array],
+    r: jax.Array,
+) -> jax.Array:
+    """One symmetrized multiplicative two-level cycle ([cd, Nc] rows).
+
+        M⁻¹ r = Rᵀ A_c⁻¹ R r + Pᵀ D⁻¹ P r,   P = I − G A_c⁻¹ R
+
+    with G = S_d Rᵀ materialised at build time, so both "S applies"
+    are [cd·Nc, C·cd] replicated contractions: per-apply work is two
+    tiny triangular solves + two G contractions + one block-diagonal
+    smooth — no edge-scale ops, ZERO collectives.  Degrades bitwise to
+    the plain base apply when the coarse factor was non-finite (the
+    fallback ladder's coarse level); fixed cameras receive exactly the
+    base apply by the masked selector.
+    """
+    rc = jnp.einsum("In,an->Ia", coarse.restrict_sel, r,
+                    precision=HI)  # R r  [C, cd]
+    y, z_c = _coarse_solve_inject(coarse, rc)
+    gy = jnp.einsum("anJb,Jb->an", coarse.G, y, precision=HI)  # G y
+    # Pre-smoothing residual P r = r − G A_c⁻¹ R r; gated so the
+    # ok=False ladder level is EXACTLY base_apply(r), not a perturbed
+    # smooth of garbage.
+    u = jnp.where(coarse.ok, r - gy, r)
+    w = base_apply(u)
+    # Post-correction: Rᵀ A_c⁻¹ (Gᵀ w)   (Gᵀ w = R S_d w).
+    v = jnp.einsum("anJb,an->Jb", coarse.G, w, precision=HI)
+    _, z2 = _coarse_solve_inject(coarse, v)
+    return jnp.where(coarse.ok, z_c + w - z2, w)
+
+
+# --------------------------------------------------------------------------
+# Factory
+# --------------------------------------------------------------------------
+
+
+def make_schur_preconditioner(
+    kind: PrecondKind,
+    block_kind: PreconditionerKind,
+    Hpp_d: jax.Array,
+    Hll_inv: jax.Array,
+    W: Optional[jax.Array],
+    Jc: Optional[jax.Array],
+    Jp: Optional[jax.Array],
+    cam_idx: jax.Array,
+    pt_idx: jax.Array,
+    num_cameras: int,
+    compute_kind: ComputeKind,
+    axis_name: Optional[str],
+    cam_sorted: bool,
+    neumann_order: int = 2,
+    plans=None,
+    cluster_plan=None,
+    cam_fixed=None,
+    s_matvec: Optional[Callable[[jax.Array], jax.Array]] = None,
+) -> Tuple[Callable[[jax.Array], jax.Array], jax.Array]:
+    """Build the reduced-system preconditioner apply for one solve.
+
+    Returns `(apply, fallback_code)`: `apply(r [cd, Nc]) -> [cd, Nc]`
+    runs inside the PCG while body; `fallback_code` is the enum-coded
+    per-level fallback count (encode_precond_fallback) for the trace.
+    `kind` picks the operator family (PrecondKind), `block_kind` the
+    base block diagonal every family smooths with (PreconditionerKind).
+    All operands are the damped, already-materialised solve quantities;
+    `s_matvec` (the CG's own S·p closure) is required by NEUMANN only.
+    """
+    if block_kind == PreconditionerKind.SCHUR_DIAG:
+        Minv, n_bad = _schur_diag_precond(
+            Hpp_d, Hll_inv, W, Jc, Jp, cam_idx, pt_idx, num_cameras,
+            compute_kind, axis_name, cam_sorted, plans=plans)
+    else:
+        Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
+        n_bad = jnp.int32(0)
+
+    def base_apply(r):
+        return cam_block_matvec(Minv, r)
+
+    if kind == PrecondKind.JACOBI:
+        return base_apply, encode_precond_fallback(n_bad)
+
+    if kind == PrecondKind.NEUMANN:
+        if s_matvec is None:
+            raise ValueError("NEUMANN preconditioner needs the S matvec")
+        order = int(neumann_order)
+
+        @jax.named_scope("megba.precond_neumann")
+        def neumann_apply(r):
+            # Horner form of Σ_{i<=k} E^i D⁻¹ r, E = I − D⁻¹S: each
+            # step is one S apply (the 2-psum product) + one block
+            # solve.  k is static — the unrolled chain lives inside the
+            # fused while body.
+            z = base_apply(r)
+            for _ in range(order):
+                z = z + base_apply(r - s_matvec(z))
+            return z
+
+        return neumann_apply, encode_precond_fallback(n_bad)
+
+    if kind != PrecondKind.TWO_LEVEL:  # pragma: no cover - enum closed
+        raise ValueError(f"unknown precond kind {kind}")
+    if cluster_plan is None:
+        raise ValueError(
+            "precond=TWO_LEVEL needs a camera-cluster plan operand; the "
+            "flat_solve lowering builds one automatically "
+            "(ops/segtiles.cached_cluster_plan) — direct schur_pcg_solve "
+            "callers must pass cluster_plan=")
+    coarse = build_two_level_coarse(
+        Hpp_d, Hll_inv, W, Jc, Jp, cluster_plan, compute_kind,
+        axis_name=axis_name, cam_fixed=cam_fixed, plans=plans)
+
+    @jax.named_scope("megba.precond_two_level")
+    def two_level_apply(r):
+        return two_level_cycle(coarse, base_apply, r)
+
+    fallback = encode_precond_fallback(
+        n_bad, jnp.where(coarse.ok, jnp.int32(0), jnp.int32(1)))
+    return two_level_apply, fallback
+
+
+__all__ = [
+    "FALLBACK_BLOCK_RADIX",
+    "TwoLevelCoarse",
+    "block_inv",
+    "build_two_level_coarse",
+    "cam_block_matvec",
+    "decode_precond_fallback",
+    "encode_precond_fallback",
+    "make_schur_preconditioner",
+    "two_level_cycle",
+    "_schur_diag_precond",
+]

@@ -1,0 +1,424 @@
+"""Pluggable Schur preconditioners (solver/precond.py, ISSUE 7).
+
+Contracts pinned here:
+
+- SPD + spectral sanity: every operator family materialises to a
+  symmetric positive-definite M⁻¹ on a real (damped) Schur system, and
+  the two-level cycle's coarse operator A_c is EXACTLY the Galerkin
+  projection R S_d Rᵀ of the damped Schur complement (dense parity,
+  f64), with G = S_d Rᵀ and the full cycle matching the explicit
+  Rᵀ A_c⁺ R + Pᵀ D⁻¹ P formula.
+- Parity suite: block-Jacobi vs Neumann vs two-level reach the same
+  optimum (rtol 1e-6) on the same LM budget, single-device AND
+  world-2; the stronger operators spend strictly fewer PCG iterations
+  in their winning regime; `precond="jacobi"` is BITWISE the
+  historical solver.
+- Fallback ladder: a poisoned coarse build degrades the cycle to the
+  base apply bitwise, the degrade is enum-coded per level in
+  `precond_fallback`, and encode/decode round-trips.
+- Cluster plan: the greedy aggregation partitions all cameras, the
+  pc/ec index streams are mutually consistent, shard grouping is
+  self-consistent, and the plan rides the content-fingerprint cache.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from megba_tpu.common import (
+    AlgoOption,
+    ComputeKind,
+    JacobianMode,
+    PrecondKind,
+    PreconditionerKind,
+    ProblemOption,
+    SolverOption,
+    validate_options,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.linear_system import build_schur_system, weight_system_inputs
+from megba_tpu.linear_system.builder import damp_blocks
+from megba_tpu.core.fm import block_inv_fm, coupling_rows, damp_rows_fm
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.ops.segtiles import (
+    build_camera_clusters,
+    build_cluster_plan,
+    cached_cluster_plan,
+    device_cluster_plan,
+)
+from megba_tpu.solve import flat_solve
+from megba_tpu.solver.pcg import schur_pcg_solve
+from megba_tpu.solver.precond import (
+    FALLBACK_BLOCK_RADIX,
+    block_inv,
+    build_two_level_coarse,
+    cam_block_matvec,
+    decode_precond_fallback,
+    encode_precond_fallback,
+    make_schur_preconditioner,
+    two_level_cycle,
+)
+
+CD, PD = 9, 3
+
+
+def _system(num_cameras=7, num_points=40, seed=2, dtype=np.float64):
+    s = make_synthetic_bal(num_cameras=num_cameras, num_points=num_points,
+                           obs_per_point=4, seed=seed, dtype=dtype)
+    cams, pts = jnp.asarray(s.cameras0.T), jnp.asarray(s.points0.T)
+    ci, pi = jnp.asarray(s.cam_idx), jnp.asarray(s.pt_idx)
+    obs = jnp.asarray(s.obs.T)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    r, Jc, Jp = f(cams[:, ci], pts[:, pi], obs)
+    r, Jc, Jp = weight_system_inputs(r, Jc, Jp, ci, pi,
+                                     jnp.ones(obs.shape[1]))
+    system = build_schur_system(r, Jc, Jp, ci, pi, num_cameras, num_points)
+    return s, system, Jc, Jp, ci, pi
+
+
+def _dense_schur(s, system, Jc, Jp, region):
+    """Explicit damped Schur complement S_d [Nc*cd, Nc*cd] (f64)."""
+    Nc = system.Hpp.shape[0]
+    Np = system.Hll.shape[1]
+    od = Jc.shape[0] // CD
+    Hpp_d = damp_blocks(system.Hpp, region)
+    Hll_d = damp_rows_fm(system.Hll, region)
+    Hinv = np.asarray(block_inv_fm(Hll_d))
+    W = np.asarray(coupling_rows(Jc, Jp, od))
+    S = np.zeros((Nc * CD, Nc * CD))
+    for i in range(Nc):
+        S[i * CD:(i + 1) * CD, i * CD:(i + 1) * CD] = np.asarray(Hpp_d[i])
+    Hpl = np.zeros((Nc * CD, Np * PD))
+    for e in range(len(s.cam_idx)):
+        c, p = int(s.cam_idx[e]), int(s.pt_idx[e])
+        Hpl[c * CD:(c + 1) * CD, p * PD:(p + 1) * PD] += (
+            W[:, e].reshape(CD, PD))
+    Hll_inv_dense = np.zeros((Np * PD, Np * PD))
+    for p in range(Np):
+        Hll_inv_dense[p * PD:(p + 1) * PD, p * PD:(p + 1) * PD] = (
+            Hinv[:, p].reshape(PD, PD))
+    return S - Hpl @ Hll_inv_dense @ Hpl.T, Hpp_d, jnp.asarray(
+        block_inv_fm(Hll_d)), W
+
+
+def _materialize(apply_fn, n_cams):
+    """Columns of M⁻¹ through the feature-major apply ([cd, Nc] rows)."""
+    cols = []
+    for e in np.eye(n_cams * CD):
+        rfm = jnp.asarray(e.reshape(n_cams, CD).T)
+        cols.append(np.asarray(apply_fn(rfm)).T.reshape(-1))
+    return np.stack(cols, axis=1)
+
+
+# ------------------------------------------------------ dense parity / SPD
+
+
+def test_two_level_coarse_is_exact_galerkin_and_cycle_matches_formula():
+    s, system, Jc, Jp, ci, pi = _system()
+    Nc = system.Hpp.shape[0]
+    region = jnp.asarray(50.0)
+    S, Hpp_d, Hll_inv, W = _dense_schur(s, system, Jc, Jp, region)
+    plan = build_cluster_plan(s.cam_idx, s.pt_idx, Nc, system.Hll.shape[1])
+    dplan = device_cluster_plan(plan)
+    C = plan.num_clusters
+    coarse = build_two_level_coarse(Hpp_d, Hll_inv, jnp.asarray(W), Jc, Jp,
+                                    dplan, ComputeKind.EXPLICIT)
+    assert bool(coarse.ok)
+    # Explicit R: piecewise-constant aggregation at scalar granularity.
+    R = np.zeros((C * CD, Nc * CD))
+    for n in range(Nc):
+        I = plan.cluster[n]
+        R[I * CD:(I + 1) * CD, n * CD:(n + 1) * CD] = np.eye(CD)
+    np.testing.assert_allclose(np.asarray(coarse.coarse_matrix), R @ S @ R.T,
+                               atol=1e-9 * np.abs(S).max())
+    G_ref = S @ R.T
+    G_impl = np.zeros_like(G_ref)
+    Gd = np.asarray(coarse.G)
+    for a in range(CD):
+        for n in range(Nc):
+            G_impl[n * CD + a, :] = Gd[a, n].reshape(-1)
+    np.testing.assert_allclose(G_impl, G_ref, atol=1e-9 * np.abs(S).max())
+
+    # Full cycle vs the explicit symmetric multiplicative formula, with
+    # the SAME filtered pseudo-inverse on both sides.
+    binv = block_inv(Hpp_d)
+    base = lambda x: cam_block_matvec(binv, x)
+    M_impl = _materialize(lambda r: two_level_cycle(coarse, base, r), Nc)
+    lam, Q = np.linalg.eigh(R @ S @ R.T)
+    keep = lam > 1e-5 * lam.max()
+    Aplus = (Q[:, keep] / lam[keep]) @ Q[:, keep].T
+    D_inv = np.zeros((Nc * CD, Nc * CD))
+    for n in range(Nc):
+        D_inv[n * CD:(n + 1) * CD, n * CD:(n + 1) * CD] = np.asarray(binv[n])
+    P = np.eye(Nc * CD) - S @ R.T @ Aplus @ R
+    M_ref = R.T @ Aplus @ R + P.T @ D_inv @ P
+    np.testing.assert_allclose(M_impl, M_ref,
+                               atol=1e-10 * np.abs(M_ref).max())
+
+
+@pytest.mark.parametrize("kind", [PrecondKind.JACOBI, PrecondKind.NEUMANN,
+                                  PrecondKind.TWO_LEVEL])
+def test_preconditioner_is_spd(kind):
+    s, system, Jc, Jp, ci, pi = _system()
+    Nc = system.Hpp.shape[0]
+    region = jnp.asarray(50.0)
+    S, Hpp_d, Hll_inv, W = _dense_schur(s, system, Jc, Jp, region)
+    plan = build_cluster_plan(s.cam_idx, s.pt_idx, Nc, system.Hll.shape[1])
+    Snp = S
+
+    def s_matvec(p):
+        flat = np.asarray(p).T.reshape(-1)
+        return jnp.asarray((Snp @ flat).reshape(Nc, CD).T)
+
+    apply_fn, code = make_schur_preconditioner(
+        kind, PreconditionerKind.HPP, Hpp_d, Hll_inv, jnp.asarray(W),
+        Jc, Jp, ci, pi, Nc, ComputeKind.EXPLICIT, None, False,
+        neumann_order=2, cluster_plan=device_cluster_plan(plan),
+        s_matvec=s_matvec)
+    M = _materialize(apply_fn, Nc)
+    sym_err = np.abs(M - M.T).max() / np.abs(M).max()
+    assert sym_err < 1e-12
+    ev = np.linalg.eigvalsh(0.5 * (M + M.T))
+    assert ev.min() > 0, f"{kind}: M⁻¹ not PD (min eig {ev.min():.3e})"
+    assert int(code) == 0
+
+
+def test_jacobi_family_is_bitwise_the_block_inverse():
+    # The extracted JACOBI baseline must be EXACTLY the historical
+    # apply: cam_block_matvec(block_inv(Hpp_d), r), bit for bit.
+    s, system, Jc, Jp, ci, pi = _system(num_cameras=5, num_points=25,
+                                        seed=4)
+    Nc = system.Hpp.shape[0]
+    Hpp_d = damp_blocks(system.Hpp, jnp.asarray(80.0))
+    Hll_inv = block_inv_fm(damp_rows_fm(system.Hll, jnp.asarray(80.0)))
+    apply_fn, code = make_schur_preconditioner(
+        PrecondKind.JACOBI, PreconditionerKind.HPP, Hpp_d, Hll_inv,
+        None, Jc, Jp, ci, pi, Nc, ComputeKind.IMPLICIT, None, False)
+    rng = np.random.default_rng(0)
+    r = jnp.asarray(rng.standard_normal((CD, Nc)))
+    want = cam_block_matvec(block_inv(Hpp_d), r)
+    assert np.array_equal(np.asarray(apply_fn(r)), np.asarray(want))
+    assert int(code) == 0
+
+
+# --------------------------------------------------------- parity suite
+
+
+def _solve(s, kind, world_size=1, max_iter=12, **skw):
+    option = ProblemOption(
+        world_size=world_size,
+        jacobian_mode=JacobianMode.ANALYTICAL,
+        algo_option=AlgoOption(max_iter=max_iter, epsilon1=1e-9,
+                               epsilon2=1e-12),
+        solver_option=SolverOption(max_iter=200, tol=1e-10,
+                                   tol_relative=True, refuse_ratio=1e30,
+                                   precond=kind, **skw))
+    return flat_solve(make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL),
+                      s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                      option)
+
+
+def test_parity_jacobi_neumann_two_level_single_device():
+    s = make_synthetic_bal(num_cameras=10, num_points=60, obs_per_point=5,
+                           seed=0, param_noise=5e-2, pixel_noise=0.3)
+    jac = _solve(s, PrecondKind.JACOBI)
+    neu = _solve(s, PrecondKind.NEUMANN, neumann_order=2)
+    two = _solve(s, PrecondKind.TWO_LEVEL)
+    np.testing.assert_allclose(float(neu.cost), float(jac.cost), rtol=1e-6)
+    np.testing.assert_allclose(float(two.cost), float(jac.cost), rtol=1e-6)
+    # The stronger operators spend strictly fewer inner iterations on
+    # the same trajectory budget.
+    assert int(neu.pcg_iterations) < int(jac.pcg_iterations)
+    assert int(two.pcg_iterations) < int(jac.pcg_iterations)
+
+
+@pytest.mark.slow  # two fresh SPMD LM compiles — cache-cold this is
+# minutes; the full suite (scripts/run_tests.sh) runs it, tier-1 skips
+def test_parity_world2_matches_single_device():
+    s = make_synthetic_bal(num_cameras=10, num_points=60, obs_per_point=5,
+                           seed=3, param_noise=5e-2, pixel_noise=0.3)
+    for kind in (PrecondKind.NEUMANN, PrecondKind.TWO_LEVEL):
+        one = _solve(s, kind, world_size=1, max_iter=8)
+        two = _solve(s, kind, world_size=2, max_iter=8)
+        np.testing.assert_allclose(float(two.cost), float(one.cost),
+                                   rtol=1e-6)
+        assert int(two.pcg_iterations) == int(one.pcg_iterations)
+
+
+def test_strict_iteration_decrease_isolated_solve():
+    # One reduced solve at moderate damping, tight relative tolerance —
+    # the regime where the plateau lives; both stronger operators must
+    # STRICTLY beat block-Jacobi's iteration count.
+    s, system, Jc, Jp, ci, pi = _system(num_cameras=12, num_points=70,
+                                        seed=1)
+    plan = build_cluster_plan(s.cam_idx, s.pt_idx, 12, 70)
+    region = jnp.asarray(100.0)
+    kw = dict(max_iter=500, tol=1e-10, tol_relative=True, refuse_ratio=1e30)
+    jac = schur_pcg_solve(system, Jc, Jp, ci, pi, region, **kw)
+    neu = schur_pcg_solve(system, Jc, Jp, ci, pi, region,
+                          precond=PrecondKind.NEUMANN, neumann_order=2, **kw)
+    two = schur_pcg_solve(system, Jc, Jp, ci, pi, region,
+                          precond=PrecondKind.TWO_LEVEL,
+                          cluster_plan=device_cluster_plan(plan), **kw)
+    assert int(neu.iterations) < int(jac.iterations)
+    assert int(two.iterations) < int(jac.iterations)
+    # All three land on the same solution (each run truncates at its
+    # own tol-crossing iterate, so the agreement band is the truncation
+    # error, not machine precision — the bitwise/rtol-1e-6 contracts
+    # live in the LM-level parity tests above).
+    scale = float(jnp.max(jnp.abs(jac.dx_cam)))
+    np.testing.assert_allclose(np.asarray(neu.dx_cam),
+                               np.asarray(jac.dx_cam), atol=1e-3 * scale)
+    np.testing.assert_allclose(np.asarray(two.dx_cam),
+                               np.asarray(jac.dx_cam), atol=1e-3 * scale)
+
+
+# ------------------------------------------------------- fallback ladder
+
+
+def test_fallback_encoding_round_trips():
+    for block, coarse in ((0, 0), (1, 0), (0, 1), (37, 1), (65535, 3)):
+        code = encode_precond_fallback(jnp.int32(block), jnp.int32(coarse))
+        got = decode_precond_fallback(int(code))
+        assert got == {"block": block, "coarse": coarse}
+    # Saturation: a block count beyond the radix clamps instead of
+    # corrupting the coarse field.
+    code = encode_precond_fallback(jnp.int32(FALLBACK_BLOCK_RADIX + 5),
+                                   jnp.int32(1))
+    assert decode_precond_fallback(int(code)) == {
+        "block": FALLBACK_BLOCK_RADIX - 1, "coarse": 1}
+
+
+def test_poisoned_coarse_degrades_to_base_apply_bitwise():
+    s, system, Jc, Jp, ci, pi = _system(num_cameras=5, num_points=25,
+                                        seed=4)
+    Nc = system.Hpp.shape[0]
+    region = jnp.asarray(80.0)
+    Hpp_d = damp_blocks(system.Hpp, region)
+    Hll_inv = block_inv_fm(damp_rows_fm(system.Hll, region))
+    plan = build_cluster_plan(s.cam_idx, s.pt_idx, Nc, 25)
+    dplan = device_cluster_plan(plan)
+    # Poison one camera block -> NaN rides into A_c -> ok=False.
+    Hpp_bad = Hpp_d.at[0, 0, 0].set(jnp.nan)
+    apply_bad, code = make_schur_preconditioner(
+        PrecondKind.TWO_LEVEL, PreconditionerKind.HPP, Hpp_bad, Hll_inv,
+        None, Jc, Jp, ci, pi, Nc, ComputeKind.IMPLICIT, None, False,
+        cluster_plan=dplan)
+    assert decode_precond_fallback(int(code)) == {"block": 0, "coarse": 1}
+    # The degraded apply IS the base block-Jacobi apply, bitwise (on
+    # the finite blocks; block 0's NaN block inverse is NaN both ways).
+    rng = np.random.default_rng(1)
+    r = jnp.asarray(rng.standard_normal((CD, Nc)))
+    want = cam_block_matvec(block_inv(Hpp_bad), r)
+    got = apply_bad(r)
+    np.testing.assert_array_equal(np.asarray(got)[:, 1:],
+                                  np.asarray(want)[:, 1:])
+    # Healthy build reports no fallback at either level.
+    _, code_ok = make_schur_preconditioner(
+        PrecondKind.TWO_LEVEL, PreconditionerKind.HPP, Hpp_d, Hll_inv,
+        None, Jc, Jp, ci, pi, Nc, ComputeKind.IMPLICIT, None, False,
+        cluster_plan=dplan)
+    assert decode_precond_fallback(int(code_ok)) == {"block": 0, "coarse": 0}
+
+
+def test_two_level_requires_cluster_plan():
+    s, system, Jc, Jp, ci, pi = _system(num_cameras=5, num_points=25,
+                                        seed=4)
+    with pytest.raises(ValueError, match="cluster plan"):
+        schur_pcg_solve(system, Jc, Jp, ci, pi, jnp.asarray(10.0),
+                        precond=PrecondKind.TWO_LEVEL)
+
+
+# ---------------------------------------------------------- validation
+
+
+def test_validate_options_rejects_bad_precond_configs():
+    def opt(**skw):
+        return ProblemOption(solver_option=SolverOption(**skw))
+
+    with pytest.raises(ValueError, match="neumann_order"):
+        validate_options(opt(precond=PrecondKind.NEUMANN, neumann_order=0))
+    with pytest.raises(ValueError, match="coarse_clusters"):
+        validate_options(opt(precond=PrecondKind.TWO_LEVEL,
+                             coarse_clusters=-1))
+    with pytest.raises(ValueError, match="use_schur"):
+        validate_options(dataclasses.replace(
+            opt(precond=PrecondKind.NEUMANN), use_schur=False))
+    validate_options(opt(precond=PrecondKind.TWO_LEVEL))  # clean
+
+
+# --------------------------------------------------------- cluster plan
+
+
+def test_camera_clusters_partition_and_cap():
+    s = make_synthetic_bal(num_cameras=20, num_points=120, obs_per_point=4,
+                           seed=5)
+    cluster = build_camera_clusters(s.cam_idx, s.pt_idx, 20)
+    assert cluster.shape == (20,)
+    C = int(cluster.max()) + 1
+    target = int(np.ceil(np.sqrt(20)))
+    assert C >= target
+    # Size cap: no cluster exceeds ceil(Nc / target).
+    _, counts = np.unique(cluster, return_counts=True)
+    assert counts.max() <= -(-20 // target)
+    # Every camera (including any edge-less one) is assigned.
+    assert np.all(cluster >= 0)
+
+
+def test_cluster_plan_index_streams_are_consistent():
+    s = make_synthetic_bal(num_cameras=9, num_points=50, obs_per_point=4,
+                           seed=6)
+    nE = len(s.cam_idx)
+    # Pad the stream like the solver does, with a mask.
+    pad = 8
+    cam_idx = np.concatenate([s.cam_idx, np.zeros(pad, np.int32)])
+    pt_idx = np.concatenate([s.pt_idx, np.zeros(pad, np.int32)])
+    mask = np.concatenate([np.ones(nE), np.zeros(pad)])
+    plan = build_cluster_plan(cam_idx, pt_idx, 9, 50, mask=mask,
+                              world_size=2)
+    C = plan.num_clusters
+    # pc: every real edge maps to the incidence of ITS (point, cluster);
+    # padding edges carry the inert slot.
+    for e in range(nE):
+        slot = plan.pc_slot[e]
+        assert slot < plan.n_pc
+        assert plan.pc_pt[slot] == pt_idx[e]
+    assert np.all(plan.pc_slot[nE:] == plan.n_pc)
+    # ec: Σ_e k_{pt(e)} real pairs; each pair couples an edge to an
+    # incidence of the same point, and its segment is cam*C + cluster
+    # of the slot.  Shard-local edge ids reassemble to global ones.
+    # (An incidence's cluster is recoverable from any edge mapping to
+    # it: cluster[cam_idx[e]] of an e with pc_slot[e] == slot.)
+    slot_cluster = np.full(plan.n_pc, -1)
+    for e in range(nE):
+        slot_cluster[plan.pc_slot[e]] = plan.cluster[cam_idx[e]]
+    ws, L = 2, plan.ec_edge.shape[0] // 2
+    n_real = 0
+    shard_edges = len(cam_idx) // ws
+    for k in range(ws):
+        for j in range(L):
+            seg = plan.ec_seg[k * L + j]
+            if seg == 9 * C:  # inert padding
+                continue
+            n_real += 1
+            ge = int(plan.ec_edge[k * L + j]) + k * shard_edges
+            slot = int(plan.ec_slot[k * L + j])
+            assert plan.pc_pt[slot] == pt_idx[ge]
+            assert seg == cam_idx[ge] * C + slot_cluster[slot]
+    assert n_real == plan.n_ec
+
+
+def test_cluster_plan_rides_content_cache():
+    s = make_synthetic_bal(num_cameras=8, num_points=40, obs_per_point=4,
+                           seed=7)
+    (p1, d1), hit1 = cached_cluster_plan(s.cam_idx, s.pt_idx, 8, 40)
+    (p2, d2), hit2 = cached_cluster_plan(s.cam_idx.copy(),
+                                         s.pt_idx.copy(), 8, 40)
+    assert not hit1 and hit2
+    assert p1 is p2
+    # A different target is a different plan.
+    (_, _), hit3 = cached_cluster_plan(s.cam_idx, s.pt_idx, 8, 40, 4)
+    assert not hit3
