@@ -160,6 +160,38 @@ def status_name(code) -> str:
         return f"unknown({int(code)})"
 
 
+# The statuses a fleet service should NOT hand back as-is: STALLED means
+# zero accepted steps (distrust the result), FATAL_NONFINITE means the
+# guards gave up.  Both are exactly the outcomes a re-solve under
+# stronger settings (guards armed, inflated damping, conservative
+# preconditioning, f64) can turn into a usable answer — the escalation
+# ladder in serving/resilience.py retries them automatically.
+RETRYABLE_STATUSES = frozenset(
+    {SolveStatus.STALLED, SolveStatus.FATAL_NONFINITE})
+
+
+def status_retryable(code, final_cost=None,
+                     statuses=RETRYABLE_STATUSES) -> bool:
+    """Should a fleet-level retry ladder re-solve this outcome?
+
+    True for a status in `statuses` (default `RETRYABLE_STATUSES`;
+    `EscalationPolicy.retry_statuses` passes its own set) and for any
+    solve whose final cost is non-finite regardless of its code: with
+    guards OFF a poisoned carry can still surface as MAX_ITER/CONVERGED
+    around a NaN cost (NaN comparisons reject every trial silently),
+    and delivering that result would defeat the ladder's purpose.
+    Unknown codes are retryable — never deliver something the service
+    cannot classify.
+    """
+    try:
+        retry = SolveStatus(int(code)) in statuses
+    except ValueError:
+        retry = True  # unknown code: never deliver silently
+    if final_cost is not None and not np.isfinite(float(final_cost)):
+        return True
+    return retry
+
+
 @dataclasses.dataclass(frozen=True)
 class RobustOption:
     """Fault-containment knobs (capability beyond the reference).

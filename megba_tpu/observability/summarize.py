@@ -10,7 +10,9 @@ table, the phase wall-clock breakdown, and memory stats when present.
 `--aggregate` switches to the FLEET view: one block over all reports in
 all given files — per-status counts, problems/sec, p50/p95 solve
 latency, and (when the reports carry the serving layer's `fleet`
-context) per-bucket problem counts — so a multi-problem run's JSONL is
+context) per-bucket problem counts plus the resilience counters
+(escalated attempts / retries / sheds / deadline misses / rejections
+and circuit-breaker transitions) — so a multi-problem run's JSONL is
 readable without ad-hoc scripts.
 """
 
@@ -150,6 +152,41 @@ def aggregate_reports(reports: List[SolveReport]) -> str:
                 buckets.get(rep.fleet["bucket"], 0) + 1)
     for bucket in sorted(buckets):
         lines.append(f"   bucket {bucket}: {buckets[bucket]} solves")
+
+    # Resilience view (PR 8): per-report escalation context, plus the
+    # service-lifetime counters embedded in each report's fleet.stats —
+    # the NEWEST report carries the most complete cumulative view
+    # (sheds never emit a report of their own, so only the embedded
+    # counters can account for them).  Known limit of a stream-only
+    # view: events AFTER the final successful report (e.g. sheds during
+    # close, or a run whose every problem was shed) are not in any
+    # report — the live `FleetStats.report()` is the authoritative
+    # in-process view.
+    fleet_reps = [r for r in reports if r.fleet]
+    if fleet_reps:
+        # One report is emitted PER ATTEMPT (a dispatch that raised
+        # emits none), so reports cannot count escalated PROBLEMS
+        # exactly — count escalated ATTEMPTS that produced a result
+        # instead; the exact re-enqueue total is the `retries` service
+        # counter printed beside it.
+        escalated = sum(1 for r in fleet_reps
+                        if (r.fleet.get("attempts") or 1) > 1)
+        max_rung = max((r.fleet.get("rung") or 0) for r in fleet_reps)
+        latest = max(fleet_reps,
+                     key=lambda r: (r.created_unix or 0.0))
+        stats = latest.fleet.get("stats") or {}
+        lines.append(
+            f"   resilience: {escalated} escalated attempts "
+            f"(max rung {max_rung}), "
+            f"{stats.get('retries', 0)} retries, "
+            f"{stats.get('sheds', 0)} shed, "
+            f"{stats.get('deadline_misses', 0)} deadline-missed, "
+            f"{stats.get('rejected', 0)} rejected")
+        lines.append(
+            f"   breaker: {stats.get('breaker_trips', 0)} trips / "
+            f"{stats.get('breaker_probes', 0)} probes / "
+            f"{stats.get('breaker_recoveries', 0)} recoveries / "
+            f"{stats.get('breaker_fast_fails', 0)} fast-fails")
     return "\n".join(lines)
 
 

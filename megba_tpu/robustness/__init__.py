@@ -23,14 +23,20 @@ Three pieces, spanning the solver stack:
 """
 
 from megba_tpu.robustness.faults import (  # noqa: F401
+    DispatchChaos,
     FaultPlan,
+    InjectedDispatchError,
+    close_fault_window,
     fault_active,
     fault_partition_specs,
+    inert_fault_plan,
     lower_edge_vector,
+    lower_fault_plan,
     make_nan_burst,
     make_point_indefinite_burst,
     poison_residuals,
     poison_system,
+    stack_fault_plans,
     with_offset,
 )
 from megba_tpu.robustness.harness import (  # noqa: F401

@@ -37,6 +37,8 @@ injection ops from the program altogether.
 from __future__ import annotations
 
 import dataclasses
+import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -104,6 +106,79 @@ def with_offset(plan: FaultPlan, offset: int) -> FaultPlan:
     return dataclasses.replace(plan, offset=np.int32(offset))
 
 
+def inert_fault_plan(n_edges: int, n_points: int = 0,
+                     dtype=np.float32) -> FaultPlan:
+    """A plan whose window never opens: zero poison, window [0, 0).
+
+    The serving chaos harness stacks one plan per batch lane; lanes
+    without a seeded fault ride an inert plan so every lane of the
+    faulted program sees an identical operand STRUCTURE.  An inert
+    plan's injection is the documented `+ 0.0` / `* 1.0` no-op, and —
+    decisive for the batch-mate-isolation contract — two runs that
+    differ only in ANOTHER lane's plan rows keep this lane's operands
+    bit-identical, so its trajectory is bitwise unchanged.
+    """
+    return FaultPlan(
+        edge_nan=np.zeros((n_edges,), dtype),
+        point_crush=np.zeros((n_points,), dtype),
+        window=np.zeros((2,), np.int32),
+        offset=np.int32(0),
+    )
+
+
+def close_fault_window(plan: FaultPlan) -> FaultPlan:
+    """The plan with its window forced shut ([0, 0)) — the unpoisoned
+    CONTROL for chaos experiments: same program, same operand shapes,
+    only the poison gate differs."""
+    return dataclasses.replace(plan, window=np.zeros((2,), np.int32))
+
+
+def lower_fault_plan(plan: FaultPlan, *, n_edges: int, n_points: int,
+                     dtype, perm: Optional[np.ndarray] = None) -> FaultPlan:
+    """Lower one plan onto a padded shape class (serving layer).
+
+    `edge_nan` rides the same camera-sort permutation the padded
+    problem's edges took (`perm`, from shape_class.pad_to_class) and is
+    zero-padded to the bucket's edge count; `point_crush` is zero-padded
+    to the bucket's point count (padding points are fixed identity
+    blocks — crushing them is meaningless, so zeros are exact).  A plan
+    built without an edge/point axis (size 0) lowers to all-zeros.
+    """
+    edge = np.asarray(plan.edge_nan).astype(dtype, copy=False)
+    if edge.shape[0] == 0:
+        edge = np.zeros((n_edges,), dtype)
+    else:
+        edge = lower_edge_vector(edge, perm=perm, n_padded=n_edges)
+    if edge.shape[0] != n_edges:
+        raise ValueError(
+            f"fault plan edge_nan has {np.asarray(plan.edge_nan).shape[0]} "
+            f"edges; problem lowers to {n_edges}")
+    crush = np.asarray(plan.point_crush).astype(dtype, copy=False)
+    if crush.shape[0] > n_points:
+        raise ValueError(
+            f"fault plan point_crush has {crush.shape[0]} points; bucket "
+            f"holds {n_points}")
+    if crush.shape[0] < n_points:
+        crush = np.concatenate(
+            [crush, np.zeros((n_points - crush.shape[0],), dtype)])
+    return FaultPlan(edge_nan=edge, point_crush=crush,
+                     window=np.asarray(plan.window, np.int32),
+                     offset=np.int32(plan.offset))
+
+
+def stack_fault_plans(plans: Sequence[FaultPlan]) -> FaultPlan:
+    """Stack same-shape plans onto a leading lane axis (vmap operand).
+
+    The batched faulted program (serving/compile_pool.py) vmaps the LM
+    solve with in_axes=0 on the plan pytree; each lane reads only its
+    own rows, so a poisoned lane and its inert batch-mates share one
+    compiled program while staying numerically independent.
+    """
+    if not plans:
+        raise ValueError("stack_fault_plans needs at least one plan")
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *plans)
+
+
 def fault_active(plan: FaultPlan, k) -> jax.Array:
     """Replicated bool scalar: is the window open at local iteration k?"""
     g = jnp.asarray(k, jnp.int32) + plan.offset
@@ -143,6 +218,77 @@ def fault_partition_specs():
 
     return FaultPlan(edge_nan=P(EDGE_AXIS), point_crush=P(),
                      window=P(), offset=P())
+
+
+class InjectedDispatchError(RuntimeError):
+    """The exception DispatchChaos raises — distinguishable from real
+    dispatch failures in logs and assertions."""
+
+
+@dataclasses.dataclass
+class DispatchChaos:
+    """Deterministic host-level chaos for the fleet dispatch path.
+
+    Where `FaultPlan` poisons the NUMERICS inside a compiled program,
+    this poisons the SERVICE around it: the dispatcher consults
+    `before_dispatch(bucket)` right after taking a batch, and the hook
+    either raises `InjectedDispatchError` (driving the retry /
+    circuit-breaker paths) or sleeps `delay_s` (driving deadline-miss
+    pressure without racing the wall clock).
+
+    Determinism: `fail_first` fails the first N dispatches of every
+    matching bucket — exact, order-independent per bucket.  `fail_rate`
+    additionally fails a seeded pseudo-random subset: each bucket gets
+    its own `np.random.default_rng` derived from (`seed`, bucket name),
+    so a fixed submission order replays the identical failure sequence.
+    `buckets` (names as `str(ShapeClass)`) restricts chaos to specific
+    buckets; None means all.
+    """
+
+    fail_first: int = 0
+    fail_rate: float = 0.0
+    delay_s: float = 0.0
+    seed: int = 0
+    buckets: Optional[frozenset] = None
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.fail_rate <= 1.0:
+            raise ValueError(f"fail_rate must be in [0, 1], got "
+                             f"{self.fail_rate}")
+        if self.fail_first < 0 or self.delay_s < 0:
+            raise ValueError("fail_first and delay_s must be >= 0")
+        self._lock = threading.Lock()
+        self._counts: dict = {}
+        self._rngs: dict = {}
+
+    def dispatches(self, bucket: str) -> int:
+        """How many dispatches this bucket has seen (tests/telemetry)."""
+        with self._lock:
+            return self._counts.get(bucket, 0)
+
+    def before_dispatch(self, bucket: str) -> None:
+        """Called by the dispatcher with the bucket's name; raises
+        `InjectedDispatchError` when this dispatch is chosen to fail."""
+        if self.buckets is not None and bucket not in self.buckets:
+            return
+        with self._lock:
+            n = self._counts.get(bucket, 0)
+            self._counts[bucket] = n + 1
+            if self.fail_rate > 0.0:
+                rng = self._rngs.get(bucket)
+                if rng is None:
+                    rng = np.random.default_rng(np.random.SeedSequence(
+                        [self.seed, *bucket.encode()]))
+                    self._rngs[bucket] = rng
+                roll = float(rng.random())
+            else:
+                roll = 1.0
+        if self.delay_s > 0.0:
+            time.sleep(self.delay_s)
+        if n < self.fail_first or roll < self.fail_rate:
+            raise InjectedDispatchError(
+                f"chaos: injected dispatch failure #{n} for bucket "
+                f"{bucket}")
 
 
 def lower_edge_vector(vec: np.ndarray, perm: Optional[np.ndarray] = None,

@@ -15,13 +15,29 @@ problems do it:
   dispatch-only.
 - queue.py — `FleetQueue`: async submission with Future handles and
   deadline-based batch flush (max-wait / max-batch knobs).
+- resilience.py — the policy layer that makes the service survive bad
+  outcomes: per-problem deadlines (shed before dispatch, flagged
+  after), a bounded retry-with-escalation ladder (`EscalationPolicy`),
+  admission control (`RejectPolicy` + max_pending), and a per-bucket
+  circuit breaker with half-open probes.
 - stats.py — `FleetStats`: problems/sec at fixed convergence, bucket
-  occupancy, padding waste, compile-pool hit rate.
+  occupancy, padding waste, compile-pool hit rate, plus the resilience
+  counters (sheds, retries, rejections, breaker transitions).
 """
 
 from megba_tpu.serving.batcher import FleetProblem, FleetResult, solve_many
 from megba_tpu.serving.compile_pool import CompilePool, lower_bucket
 from megba_tpu.serving.queue import FleetQueue
+from megba_tpu.serving.resilience import (
+    BreakerPolicy,
+    BreakerState,
+    BucketTripped,
+    CircuitBreaker,
+    DeadlineExceeded,
+    EscalationPolicy,
+    QueueRejected,
+    RejectPolicy,
+)
 from megba_tpu.serving.shape_class import (
     BucketLadder,
     PaddedProblem,
@@ -32,13 +48,21 @@ from megba_tpu.serving.shape_class import (
 from megba_tpu.serving.stats import FleetStats
 
 __all__ = [
+    "BreakerPolicy",
+    "BreakerState",
     "BucketLadder",
+    "BucketTripped",
+    "CircuitBreaker",
     "CompilePool",
+    "DeadlineExceeded",
+    "EscalationPolicy",
     "FleetProblem",
     "FleetQueue",
     "FleetResult",
     "FleetStats",
     "PaddedProblem",
+    "QueueRejected",
+    "RejectPolicy",
     "ShapeClass",
     "classify",
     "lower_bucket",

@@ -57,6 +57,13 @@ class FleetProblem:
     cam_idx: np.ndarray  # [nE]
     pt_idx: np.ndarray  # [nE]
     name: str = ""
+    # Optional seeded fault (robustness/faults.FaultPlan, NATURAL edge
+    # order) — the serving chaos harness's injection point.  A problem
+    # carrying a plan rides the batched FAULTED program (its plan
+    # lowered through the same sort/padding as its edges, batch-mates
+    # on inert plans); problems without plans in a plan-free batch ride
+    # the ordinary program unchanged.
+    fault_plan: Optional[Any] = None
 
     @classmethod
     def from_synthetic(cls, s, name: str = "") -> "FleetProblem":
@@ -88,6 +95,17 @@ class FleetResult:
     recoveries: int
     latency_s: float  # batch wall clock this problem rode
     trace: Optional[SolveTrace] = None  # per-lane convergence history
+    # -- fleet-resilience context (serving/resilience.py) ---------------
+    # True when the result completed AFTER the submitted deadline (it is
+    # delivered anyway, but never silently).
+    deadline_missed: bool = False
+    # Escalation history: total attempts (1 = first try succeeded), the
+    # rung this result solved at, and one record per PRIOR attempt
+    # ({"rung", "status", "status_name", "error"}) — the error field
+    # carries dispatch-level exceptions, status the solve outcomes.
+    attempts: int = 1
+    rung: int = 0
+    history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     @property
     def status_name(self) -> str:
@@ -177,28 +195,70 @@ def _solve_bucket(
     timer: PhaseTimer,
     telemetry: Optional[str],
     report_option: ProblemOption,
+    *,
+    initial_region: Optional[float] = None,
+    rung: int = 0,
+    attempts: int = 1,
 ) -> List[Tuple[int, FleetResult]]:
-    """Solve one bucket's problems in a single batched dispatch."""
+    """Solve one bucket's problems in a single batched dispatch.
+
+    `initial_region` overrides the option's trust-region start (an
+    OPERAND — the escalation ladder's damping inflation rides the same
+    compiled program).  `rung`/`attempts` are the escalation context
+    stamped onto results and telemetry (rung 0 / attempt 1 = a plain
+    first try).  Any item carrying a `FleetProblem.fault_plan` switches
+    the batch onto the FAULTED program variant with per-lane plans
+    (inert for unpoisoned lanes) — the serving chaos path.
+    """
     dtype = np.dtype(option.dtype)
     n_real = len(items)
     lanes = ladder.bucket_lanes(n_real)
     phases_before = timer.as_dict()
+    faulted = any(p.fault_plan is not None for _, p in items)
     with timer.phase("lowering"):
         padded = [pad_to_class(p.cameras, p.points, p.obs, p.cam_idx,
                                p.pt_idx, shape) for _, p in items]
         operands = _stack_bucket(padded, lanes, dtype)
+        plan_stack = None
+        if faulted:
+            from megba_tpu.robustness.faults import (
+                inert_fault_plan,
+                lower_fault_plan,
+                stack_fault_plans,
+            )
+
+            plans = []
+            for (_, p), pp in zip(items, padded):
+                if p.fault_plan is None:
+                    plans.append(inert_fault_plan(
+                        shape.n_edge, shape.n_pt, dtype))
+                else:
+                    plans.append(lower_fault_plan(
+                        p.fault_plan, n_edges=shape.n_edge,
+                        n_points=shape.n_pt, dtype=dtype, perm=pp.perm))
+            # Lane padding repeats lane 0's operands (_stack_bucket), so
+            # it must repeat lane 0's plan too — a padding lane then
+            # behaves exactly like its original and cannot extend the
+            # while-loop horizon past the real lanes'.
+            plans.extend(plans[0] for _ in range(lanes - len(plans)))
+            plan_stack = stack_fault_plans(plans)
     cd = operands[0].shape[1]
     pd = operands[1].shape[1]
     od = operands[2].shape[1]
 
     with timer.phase("program"):
-        program = pool.program(engine, option, shape, lanes, cd, pd, od)
-    ir = jnp.asarray(option.algo_option.initial_region, dtype)
+        program = pool.program(engine, option, shape, lanes, cd, pd, od,
+                               faulted=faulted)
+    ir = jnp.asarray(option.algo_option.initial_region
+                     if initial_region is None else initial_region, dtype)
     iv = jnp.asarray(2.0, dtype)
 
     t0 = time.perf_counter()
     with timer.phase("dispatch"):
-        result = program(*operands, ir, iv)
+        if faulted:
+            result = program(*operands, ir, iv, plan_stack)
+        else:
+            result = program(*operands, ir, iv)
     with timer.phase("execute") as ph:
         ph.sync(result.cost)
     wall = time.perf_counter() - t0
@@ -226,6 +286,8 @@ def _solve_bucket(
             recoveries=int(lane_res.recoveries),
             latency_s=wall,
             trace=lane_res.trace,
+            rung=rung,
+            attempts=attempts,
         )
         out.append((orig_i, fr))
         if telemetry and jax.process_index() == 0:
@@ -249,6 +311,8 @@ def _solve_bucket(
                 "batch_problems": n_real,
                 "latency_s": wall,
                 "batch_problems_per_sec": n_real / wall if wall > 0 else 0.0,
+                "rung": rung,
+                "attempts": attempts,
                 "stats": stats.as_dict(),
             }
             append_report(

@@ -56,7 +56,7 @@ _WARMING: set = set()
 _LOCK = threading.Lock()
 
 
-def _build_batched_solve(residual_jac_fn, option):
+def _build_batched_solve(residual_jac_fn, option, faulted=False):
     """The batched mega-solve: `vmap`'d LM over a leading problem axis.
 
     Every lane carries its own problem (parameters, observations,
@@ -68,38 +68,61 @@ def _build_batched_solve(residual_jac_fn, option):
     predicate clears.  Per-lane `SolveStatus`, trace and cost come back
     as leading-axis stacks on the returned LMResult pytree.
 
+    `faulted=True` builds the CHAOS variant: a per-lane
+    `robustness.faults.FaultPlan` pytree (stacked on the lane axis,
+    in_axes=0) rides as one extra operand, so a poisoned lane and its
+    inert batch-mates share a single compiled program — the serving
+    chaos harness's isolation contract lives on this path.  It is a
+    separate retrace-sentinel site (`serving.batched_faulted`) so the
+    <=1-compile-per-bucket certification stays per-variant.
+
     The parameter stacks are donated (same rationale as
     solve._build_single_solve): the batcher stacks fresh operands per
     batch and never reads them back.
     """
 
     def one(cameras, points, obs, cam_idx, pt_idx, mask, cam_fixed,
-            pt_fixed, init_region, init_v):
+            pt_fixed, init_region, init_v, fault_plan=None):
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
             option, cam_fixed=cam_fixed, pt_fixed=pt_fixed,
             cam_sorted=True, initial_region=init_region,
-            initial_v=init_v)
+            initial_v=init_v, fault_plan=fault_plan)
 
-    batched = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None))
+    if faulted:
+        batched = jax.vmap(
+            one, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None, 0))
+        site = "serving.batched_faulted"
+    else:
+        batched = jax.vmap(one,
+                           in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None))
+        site = "serving.batched"
     return jax.jit(
-        traced("serving.batched", batched,
-               static=static_key(residual_jac_fn, option, "batched")),
+        traced(site, batched,
+               static=static_key(residual_jac_fn, option, site)),
         donate_argnums=(0, 1))
 
 
 # Long-lived engines only (make_residual_jacobian_fn is itself memoised,
 # so the default BAL engines qualify); mirrors _cached_single_solve.
-batched_solve_program = functools.lru_cache(maxsize=64)(_build_batched_solve)
+_cached_batched_solve = functools.lru_cache(maxsize=64)(_build_batched_solve)
+
+
+def batched_solve_program(residual_jac_fn, option, faulted=False):
+    """Call-shape-normalising front for the lru cache: positional,
+    keyword and defaulted spellings of `faulted` must hit ONE entry (the
+    same double-cache footgun make_residual_jacobian_fn fixed in PR 6 —
+    two entries would mean two jit wrappers and a duplicate trace)."""
+    return _cached_batched_solve(residual_jac_fn, option, bool(faulted))
 
 
 def _abstract_args(shape: ShapeClass, lanes: int, cd: int, pd: int,
-                   od: int) -> Tuple:
+                   od: int, faulted: bool = False) -> Tuple:
     """ShapeDtypeStructs matching the batcher's operand layout
     (feature-major stacks, leading lane axis)."""
     dt = np.dtype(shape.dtype)
     s = jax.ShapeDtypeStruct
-    return (
+    args = (
         s((lanes, cd, shape.n_cam), dt),  # cameras
         s((lanes, pd, shape.n_pt), dt),  # points
         s((lanes, od, shape.n_edge), dt),  # obs
@@ -111,23 +134,34 @@ def _abstract_args(shape: ShapeClass, lanes: int, cd: int, pd: int,
         s((), dt),  # init_region
         s((), dt),  # init_v
     )
+    if faulted:
+        from megba_tpu.robustness.faults import FaultPlan
+
+        args = args + (FaultPlan(
+            edge_nan=s((lanes, shape.n_edge), dt),
+            point_crush=s((lanes, shape.n_pt), dt),
+            window=s((lanes, 2), np.int32),
+            offset=s((lanes,), np.int32)),)
+    return args
 
 
 def pool_key(engine, option, shape: ShapeClass, lanes: int, cd: int,
-             pd: int, od: int) -> Tuple:
-    return (engine, option, shape, int(lanes), int(cd), int(pd), int(od))
+             pd: int, od: int, faulted: bool = False) -> Tuple:
+    return (engine, option, shape, int(lanes), int(cd), int(pd), int(od),
+            bool(faulted))
 
 
 def lower_bucket(engine, option, shape: ShapeClass, lanes: int,
-                 cd: int = 9, pd: int = 3, od: int = 2):
+                 cd: int = 9, pd: int = 3, od: int = 2,
+                 faulted: bool = False):
     """AOT-lower one bucket program (`jax.stages.Lowered`).
 
     The compiled-program auditor's entry point for the batched canonical
     program (`ba_batched_b4_f32`): same builder, same operand layout,
     same donation flags as production dispatch.
     """
-    jitted = batched_solve_program(engine, option)
-    return jitted.lower(*_abstract_args(shape, lanes, cd, pd, od))
+    jitted = batched_solve_program(engine, option, faulted)
+    return jitted.lower(*_abstract_args(shape, lanes, cd, pd, od, faulted))
 
 
 class CompilePool:
@@ -147,10 +181,10 @@ class CompilePool:
 
     # -- dispatch path ---------------------------------------------------
     def program(self, engine, option, shape: ShapeClass, lanes: int,
-                cd: int, pd: int, od: int):
+                cd: int, pd: int, od: int, faulted: bool = False):
         """Callable for one bucket; prefers the AOT executable."""
-        key = pool_key(engine, option, shape, lanes, cd, pd, od)
-        self._note(key, shape, lanes, cd, pd, od)
+        key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
+        self._note(key, shape, lanes, cd, pd, od, faulted)
         with _LOCK:
             compiled = _AOT.get(key)
             hit = compiled is not None or key in _DISPATCHED
@@ -158,7 +192,7 @@ class CompilePool:
             self._stats.record_pool(hit)
         if compiled is not None:
             return compiled
-        jitted = batched_solve_program(engine, option)
+        jitted = batched_solve_program(engine, option, faulted)
 
         def run(*args):
             out = jitted(*args)
@@ -185,15 +219,16 @@ class CompilePool:
             lanes = int(e["lanes"])
             cd, pd, od = int(e.get("cd", 9)), int(e.get("pd", 3)), \
                 int(e.get("od", 2))
-            key = pool_key(engine, option, shape, lanes, cd, pd, od)
-            self._note(key, shape, lanes, cd, pd, od)
+            faulted = bool(e.get("faulted", False))
+            key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
+            self._note(key, shape, lanes, cd, pd, od, faulted)
             with _LOCK:
                 if key in _AOT or key in _DISPATCHED or key in _WARMING:
                     continue
                 _WARMING.add(key)
             try:
                 compiled = lower_bucket(engine, option, shape, lanes,
-                                        cd, pd, od).compile()
+                                        cd, pd, od, faulted).compile()
                 with _LOCK:
                     _AOT[key] = compiled
             finally:
@@ -204,11 +239,15 @@ class CompilePool:
 
     # -- manifests -------------------------------------------------------
     def _note(self, key: Tuple, shape: ShapeClass, lanes: int, cd: int,
-              pd: int, od: int) -> None:
+              pd: int, od: int, faulted: bool = False) -> None:
+        entry = {"shape": shape.to_dict(), "lanes": int(lanes),
+                 "cd": int(cd), "pd": int(pd), "od": int(od)}
+        if faulted:
+            # Additive manifest field: pre-PR-8 manifests (no key) read
+            # back as the plain program, which is what they warmed.
+            entry["faulted"] = True
         with self._lock:
-            self._seen.setdefault(key, {
-                "shape": shape.to_dict(), "lanes": int(lanes),
-                "cd": int(cd), "pd": int(pd), "od": int(od)})
+            self._seen.setdefault(key, entry)
 
     def entries(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -37,7 +37,7 @@ logarithmic in the problem-size range.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
@@ -157,6 +157,11 @@ class PaddedProblem:
     n_cam: int
     n_pt: int
     n_edge: int
+    # The camera-sort permutation the REAL edges took (None if they were
+    # already sorted): any per-edge side-channel vector — e.g. a
+    # FaultPlan's edge_nan (robustness/faults.lower_fault_plan) — must
+    # ride the same reorder to land on the same physical edges.
+    perm: Optional[np.ndarray] = None
 
 
 def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
@@ -186,6 +191,7 @@ def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
             f"problem ({n_cam} cams, {n_pt} pts, {n_edge} edges) does not "
             f"fit shape class {shape}")
 
+    perm = None
     if not is_cam_sorted(cam_idx):
         perm = sort_edges_by_camera(cam_idx, n_cam)
         cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
@@ -213,4 +219,4 @@ def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
         shape=shape, cameras=cameras, points=points, obs=obs,
         cam_idx=cam_idx, pt_idx=pt_idx, mask=mask,
         cam_fixed=cam_fixed, pt_fixed=pt_fixed,
-        n_cam=n_cam, n_pt=n_pt, n_edge=n_edge)
+        n_cam=n_cam, n_pt=n_pt, n_edge=n_edge, perm=perm)

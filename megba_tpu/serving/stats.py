@@ -34,6 +34,17 @@ class FleetStats:
         self.pool_hits = 0  # dispatches served by an already-built program
         self.pool_misses = 0  # dispatches that had to build/compile
         self.per_bucket: Dict[str, Dict[str, int]] = {}
+        # -- resilience counters (serving/resilience.py mechanisms) ------
+        self.sheds = 0  # problems shed before dispatch (deadline expired)
+        self.deadline_misses = 0  # results delivered AFTER their deadline
+        self.retries = 0  # escalation re-enqueues (ladder rungs climbed)
+        self.retries_by_rung: Dict[int, int] = {}  # target rung -> count
+        self.rejected = 0  # submits refused by admission control
+        self.breaker_trips = 0  # bucket breakers opened
+        self.breaker_probes = 0  # half-open probe batches admitted
+        self.breaker_recoveries = 0  # probes that closed the breaker
+        self.breaker_fast_fails = 0  # submits failed fast on a tripped bucket
+        self.queue_depth_peak = 0  # max pending problems ever observed
 
     # -- recording -------------------------------------------------------
     def record_batch(self, bucket: str, lanes: int, n_real: int,
@@ -58,6 +69,42 @@ class FleetStats:
                 self.pool_hits += 1
             else:
                 self.pool_misses += 1
+
+    # -- resilience recording (called by FleetQueue under its own lock,
+    # but kept self-locking so direct callers stay safe) ----------------
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.sheds += n
+
+    def record_deadline_miss(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_misses += n
+
+    def record_retry(self, rung: int) -> None:
+        """One problem re-enqueued at escalation rung `rung`."""
+        with self._lock:
+            self.retries += 1
+            self.retries_by_rung[int(rung)] = (
+                self.retries_by_rung.get(int(rung), 0) + 1)
+
+    def record_reject(self, n: int = 1) -> None:
+        with self._lock:
+            self.rejected += n
+
+    def record_breaker(self, event: str) -> None:
+        """One breaker transition: trip / probe / recover / fast_fail."""
+        field = {"trip": "breaker_trips", "probe": "breaker_probes",
+                 "recover": "breaker_recoveries",
+                 "fast_fail": "breaker_fast_fails"}.get(event)
+        if field is None:
+            raise ValueError(f"unknown breaker event {event!r}")
+        with self._lock:
+            setattr(self, field, getattr(self, field) + 1)
+
+    def record_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.queue_depth_peak:
+                self.queue_depth_peak = depth
 
     # -- derived metrics -------------------------------------------------
     def problems_per_sec(self) -> float:
@@ -101,6 +148,17 @@ class FleetStats:
                 "pool_misses": self.pool_misses,
                 "per_bucket": {k: dict(v)
                                for k, v in self.per_bucket.items()},
+                "sheds": self.sheds,
+                "deadline_misses": self.deadline_misses,
+                "retries": self.retries,
+                "retries_by_rung": {str(k): v for k, v
+                                    in self.retries_by_rung.items()},
+                "rejected": self.rejected,
+                "breaker_trips": self.breaker_trips,
+                "breaker_probes": self.breaker_probes,
+                "breaker_recoveries": self.breaker_recoveries,
+                "breaker_fast_fails": self.breaker_fast_fails,
+                "queue_depth_peak": self.queue_depth_peak,
             }
         base["problems_per_sec"] = self.problems_per_sec()
         base["padding_waste"] = self.padding_waste()
@@ -119,6 +177,17 @@ class FleetStats:
             f"  compile pool: {d['pool_hits']} hits / {d['pool_misses']} "
             f"misses ({100 * d['pool_hit_rate']:.0f}% hit rate)",
         ]
+        if (d["sheds"] or d["retries"] or d["rejected"]
+                or d["deadline_misses"] or d["breaker_trips"]
+                or d["breaker_fast_fails"]):
+            lines.append(
+                f"  resilience: {d['retries']} retries, {d['sheds']} shed, "
+                f"{d['deadline_misses']} deadline-missed, "
+                f"{d['rejected']} rejected; breaker: {d['breaker_trips']} "
+                f"trips / {d['breaker_probes']} probes / "
+                f"{d['breaker_recoveries']} recoveries / "
+                f"{d['breaker_fast_fails']} fast-fails "
+                f"(peak depth {d['queue_depth_peak']})")
         for bucket, occ in sorted(d["bucket_occupancy"].items()):
             b = d["per_bucket"][bucket]
             lines.append(

@@ -193,3 +193,175 @@ for world in (1, 2):
     assert gap <= 1e-5, f"world {world}: recovered cost off by {gap:.2e}"
 PY
 echo "fault-injection smoke OK"
+
+# Serving chaos smoke (ISSUE 8): a 16-problem mixed fleet through a
+# resilient FleetQueue — 2 NaN-poisoned problems must heal via the
+# escalation ladder (RECOVERED at rung >= 1), 1 deadline-doomed problem
+# must be shed before dispatch, and the 13 clean problems must land
+# BITWISE at parity with an unpoisoned solve_many control (same
+# batches, only the poison gate differs).  A chaos-tripped bucket must
+# fail submits fast; escalated re-solves certify <= 1 compile per
+# (bucket, rung) via the retrace sentinel; the dispatcher thread must
+# survive all of it; and `summarize --aggregate` must render the
+# retry/shed/deadline-miss/breaker counters from the report stream.
+CHAOS_SINK=$(mktemp /tmp/megba_chaos_smoke.XXXXXX.jsonl)
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK"' EXIT
+JAX_PLATFORMS=cpu MEGBA_CHAOS_SINK="$CHAOS_SINK" python - <<'PY'
+import dataclasses
+import os
+
+import numpy as np
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.analysis import retrace
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption, SolveStatus
+from megba_tpu.io.synthetic import make_fleet
+from megba_tpu.observability import summarize
+from megba_tpu.robustness.faults import (
+    DispatchChaos, InjectedDispatchError, close_fault_window, make_nan_burst)
+from megba_tpu.serving import (
+    BreakerPolicy, BucketTripped, BucketLadder, EscalationPolicy,
+    DeadlineExceeded, FleetProblem, FleetQueue, FleetStats, classify,
+    solve_many)
+
+OPT = ProblemOption(dtype=np.float64, algo_option=AlgoOption(max_iter=6),
+                    solver_option=SolverOption(max_iter=12, tol=1e-10))
+sink = os.environ["MEGBA_CHAOS_SINK"]
+
+fleet = [FleetProblem.from_synthetic(s, name=f"chaos{i}")
+         for i, s in enumerate(make_fleet(16, size_range=(12, 96), seed=0,
+                                          dtype=np.float64))]
+ladder = BucketLadder()
+buckets = {}
+for i, p in enumerate(fleet):
+    buckets.setdefault(classify(*p.dims(), OPT.dtype, ladder), []).append(i)
+# poison 2 members of the most-populated bucket (they need clean
+# batch-mates to prove isolation); doom one problem from another bucket
+big = max(buckets.values(), key=len)
+poisoned_idx = set(big[:2])
+doomed_idx = next(i for i in range(16) if i not in poisoned_idx
+                  and i not in set(big))
+
+def poison(p):
+    plan = make_nan_burst(p.obs.shape[0], [1, 5], start=0, stop=1,
+                          n_points=p.points.shape[0], dtype=np.float64)
+    return dataclasses.replace(p, fault_plan=plan)
+
+submitted = [poison(p) if i in poisoned_idx else p
+             for i, p in enumerate(fleet)]
+
+# --- phase 1: breaker trip + fast-fail (chaos dies pre-solve) ---------
+# Two SAME-bucket problems fail consecutively (the heterogeneous fleet
+# spans several buckets; the breaker is per bucket, so the trip must
+# come from one bucket's own streak), then a third submit to that
+# bucket must fail fast.
+assert len(big) >= 3, buckets
+stats = FleetStats()
+chaos = DispatchChaos(fail_first=99)
+with FleetQueue(OPT, max_batch=1, max_wait_s=0.0, stats=stats, chaos=chaos,
+                breaker=BreakerPolicy(trip_after=2, cooldown_s=600.0)) as q:
+    for i in big[:2]:
+        try:
+            q.submit(fleet[i]).result(timeout=60)
+            raise AssertionError("injected dispatch failure did not fire")
+        except InjectedDispatchError:
+            pass
+    try:
+        q.submit(fleet[big[2]])
+        raise AssertionError("tripped bucket accepted a submit")
+    except BucketTripped as e:
+        print("chaos smoke: tripped-bucket fast-fail OK:", e)
+assert stats.breaker_trips == 1 and stats.breaker_fast_fails == 1, (
+    stats.as_dict())
+
+# --- phase 2: the mixed fleet through the resilient queue -------------
+base = retrace.snapshot()
+opt_tele = dataclasses.replace(OPT, telemetry=sink)
+with FleetQueue(opt_tele, max_batch=16, max_wait_s=30.0, stats=stats,
+                escalation=EscalationPolicy(backoff_base_s=0.01,
+                                            seed=0)) as q:
+    futs = []
+    for i, p in enumerate(submitted):
+        futs.append(q.submit(p, deadline_s=0.0 if i == doomed_idx
+                             else None))
+    q.flush()
+    assert q._thread.is_alive(), "dispatcher thread died"
+    assert all(f.done() for f in futs), "flush returned with open futures"
+    results = {}
+    shed = None
+    for i, f in enumerate(futs):
+        try:
+            results[i] = f.result(timeout=1)
+        except DeadlineExceeded:
+            shed = i
+
+new = {k: v - base.get(k, 0) for k, v in retrace.snapshot().items()
+       if k[0].startswith("serving.batched") and v > base.get(k, 0)}
+assert all(d <= 1 for d in new.values()), (
+    f"duplicate batched-program trace (cache bust): {new}")
+print(f"chaos smoke: {sum(new.values())} batched programs traced, "
+      "<= 1 per (bucket, rung)")
+
+assert shed == doomed_idx, f"doomed problem {doomed_idx} was not shed"
+for i in poisoned_idx:
+    r = results[i]
+    assert r.status == int(SolveStatus.RECOVERED), (i, r.status_name)
+    assert r.attempts == 2 and r.rung == 1, (r.attempts, r.rung)
+    assert r.history[0]["status"] in (int(SolveStatus.STALLED),
+                                      int(SolveStatus.FATAL_NONFINITE))
+    assert np.isfinite(float(r.cost))
+print(f"chaos smoke: {len(poisoned_idx)} poisoned problems RECOVERED "
+      "via escalation")
+
+# --- clean-problem parity: bitwise vs the unpoisoned control ----------
+# Control = the same fleet minus the doomed problem, poison windows
+# CLOSED: identical batch compositions and operands except the poison
+# gate, so clean results must be bit-identical.
+control_probs = [dataclasses.replace(
+                     p, fault_plan=close_fault_window(p.fault_plan))
+                 if p.fault_plan is not None else p
+                 for i, p in enumerate(submitted) if i != doomed_idx]
+control = solve_many(control_probs, OPT, ladder=ladder)
+ctrl = {}
+k = 0
+for i in range(16):
+    if i == doomed_idx:
+        continue
+    ctrl[i] = control[k]
+    k += 1
+clean = [i for i in range(16)
+         if i not in poisoned_idx and i != doomed_idx]
+assert len(clean) == 13
+for i in clean:
+    r, c = results[i], ctrl[i]
+    assert int(r.status) == int(c.status), (i, r.status_name, c.status_name)
+    assert r.cameras.tobytes() == c.cameras.tobytes(), (
+        f"clean problem {i}: params drifted from the unpoisoned control")
+    assert r.cost.tobytes() == c.cost.tobytes(), i
+    assert not r.deadline_missed and r.attempts == 1
+print("chaos smoke: 13 clean problems BITWISE at parity with the "
+      "unpoisoned control")
+
+d = stats.as_dict()
+assert d["sheds"] == 1 and d["retries"] == 2, d
+assert d["breaker_trips"] == 1, d
+
+# --- aggregate CLI surfaces the resilience counters -------------------
+out = summarize.aggregate_paths([sink])
+print(out)
+assert "status recovered: 2" in out, out
+assert "2 escalated attempts (max rung 1)" in out, out
+assert "2 retries" in out and "1 shed" in out, out
+assert "breaker: 1 trips" in out, out
+assert summarize.main(["--aggregate", sink]) == 0
+PY
+echo "serving chaos smoke OK"

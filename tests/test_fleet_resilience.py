@@ -1,0 +1,620 @@
+"""Fleet-resilience tests: deadlines, escalation, admission, breaker.
+
+Chaos-path coverage for serving/resilience.py + the FleetQueue surgery
+(ISSUE 8): deadline shed before/after dispatch, the escalation ladder
+rung by rung (a poisoned problem heals at rung >= 1 while clean
+batch-mates stay bitwise identical to an unpoisoned run), breaker
+trip / half-open / recovery, admission-control reject vs. block, and
+deterministic backoff under a fixed seed.
+
+Compile discipline (tier-1 is at ~80% of its budget): everything that
+traces or compiles a solver program is marked `slow` and draws from the
+SAME canonical OPT64 / problem set as tests/test_serving.py, so the jit
+caches and the persistent compile cache amortise across the full lane.
+The host-side state machines (policies, breaker, queue plumbing driven
+by injected dispatch chaos that fails BEFORE any JAX work) run in
+tier-1 compile-free.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from megba_tpu.common import (
+    AlgoOption,
+    PrecondKind,
+    PreconditionerKind,
+    ProblemOption,
+    SolverOption,
+    SolveStatus,
+    status_retryable,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.robustness.faults import (
+    DispatchChaos,
+    FaultPlan,
+    InjectedDispatchError,
+    close_fault_window,
+    inert_fault_plan,
+    lower_fault_plan,
+    make_nan_burst,
+    stack_fault_plans,
+)
+from megba_tpu.serving import (
+    BreakerPolicy,
+    BreakerState,
+    BucketTripped,
+    CircuitBreaker,
+    DeadlineExceeded,
+    EscalationPolicy,
+    FleetProblem,
+    FleetQueue,
+    FleetStats,
+    QueueRejected,
+    RejectPolicy,
+    solve_many,
+)
+
+OPT64 = ProblemOption(dtype=np.float64,
+                      algo_option=AlgoOption(max_iter=6),
+                      solver_option=SolverOption(max_iter=12, tol=1e-10))
+
+
+def _mk(seed, n_pt=24, n_cam=4):
+    s = make_synthetic_bal(num_cameras=n_cam, num_points=n_pt,
+                           obs_per_point=3, seed=seed, param_noise=2e-2,
+                           pixel_noise=0.3, dtype=np.float64)
+    return FleetProblem.from_synthetic(s, name=f"s{seed}_p{n_pt}")
+
+
+def _poison(problem: FleetProblem, edges=(3, 17)) -> FleetProblem:
+    """NaN burst on the PRE-LOOP linearisation (window [0, 1)): with
+    guards off the carried cost is NaN from the start and every trial
+    is rejected against it (STALLED + non-finite cost); with guards on
+    the adoption path heals it (RECOVERED)."""
+    plan = make_nan_burst(problem.obs.shape[0], list(edges), start=0,
+                          stop=1, n_points=problem.points.shape[0],
+                          dtype=np.float64)
+    return dataclasses.replace(problem, fault_plan=plan,
+                               name=problem.name + "_poisoned")
+
+
+# ---------------------------------------------------------------------------
+# EscalationPolicy: rung transforms, backoff, retry predicate
+# ---------------------------------------------------------------------------
+
+def test_escalation_rung_transforms_are_cumulative():
+    pol = EscalationPolicy()
+    base = ProblemOption(
+        dtype=np.float32,
+        solver_option=SolverOption(max_iter=30, forcing=True,
+                                   warm_start=True,
+                                   precond=PrecondKind.NEUMANN,
+                                   preconditioner=(
+                                       PreconditionerKind.SCHUR_DIAG)))
+    r0 = pol.option_for_rung(base, 0)
+    assert r0 == base  # rung 0 = as submitted
+    r1 = pol.option_for_rung(base, 1)
+    assert r1.robust_option.guards
+    assert r1.solver_option == base.solver_option  # only guards changed
+    r2 = pol.option_for_rung(base, 2)
+    assert r2.robust_option.guards  # cumulative
+    assert r2.solver_option.precond == PrecondKind.JACOBI
+    assert r2.solver_option.preconditioner == PreconditionerKind.HPP
+    assert not r2.solver_option.forcing and not r2.solver_option.warm_start
+    assert r2.solver_option.max_iter == 60
+    assert np.dtype(r2.dtype) == np.float32
+    r3 = pol.option_for_rung(base, 3)
+    assert np.dtype(r3.dtype) == np.float64  # the f64 re-solve rung
+    assert r3.robust_option.guards
+    with pytest.raises(ValueError):
+        pol.option_for_rung(base, 4)
+    # rung >= 1 inflates initial damping as an OPERAND
+    assert pol.initial_region_for_rung(base, 0) is None
+    assert pol.initial_region_for_rung(base, 1) == pytest.approx(
+        base.algo_option.initial_region / pol.damping_deflation)
+
+
+def test_escalation_backoff_deterministic_and_bounded():
+    a = EscalationPolicy(seed=7, backoff_base_s=0.02, backoff_factor=2.0,
+                         backoff_jitter=0.5)
+    b = EscalationPolicy(seed=7, backoff_base_s=0.02, backoff_factor=2.0,
+                         backoff_jitter=0.5)
+    seq_a = [a.backoff_s(seq, k) for seq in range(4) for k in (1, 2, 3)]
+    seq_b = [b.backoff_s(seq, k) for seq in range(4) for k in (1, 2, 3)]
+    assert seq_a == seq_b  # fixed seed replays the exact schedule
+    c = EscalationPolicy(seed=8)
+    assert any(a.backoff_s(s, 1) != c.backoff_s(s, 1) for s in range(4))
+    # jitter stays inside [1-j, 1+j] of the exponential base
+    for seq in range(8):
+        for attempt in (1, 2, 3):
+            base = 0.02 * 2.0 ** (attempt - 1)
+            got = a.backoff_s(seq, attempt)
+            assert 0.5 * base <= got <= 1.5 * base
+    # problems de-synchronise: not every problem gets the same jitter
+    assert len({a.backoff_s(s, 1) for s in range(8)}) > 1
+    # jitter-free policy is the plain exponential
+    flat = EscalationPolicy(backoff_jitter=0.0, backoff_base_s=0.01)
+    assert flat.backoff_s(3, 2) == pytest.approx(0.02)
+    with pytest.raises(ValueError):
+        a.backoff_s(0, 0)
+    with pytest.raises(ValueError):
+        EscalationPolicy(max_rungs=0)
+    with pytest.raises(ValueError):
+        EscalationPolicy(backoff_jitter=1.0)
+    with pytest.raises(ValueError):
+        EscalationPolicy(backoff_factor=0.5)
+
+
+def test_retry_predicate_and_status_retryable():
+    pol = EscalationPolicy()
+    assert pol.should_retry(int(SolveStatus.STALLED))
+    assert pol.should_retry(int(SolveStatus.FATAL_NONFINITE))
+    assert not pol.should_retry(int(SolveStatus.CONVERGED), 1.0)
+    assert not pol.should_retry(int(SolveStatus.MAX_ITER), 1.0)
+    assert not pol.should_retry(int(SolveStatus.RECOVERED), 1.0)
+    # NaN cost under a benign status is still unusable
+    assert pol.should_retry(int(SolveStatus.MAX_ITER), float("nan"))
+    assert pol.should_retry(99)  # unknown codes never deliver silently
+    # the shared common.py predicate agrees
+    assert status_retryable(int(SolveStatus.STALLED))
+    assert status_retryable(int(SolveStatus.CONVERGED), float("inf"))
+    assert not status_retryable(int(SolveStatus.CONVERGED), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker state machine (pure host, injected clock)
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    events = []
+    cb = CircuitBreaker(BreakerPolicy(trip_after=2, cooldown_s=1.0),
+                        on_event=lambda e, b, r: events.append((e, b)))
+    assert cb.state("b") is BreakerState.CLOSED
+    cb.record_failure("b", "boom", now=0.0)
+    assert cb.state("b") is BreakerState.CLOSED  # streak 1 < trip_after
+    cb.check_submit("b", now=0.1)  # closed: no-op
+    cb.record_failure("b", "boom2", now=0.2)
+    assert cb.state("b") is BreakerState.OPEN
+    with pytest.raises(BucketTripped, match="boom2"):
+        cb.check_submit("b", now=0.5)
+    assert not cb.admit("b", now=0.5)  # still cooling down
+    assert cb.reopen_at("b") == pytest.approx(1.2)
+    cb.check_submit("b", now=1.5)  # past cooldown: submits flow again
+    assert cb.admit("b", now=1.5)  # half-open probe admitted
+    assert cb.state("b") is BreakerState.HALF_OPEN
+    assert not cb.admit("b", now=1.6)  # one probe at a time
+    cb.record_failure("b", "probe died", now=1.7)
+    assert cb.state("b") is BreakerState.OPEN  # failed probe re-opens
+    assert cb.admit("b", now=3.0)
+    cb.record_success("b")
+    assert cb.state("b") is BreakerState.CLOSED
+    assert cb.reopen_at("b") is None
+    # a success resets the streak: two more failures needed to re-trip
+    cb.record_failure("b", "x", now=3.1)
+    assert cb.state("b") is BreakerState.CLOSED
+    # independent buckets
+    assert cb.state("other") is BreakerState.CLOSED
+    assert [e for e, _ in events] == [
+        "trip", "fast_fail", "probe", "trip", "probe", "recover"]
+    with pytest.raises(ValueError):
+        BreakerPolicy(trip_after=0)
+
+
+# ---------------------------------------------------------------------------
+# Queue plumbing under chaos (compile-free: failures fire pre-solve)
+# ---------------------------------------------------------------------------
+
+def test_deadline_shed_before_dispatch():
+    stats = FleetStats()
+    with FleetQueue(OPT64, max_batch=64, max_wait_s=30.0,
+                    stats=stats) as q:
+        fut = q.submit(_mk(0), deadline_s=0.0)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded, match="shed before dispatch"):
+            fut.result(timeout=10)
+        # shed at the deadline, not at the 30s batch flush
+        assert time.monotonic() - t0 < 5.0
+    assert stats.sheds == 1
+    assert stats.problems == 0  # no device work was burned
+    with pytest.raises(ValueError):
+        q2 = FleetQueue(OPT64)
+        try:
+            q2.submit(_mk(0), deadline_s=-1.0)
+        finally:
+            q2.close()
+
+
+def test_admission_control_reject_raise():
+    stats = FleetStats()
+    with FleetQueue(OPT64, max_batch=64, max_wait_s=30.0, stats=stats,
+                    max_pending=2) as q:
+        f1 = q.submit(_mk(1), deadline_s=0.2)
+        f2 = q.submit(_mk(2), deadline_s=0.2)
+        with pytest.raises(QueueRejected, match="max_pending=2"):
+            q.submit(_mk(3), deadline_s=0.2)
+        # capacity frees once the two shed; the queue serves again
+        for f in (f1, f2):
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=10)
+        f4 = q.submit(_mk(4), deadline_s=0.0)
+        with pytest.raises(DeadlineExceeded):
+            f4.result(timeout=10)
+    assert stats.rejected == 1
+    assert stats.sheds == 3
+    assert stats.queue_depth_peak == 2
+    with pytest.raises(ValueError):
+        FleetQueue(OPT64, max_pending=0)
+
+
+def test_admission_control_block_times_out():
+    stats = FleetStats()
+    with FleetQueue(OPT64, max_batch=64, max_wait_s=30.0, stats=stats,
+                    max_pending=1, reject_policy=RejectPolicy.BLOCK,
+                    block_timeout_s=0.15) as q:
+        f1 = q.submit(_mk(1), deadline_s=30.0)
+        t0 = time.monotonic()
+        with pytest.raises(QueueRejected, match="for 0.15s"):
+            q.submit(_mk(2))
+        assert time.monotonic() - t0 >= 0.15
+        # a cancel before dispatch frees the slot without device work
+        assert f1.cancel()
+        f3 = q.submit(_mk(3), deadline_s=0.0)
+        with pytest.raises(DeadlineExceeded):
+            f3.result(timeout=10)
+    assert stats.rejected == 1
+    assert stats.problems == 0
+
+
+def test_breaker_trips_bucket_and_submits_fail_fast():
+    stats = FleetStats()
+    chaos = DispatchChaos(fail_first=99)  # every dispatch dies pre-solve
+    with FleetQueue(OPT64, max_batch=1, max_wait_s=0.0, stats=stats,
+                    chaos=chaos,
+                    breaker=BreakerPolicy(trip_after=2,
+                                          cooldown_s=60.0)) as q:
+        f1, f2 = q.submit(_mk(1)), q.submit(_mk(2))
+        for f in (f1, f2):
+            with pytest.raises(InjectedDispatchError):
+                f.result(timeout=10)
+        # two consecutive dispatch failures tripped the bucket: the
+        # third submit fails FAST with the tripped reason, untouched by
+        # the 60s cooldown
+        t0 = time.monotonic()
+        with pytest.raises(BucketTripped, match="InjectedDispatchError"):
+            q.submit(_mk(3))
+        assert time.monotonic() - t0 < 1.0
+    assert stats.breaker_trips == 1
+    assert stats.breaker_fast_fails == 1
+    assert chaos.dispatches(str(q._key_for(_mk(1), 0)[0])) == 2
+
+
+def test_flush_failure_does_not_wedge_and_prunes_pending():
+    """Satellites: an exception-riddled flush must leave `_force`
+    reset (a wedged `_force` would break every later deadline flush)
+    and `_pending` must never accumulate empty bucket entries."""
+    chaos = DispatchChaos(fail_first=99)
+    q = FleetQueue(OPT64, max_batch=64, max_wait_s=30.0, chaos=chaos)
+    try:
+        f1 = q.submit(_mk(1))
+        q.flush()
+        with pytest.raises(InjectedDispatchError):
+            f1.result(timeout=10)
+        assert not q._force
+        assert q._pending == {}  # the emptied bucket was pruned
+        # distinct shapes through the queue never leak empty entries
+        futs = [q.submit(_mk(2, n_pt=20)), q.submit(_mk(3, n_pt=40)),
+                q.submit(_mk(4, n_pt=70))]
+        q.flush()
+        for f in futs:
+            with pytest.raises(InjectedDispatchError):
+                f.result(timeout=10)
+        assert q._pending == {}
+        assert not q._force
+    finally:
+        q.close()
+    q.close()  # idempotent: a second close is a no-op, not an error
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(_mk(5))
+
+
+def test_deadline_expiring_during_failed_dispatch_counts_as_miss():
+    """A problem dispatched in time whose batch then fails after the
+    deadline passed gets the dispatch error (the real diagnostic) but
+    the expired deadline still lands in the deadline_miss counter —
+    the event must not vanish from FleetStats."""
+    stats = FleetStats()
+    chaos = DispatchChaos(fail_first=99, delay_s=0.3)
+    with FleetQueue(OPT64, max_batch=1, max_wait_s=0.0, stats=stats,
+                    chaos=chaos) as q:
+        fut = q.submit(_mk(1), deadline_s=0.1)
+        with pytest.raises(InjectedDispatchError):
+            fut.result(timeout=10)
+    assert stats.deadline_misses == 1
+    assert stats.sheds == 0  # it WAS dispatched — a miss, not a shed
+
+
+# ---------------------------------------------------------------------------
+# Fault-plan lowering / stacking + dispatch chaos determinism
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_lowering_and_stacking():
+    plan = make_nan_burst(6, [1, 4], start=2, stop=5, n_points=3,
+                          dtype=np.float64)
+    # permutation rides exactly like the edges it follows
+    perm = np.asarray([5, 4, 3, 2, 1, 0])
+    low = lower_fault_plan(plan, n_edges=8, n_points=4, dtype=np.float64,
+                           perm=perm)
+    assert low.edge_nan.shape == (8,)
+    assert np.isnan(low.edge_nan[perm.argsort()[1]])  # edge 1 followed
+    assert np.isnan(low.edge_nan[perm.argsort()[4]])
+    assert np.count_nonzero(np.isnan(low.edge_nan)) == 2
+    assert not np.isnan(low.edge_nan[6:]).any()  # padding stays zero
+    assert low.point_crush.shape == (4,) and low.point_crush[3] == 0.0
+    assert tuple(low.window) == (2, 5)
+    # a plan built without a point axis lowers to zeros
+    edge_only = make_nan_burst(6, [0], start=0, stop=1, dtype=np.float64)
+    low2 = lower_fault_plan(edge_only, n_edges=8, n_points=4,
+                            dtype=np.float64)
+    assert low2.point_crush.shape == (4,)
+    # too-big plans are rejected, not truncated
+    with pytest.raises(ValueError, match="point_crush"):
+        lower_fault_plan(plan, n_edges=8, n_points=2, dtype=np.float64)
+    with pytest.raises(ValueError, match="edge_nan"):
+        lower_fault_plan(plan, n_edges=4, n_points=4, dtype=np.float64)
+
+    inert = inert_fault_plan(8, 4, np.float64)
+    assert not np.isnan(inert.edge_nan).any()
+    assert tuple(inert.window) == (0, 0)
+    closed = close_fault_window(low)
+    assert tuple(closed.window) == (0, 0)
+    assert np.isnan(closed.edge_nan).any()  # only the gate changed
+
+    stack = stack_fault_plans([low, inert, closed])
+    assert isinstance(stack, FaultPlan)
+    assert stack.edge_nan.shape == (3, 8)
+    assert stack.window.shape == (3, 2)
+    assert stack.offset.shape == (3,)
+    with pytest.raises(ValueError):
+        stack_fault_plans([])
+
+
+def test_dispatch_chaos_seeded_determinism():
+    a = DispatchChaos(fail_rate=0.5, seed=3)
+    b = DispatchChaos(fail_rate=0.5, seed=3)
+
+    def pattern(chaos, bucket, n=32):
+        out = []
+        for _ in range(n):
+            try:
+                chaos.before_dispatch(bucket)
+                out.append(False)
+            except InjectedDispatchError:
+                out.append(True)
+        return out
+
+    pa = pattern(a, "bucket_x")
+    assert pa == pattern(b, "bucket_x")  # same seed: identical sequence
+    assert any(pa) and not all(pa)
+    c = DispatchChaos(fail_rate=0.5, seed=4)
+    assert pattern(c, "bucket_x") != pa  # different seed: different run
+    # bucket restriction: non-matching buckets are untouched
+    d = DispatchChaos(fail_first=99, buckets=frozenset({"only_this"}))
+    d.before_dispatch("something_else")
+    with pytest.raises(InjectedDispatchError):
+        d.before_dispatch("only_this")
+    with pytest.raises(ValueError):
+        DispatchChaos(fail_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# Stats + aggregate CLI satellites
+# ---------------------------------------------------------------------------
+
+def test_fleet_stats_resilience_counters():
+    s = FleetStats()
+    s.record_shed(2)
+    s.record_deadline_miss()
+    s.record_retry(1)
+    s.record_retry(1)
+    s.record_retry(2)
+    s.record_reject()
+    for ev in ("trip", "probe", "recover", "fast_fail"):
+        s.record_breaker(ev)
+    s.record_depth(5)
+    s.record_depth(3)  # peak keeps the max
+    d = s.as_dict()
+    assert d["sheds"] == 2 and d["deadline_misses"] == 1
+    assert d["retries"] == 3
+    assert d["retries_by_rung"] == {"1": 2, "2": 1}
+    assert d["rejected"] == 1
+    assert d["breaker_trips"] == 1 and d["breaker_probes"] == 1
+    assert d["breaker_recoveries"] == 1 and d["breaker_fast_fails"] == 1
+    assert d["queue_depth_peak"] == 5
+    assert "resilience:" in s.report() and "breaker:" in s.report()
+    with pytest.raises(ValueError):
+        s.record_breaker("nope")
+    # a fresh stats object keeps the report free of resilience noise
+    assert "resilience:" not in FleetStats().report()
+
+
+def test_aggregate_cli_reports_resilience_counters():
+    from megba_tpu.observability.report import SolveReport
+    from megba_tpu.observability.summarize import aggregate_reports
+
+    stats = {"sheds": 1, "retries": 2, "deadline_misses": 1,
+             "rejected": 3, "breaker_trips": 1, "breaker_probes": 1,
+             "breaker_recoveries": 1, "breaker_fast_fails": 4}
+    reps = [
+        SolveReport(problem={}, config={}, backend={}, phases={},
+                    result={"status_name": "converged"},
+                    fleet={"bucket": "b", "latency_s": 0.1, "attempts": 1,
+                           "rung": 0, "stats": {}},
+                    created_unix=100.0),
+        SolveReport(problem={}, config={}, backend={}, phases={},
+                    result={"status_name": "recovered"},
+                    fleet={"bucket": "b", "latency_s": 0.2, "attempts": 2,
+                           "rung": 1, "stats": stats},
+                    created_unix=101.0),
+    ]
+    out = aggregate_reports(reps)
+    assert "status recovered: 1" in out
+    assert "resilience: 1 escalated attempts (max rung 1)" in out
+    assert "2 retries" in out and "1 shed" in out
+    assert "1 deadline-missed" in out and "3 rejected" in out
+    assert "breaker: 1 trips / 1 probes / 1 recoveries / 4 fast-fails" \
+        in out
+    # plain (non-fleet) streams keep the pre-resilience shape
+    plain = aggregate_reports([SolveReport(
+        problem={}, config={}, backend={}, phases={},
+        result={"status_name": "converged"}, created_unix=1.0)])
+    assert "resilience:" not in plain
+
+
+# ---------------------------------------------------------------------------
+# Chaos paths that run real solves (slow: full lane only)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_poisoned_lane_isolated_bitwise_and_heals_under_guards():
+    """The batched chaos contract: one NaN-poisoned lane ends unusable
+    (guards off) or RECOVERED (guards on) while its batch-mates stay
+    BITWISE identical to the unpoisoned control — the same faulted
+    program with the poison window closed, so the mates' operands are
+    bit-identical and only the poisoned lane's plan differs."""
+    clean0, clean1 = _mk(3, 32), _mk(7, 29)
+    poisoned = _poison(_mk(11, 31))
+    fleet = [clean0, poisoned, clean1]
+    control = [clean0,
+               dataclasses.replace(
+                   poisoned,
+                   fault_plan=close_fault_window(poisoned.fault_plan)),
+               clean1]
+
+    got = solve_many(fleet, OPT64)
+    ref = solve_many(control, OPT64)
+    assert got[0].shape == got[1].shape == got[2].shape  # one bucket
+
+    # guards off: the poisoned lane is unusable and says so
+    assert got[1].status in {int(SolveStatus.STALLED),
+                             int(SolveStatus.FATAL_NONFINITE)}
+    assert not np.isfinite(float(got[1].cost))
+    assert np.isfinite(float(ref[1].cost))  # control really is clean
+
+    # batch-mates: bitwise identical to the unpoisoned run
+    for i in (0, 2):
+        assert got[i].cameras.tobytes() == ref[i].cameras.tobytes()
+        assert got[i].points.tobytes() == ref[i].points.tobytes()
+        assert got[i].cost.tobytes() == ref[i].cost.tobytes()
+        assert got[i].iterations == ref[i].iterations
+
+    # guards on (= the ladder's rung-1 option): the same poison heals
+    opt_guarded = EscalationPolicy().option_for_rung(OPT64, 1)
+    healed = solve_many(fleet, opt_guarded)
+    assert healed[1].status == int(SolveStatus.RECOVERED)
+    assert np.isfinite(float(healed[1].cost))
+    assert healed[1].recoveries >= 1
+
+
+@pytest.mark.slow
+def test_queue_escalation_heals_poisoned_problem():
+    """End-to-end ladder: rung 0 (as submitted, guards off) ends
+    STALLED/non-finite -> requeued at rung 1 (guards + inflated
+    damping) -> RECOVERED, with per-attempt history on the result and
+    <= 1 compile per (bucket, rung) certified by the retrace
+    sentinel."""
+    from megba_tpu.analysis import retrace
+
+    clean0, clean1 = _mk(3, 32), _mk(7, 29)
+    poisoned = _poison(_mk(11, 31))
+    stats = FleetStats()
+
+    base = retrace.snapshot()
+    with FleetQueue(OPT64, max_batch=8, max_wait_s=30.0, stats=stats,
+                    escalation=EscalationPolicy(
+                        backoff_base_s=0.01, seed=0)) as q:
+        futs = [q.submit(p) for p in (clean0, poisoned, clean1)]
+        q.flush()
+        got = [f.result(timeout=600) for f in futs]
+
+    # escalated re-solves never retraced an already-compiled program:
+    # <= 1 compile per (bucket program, rung option) signature
+    new = {k: v - base.get(k, 0) for k, v in retrace.snapshot().items()
+           if k[0].startswith("serving.batched") and v > base.get(k, 0)}
+    assert all(delta <= 1 for delta in new.values()), new
+
+    for g in (got[0], got[2]):  # clean problems: untouched by the chaos
+        assert g.attempts == 1 and g.rung == 0 and g.history == []
+        assert np.isfinite(float(g.cost))
+    healed = got[1]
+    assert healed.status == int(SolveStatus.RECOVERED)
+    assert healed.attempts == 2 and healed.rung == 1
+    assert len(healed.history) == 1
+    assert healed.history[0]["rung"] == 0
+    assert healed.history[0]["status"] in {
+        int(SolveStatus.STALLED), int(SolveStatus.FATAL_NONFINITE)}
+    assert healed.history[0]["error"] is None
+    assert np.isfinite(float(healed.cost))
+    assert stats.retries == 1 and stats.retries_by_rung == {1: 1}
+
+
+@pytest.mark.slow
+def test_queue_dispatch_error_escalates_then_succeeds():
+    """Dispatch-level exceptions ride the same ladder: chaos kills the
+    first dispatch, the retry (rung 1) solves, and the history records
+    the error string."""
+    stats = FleetStats()
+    chaos = DispatchChaos(fail_first=1)
+    with FleetQueue(OPT64, max_batch=1, max_wait_s=0.0, stats=stats,
+                    chaos=chaos,
+                    escalation=EscalationPolicy(backoff_base_s=0.01)) as q:
+        r = q.submit(_mk(3, 32)).result(timeout=600)
+    assert r.attempts == 2 and r.rung == 1
+    assert "InjectedDispatchError" in r.history[0]["error"]
+    assert np.isfinite(float(r.cost))
+    assert stats.retries == 1
+
+
+@pytest.mark.slow
+def test_deadline_missed_result_is_flagged_not_silent():
+    """A problem dispatched in time but completing late is delivered
+    flagged `deadline_missed` (chaos delay makes 'late' deterministic
+    instead of racing the wall clock)."""
+    stats = FleetStats()
+    chaos = DispatchChaos(delay_s=1.2)
+    with FleetQueue(OPT64, max_batch=1, max_wait_s=0.0, stats=stats,
+                    chaos=chaos) as q:
+        r = q.submit(_mk(3, 32), deadline_s=1.0).result(timeout=600)
+    assert r.deadline_missed
+    assert r.latency_s >= 1.0
+    assert np.isfinite(float(r.cost))  # delivered, not discarded
+    assert stats.deadline_misses == 1 and stats.sheds == 0
+
+
+@pytest.mark.slow
+def test_breaker_half_open_probe_recovers_bucket():
+    """Trip the bucket with injected failures, wait out the cooldown,
+    and watch the half-open probe batch close the breaker again."""
+    stats = FleetStats()
+    chaos = DispatchChaos(fail_first=2)
+    with FleetQueue(OPT64, max_batch=1, max_wait_s=0.0, stats=stats,
+                    chaos=chaos,
+                    breaker=BreakerPolicy(trip_after=2,
+                                          cooldown_s=0.3)) as q:
+        bucket = str(q._key_for(_mk(3, 32), 0)[0])
+        for seed in (1, 2):
+            with pytest.raises(InjectedDispatchError):
+                q.submit(_mk(seed, 32)).result(timeout=10)
+        assert q.breaker.state(bucket) is BreakerState.OPEN
+        with pytest.raises(BucketTripped):
+            q.submit(_mk(5, 32))  # fail-fast while cooling down
+        time.sleep(0.35)
+        r = q.submit(_mk(3, 32)).result(timeout=600)  # the probe
+        assert np.isfinite(float(r.cost))
+        assert q.breaker.state(bucket) is BreakerState.CLOSED
+    assert stats.breaker_trips == 1
+    assert stats.breaker_probes == 1
+    assert stats.breaker_recoveries == 1
+    assert stats.breaker_fast_fails == 1
