@@ -21,6 +21,7 @@ import dataclasses
 import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -60,7 +61,8 @@ def _replace(result, **fields):
 
 
 def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
-                 total, checkpoint_path, checkpoint_every):
+                 total, checkpoint_path, checkpoint_every,
+                 world_size=1, process_index=0, elastic=None):
     """The shared chunk loop: resume, solve in chunks, snapshot, aggregate.
 
     `solve_chunk(params, max_iter, region, v, dx, done) -> (result,
@@ -74,10 +76,45 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     accepted / pcg_iterations / stopped.  `dump_params(params)` returns
     the two arrays the snapshot format stores; `load_params(st)` inverts
     it.
+
+    `world_size`/`process_index` are stamped into every snapshot's
+    schema-v3 world header; a resume at a DIFFERENT world size warns
+    (never fails) and is recorded as a reshard on `elastic`.
+
+    `elastic` (robustness.elastic.ElasticMonitor, already started)
+    bounds every chunk dispatch: peers are liveness-checked at each
+    chunk boundary and the dispatch itself runs under the collective
+    watchdog — a dead or wedged peer surfaces as a typed `WorkerLost` /
+    `CollectiveTimeout` within the budget instead of hanging the rank.
+    The chunk whose dispatch died is simply never snapshotted, so the
+    previous chunk's checksummed snapshot IS the coordinated-abort
+    recovery line (resume_elastic continues from it).
     """
     if checkpoint_every < 1:
         raise ValueError(
             f"checkpoint_every must be >= 1, got {checkpoint_every}")
+
+    def dispatch(label, *chunk_args):
+        if elastic is None:
+            return solve_chunk(*chunk_args)
+
+        def _solve_chunk_sync():
+            # jax dispatch is ASYNC: without the barrier the guarded
+            # call would return un-materialized arrays and a peer-death
+            # transport error would surface later, OUTSIDE the guard,
+            # as an unclassified XlaRuntimeError at the first host read.
+            # Blocking here keeps the whole chunk — dispatch AND
+            # execution — inside the watchdog/liveness envelope.
+            out = solve_chunk(*chunk_args)
+            return jax.block_until_ready(out)
+
+        elastic.check_peers(label=label)
+        # grace_key = the chunk's iteration count (max_iter is the one
+        # per-chunk STATIC, so it identifies the compiled program): the
+        # first dispatch of each program — including a short final
+        # chunk or the 0-iter evaluate — gets the compile grace.
+        return elastic.guard(label, _solve_chunk_sync,
+                             grace_key=("chunk", chunk_args[1]))
     done = 0
     region = None
     v = None
@@ -99,7 +136,13 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     # graph topology is summarised by a cheap order-sensitive hash of
     # the index arrays, not just the counts.
     if os.path.exists(checkpoint_path):
-        st = load_state(checkpoint_path)
+        st = load_state(checkpoint_path, expect_world_size=world_size)
+        saved_ws = st.get("world_size")
+        if (elastic is not None and saved_ws is not None
+                and int(saved_ws) != int(world_size)):
+            # Shrink-world resume through the driver directly (without
+            # resume_elastic's own bookkeeping): still a reshard event.
+            elastic.record_reshard(int(saved_ws), int(world_size))
         saved_topo = st.get("extra_topology")
         if saved_topo is None or not np.array_equal(
                 np.asarray(saved_topo), topo):
@@ -141,7 +184,8 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
     result = None
     while not already_stopped and done < total:
         chunk = min(checkpoint_every, total - done)
-        result, params = solve_chunk(params, chunk, region, v, dx, done)
+        result, params = dispatch(
+            f"chunk@iter{done}", params, chunk, region, v, dx, done)
         region = float(result.region)
         v = float(result.v)
         if getattr(result, "dx_cam", None) is not None:
@@ -188,12 +232,14 @@ def _run_chunked(solve_chunk, params, dump_params, load_params, topo,
         save_state(
             checkpoint_path, arr_a, arr_b,
             region=region, cost=float(result.cost), iteration=done,
+            world_size=world_size, process_index=process_index,
             extra=extra)
         if stopped:
             break  # converged (possibly exactly on the chunk boundary)
 
     if result is None:  # resumed at/past total (or converged): evaluate
-        result, params = solve_chunk(params, 0, region, v, dx, done)
+        result, params = dispatch(
+            f"evaluate@iter{done}", params, 0, region, v, dx, done)
         if first_cost is None:
             first_cost = result.initial_cost
         if already_stopped:
@@ -246,6 +292,7 @@ def solve_checkpointed(
     checkpoint_path: str,
     checkpoint_every: int = 5,
     verbose: bool = False,
+    elastic=None,
     **solve_kwargs,
 ) -> LMResult:
     """Run the BA LM solve, snapshotting every `checkpoint_every` iters.
@@ -255,9 +302,19 @@ def solve_checkpointed(
     same configuration reuse ONE compiled program (the resume state
     rides as dynamic operands).  Extra kwargs flow to `solve.flat_solve`
     (sqrt_info, cam_fixed, pt_fixed, use_tiled...).
+
+    `elastic` (robustness.elastic.ElasticConfig or ElasticMonitor) arms
+    the elastic-distribution contract for world>1 solves: this rank
+    heartbeats, every chunk dispatch is watchdog-bounded, and a dead or
+    wedged peer raises a typed `WorkerLost`/`CollectiveTimeout` at the
+    chunk boundary — the latest snapshot is then the recovery line for
+    `robustness.elastic.resume_elastic`.  When telemetry is on, each
+    chunk's SolveReport carries the monitor's `elastic` counters.
     """
+    from megba_tpu.robustness.elastic import ElasticMonitor
     from megba_tpu.solve import flat_solve
 
+    monitor, owned = ElasticMonitor.ensure(elastic)
     cam_dtype = cameras.dtype
     pt_dtype = points.dtype
     # A seeded FaultPlan is anchored in GLOBAL iterations: each chunk
@@ -277,6 +334,11 @@ def solve_checkpointed(
             from megba_tpu.robustness.faults import with_offset
 
             kwargs["fault_plan"] = with_offset(fault_plan, done)
+        if monitor is not None:
+            # Telemetry context: the chunk's SolveReport line carries a
+            # snapshot of the elastic ledger (fresh dict per chunk; the
+            # aggregator keeps the last snapshot per monitor).
+            kwargs["elastic_report"] = monitor.report_block()
         result = flat_solve(
             residual_jac_fn, cams, pts, obs, cam_idx, pt_idx,
             chunk_option, verbose=verbose,
@@ -284,17 +346,24 @@ def solve_checkpointed(
             **kwargs)
         return result, (result.cameras, result.points)
 
-    return _run_chunked(
-        solve_chunk,
-        params=(cameras, points),
-        dump_params=lambda p: (np.asarray(p[0]), np.asarray(p[1])),
-        load_params=lambda st: (jnp.asarray(st["cameras"], cam_dtype),
-                                jnp.asarray(st["points"], pt_dtype)),
-        topo=_topology_fingerprint(cameras, points, cam_idx, pt_idx),
-        total=option.algo_option.max_iter,
-        checkpoint_path=checkpoint_path,
-        checkpoint_every=checkpoint_every,
-    )
+    try:
+        return _run_chunked(
+            solve_chunk,
+            params=(cameras, points),
+            dump_params=lambda p: (np.asarray(p[0]), np.asarray(p[1])),
+            load_params=lambda st: (jnp.asarray(st["cameras"], cam_dtype),
+                                    jnp.asarray(st["points"], pt_dtype)),
+            topo=_topology_fingerprint(cameras, points, cam_idx, pt_idx),
+            total=option.algo_option.max_iter,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            world_size=option.world_size,
+            process_index=int(jax.process_index()),
+            elastic=monitor,
+        )
+    finally:
+        if owned:
+            monitor.stop()
 
 
 def solve_pgo_checkpointed(
@@ -306,6 +375,7 @@ def solve_pgo_checkpointed(
     checkpoint_path: str,
     checkpoint_every: int = 5,
     verbose: bool = False,
+    elastic=None,
     **solve_kwargs,
 ):
     """Preemption-safe chunked PGO solve (models/pgo.solve_pgo).
@@ -317,9 +387,17 @@ def solve_pgo_checkpointed(
     a dynamic operand of models/pgo's program cache).  Extra kwargs flow
     to `solve_pgo` (sqrt_info, fixed...).  The pose table reuses the
     "cameras" slot of the shared snapshot format; "points" carries a
-    placeholder.
+    placeholder.  `elastic` bounds chunk dispatches exactly as in
+    `solve_checkpointed` (typed WorkerLost/CollectiveTimeout at chunk
+    boundaries; the snapshot is the recovery line).  Unlike the BA
+    driver there is no per-chunk `elastic_report` to attach: the PGO
+    pipeline emits no SolveReport telemetry at all (see
+    observability/report.py — the sink hangs off flat_solve only).
     """
     from megba_tpu.models.pgo import solve_pgo
+    from megba_tpu.robustness.elastic import ElasticMonitor
+
+    monitor, owned = ElasticMonitor.ensure(elastic)
 
     def solve_chunk(params, max_iter, region, v, dx, done):
         # PGO has no cross-chunk warm-start operand (its warm-start
@@ -336,13 +414,21 @@ def solve_pgo_checkpointed(
         return result, np.asarray(result.poses)
 
     poses = np.asarray(poses0)
-    return _run_chunked(
-        solve_chunk,
-        params=poses,
-        dump_params=lambda p: (np.asarray(p), np.zeros((0, 1))),
-        load_params=lambda st: np.asarray(st["cameras"]),
-        topo=_topology_fingerprint(poses, np.zeros((0, 1)), edge_i, edge_j),
-        total=option.algo_option.max_iter,
-        checkpoint_path=checkpoint_path,
-        checkpoint_every=checkpoint_every,
-    )
+    try:
+        return _run_chunked(
+            solve_chunk,
+            params=poses,
+            dump_params=lambda p: (np.asarray(p), np.zeros((0, 1))),
+            load_params=lambda st: np.asarray(st["cameras"]),
+            topo=_topology_fingerprint(poses, np.zeros((0, 1)), edge_i,
+                                       edge_j),
+            total=option.algo_option.max_iter,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every,
+            world_size=option.world_size,
+            process_index=int(jax.process_index()),
+            elastic=monitor,
+        )
+    finally:
+        if owned:
+            monitor.stop()

@@ -98,6 +98,13 @@ class SolveReport:
     # emitted by `solve_many` / `FleetQueue` — the fields the
     # `summarize --aggregate` fleet view keys on.
     fleet: Optional[Dict[str, Any]] = None
+    # Optional elastic-distribution context (robustness/elastic.py): a
+    # snapshot of one rank's ElasticMonitor ledger — workers lost,
+    # collective timeouts, reshards, resumes, time-to-detection samples,
+    # keyed by a `monitor` id so the aggregate view can take the LAST
+    # snapshot per monitor and sum across monitors without double
+    # counting (chunked solves emit one snapshot per chunk).
+    elastic: Optional[Dict[str, Any]] = None
     schema: str = SCHEMA
     created_unix: float = 0.0
 
@@ -129,7 +136,8 @@ def _decode_fallback_totals(trace, iterations: int) -> Optional[Dict[str, int]]:
 def build_report(option, result, phases: Dict[str, Any],
                  problem: Dict[str, Any],
                  audit: Optional[Dict[str, Any]] = None,
-                 fleet: Optional[Dict[str, Any]] = None) -> SolveReport:
+                 fleet: Optional[Dict[str, Any]] = None,
+                 elastic: Optional[Dict[str, Any]] = None) -> SolveReport:
     """Assemble a SolveReport from a finished solve.
 
     `result` is an LMResult (trace included when the solve populated
@@ -179,6 +187,7 @@ def build_report(option, result, phases: Dict[str, Any],
         memory=device_memory_stats(),
         program_audit=audit,
         fleet=fleet,
+        elastic=elastic,
         created_unix=time.time(),
     )
 

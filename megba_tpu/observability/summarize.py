@@ -13,7 +13,11 @@ latency, and (when the reports carry the serving layer's `fleet`
 context) per-bucket problem counts plus the resilience counters
 (escalated attempts / retries / sheds / deadline misses / rejections
 and circuit-breaker transitions) — so a multi-problem run's JSONL is
-readable without ad-hoc scripts.
+readable without ad-hoc scripts.  Reports carrying the elastic-
+distribution context (`SolveReport.elastic`, robustness/elastic.py)
+add an elastic line: workers lost, collective timeouts, reshards,
+resumes, and time-to-detection p50/max (last snapshot per monitor,
+summed across monitors).
 """
 
 from __future__ import annotations
@@ -187,6 +191,38 @@ def aggregate_reports(reports: List[SolveReport]) -> str:
             f"{stats.get('breaker_probes', 0)} probes / "
             f"{stats.get('breaker_recoveries', 0)} recoveries / "
             f"{stats.get('breaker_fast_fails', 0)} fast-fails")
+
+    # Elastic view (PR 9): each elastic block is a CUMULATIVE snapshot
+    # of one rank's ElasticMonitor (chunked solves emit one per chunk),
+    # so keep the last snapshot per `monitor` id and sum ACROSS
+    # monitors — counting every snapshot would multiply the ledger by
+    # the chunk count.
+    latest_by_monitor: dict = {}
+    for i, rep in enumerate(reports):
+        if not rep.elastic:
+            continue
+        key = rep.elastic.get("monitor") or f"anon{i}"
+        prev = latest_by_monitor.get(key)
+        if prev is None or (rep.created_unix or 0.0) >= (
+                prev.created_unix or 0.0):
+            latest_by_monitor[key] = rep
+    if latest_by_monitor:
+        blocks = [r.elastic for r in latest_by_monitor.values()]
+        lost = sum(b.get("workers_lost", 0) for b in blocks)
+        timeouts = sum(b.get("collective_timeouts", 0) for b in blocks)
+        reshards = sum(b.get("reshards", 0) for b in blocks)
+        resumes = sum(b.get("resumes", 0) for b in blocks)
+        detections = sorted(
+            float(s) for b in blocks for s in (b.get("detection_s") or []))
+        lines.append(
+            f"   elastic: {lost} workers lost, {timeouts} collective "
+            f"timeouts, {reshards} reshards, {resumes} resumes "
+            f"({len(latest_by_monitor)} monitors)")
+        if detections:
+            lines.append(
+                f"   time-to-detection: p50 "
+                f"{_percentile(detections, 50):.3f}s / max "
+                f"{detections[-1]:.3f}s over {len(detections)} losses")
     return "\n".join(lines)
 
 

@@ -19,7 +19,9 @@ changes.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 import warnings
 from typing import Optional, Sequence
 
@@ -54,6 +56,44 @@ except AttributeError:
 
 EDGE_AXIS = "edges"
 
+# Elastic shrink-world scope (parallel/multihost + robustness/elastic):
+# after peers are lost/abandoned, `jax.devices()` STILL lists the dead
+# processes' devices — a mesh (or default-device dispatch) touching one
+# would address a process that no longer exists.  While this scope is
+# active, `make_mesh` draws only from devices THIS process owns.  A
+# process-global count, not a thread-local: elastic dispatches run on
+# watchdog worker threads, and a dead world is dead for every thread.
+_LOCAL_ONLY_DEPTH = 0
+_LOCAL_ONLY_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def local_devices_only():
+    """Context manager scoping `make_mesh` to this process's devices.
+
+    The shrink-world resume path (`robustness.elastic.resume_elastic`)
+    wraps the re-lowered solve in this so the smaller mesh is built
+    from surviving local devices regardless of what the stale global
+    device list claims.  Re-entrant; affects all threads (see above).
+    """
+    global _LOCAL_ONLY_DEPTH
+    with _LOCAL_ONLY_LOCK:
+        _LOCAL_ONLY_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _LOCAL_ONLY_LOCK:
+            _LOCAL_ONLY_DEPTH -= 1
+
+
+def local_only_active() -> bool:
+    return _LOCAL_ONLY_DEPTH > 0
+
+
+def _this_process_devices(devices):
+    pi = jax.process_index()
+    return [d for d in devices if d.process_index == pi]
+
 
 def make_mesh(
     world_size: int, devices: Optional[Sequence[jax.Device]] = None
@@ -62,10 +102,15 @@ def make_mesh(
 
     `world_size` plays the role of the reference's ProblemOption::deviceUsed
     GPU count (common.h:47, validated against the device count at
-    memory_pool.cu:50-56).
+    memory_pool.cu:50-56).  Under `local_devices_only()` (elastic
+    shrink-world resume) the default device pool is restricted to this
+    process's own devices; an explicit `devices=` list is always taken
+    as-is — the caller owns it.
     """
     if devices is None:
         devices = jax.devices()
+        if local_only_active():
+            devices = _this_process_devices(devices)
         if len(devices) < world_size:
             # Fall back to the CPU platform (e.g. virtual multi-device CPU
             # testing while only one accelerator chip is attached) — loudly,
@@ -74,6 +119,8 @@ def make_mesh(
                 cpus = jax.devices("cpu")
             except RuntimeError:
                 cpus = []
+            if local_only_active():
+                cpus = _this_process_devices(cpus)
             if len(cpus) >= world_size:
                 warnings.warn(
                     f"world_size {world_size} exceeds the {len(devices)} "

@@ -12,13 +12,31 @@ within a slice and DCN across slices with zero further code changes.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Tuple
 
 import jax
 
 # Parameters this module successfully initialized jax.distributed with
-# (None until we did); used to keep repeat calls idempotent.
+# (None until we did); used to keep repeat calls idempotent.  Reset by
+# `shutdown_multihost`, which is what makes a later re-initialization at
+# a DIFFERENT world size legal (the elastic shrink-world path).
 _initialized_with: Optional[Tuple] = None
+
+# Elastic bring-up heartbeat tuning: effectively-infinite windows.  The
+# coordination-service liveness layer is deliberately neutered because
+# BOTH of its failure reactions abort the surviving process on this
+# jaxlib (probed, see initialize_multihost): app-level liveness
+# (robustness/elastic.HeartbeatBoard) is the detector instead.
+_ELASTIC_HEARTBEAT_S = 10
+_ELASTIC_MAX_MISSING = 1_000_000
+
+
+def _global_state():
+    """jax's distributed global state (indirection for tests)."""
+    from jax._src import distributed as _dist
+
+    return _dist.global_state
 
 
 def _distributed_is_initialized() -> bool:
@@ -41,10 +59,89 @@ def _distributed_is_initialized() -> bool:
         return _initialized_with is not None
 
 
+def _elastic_connect(coordinator_address: str, process_id: int) -> object:
+    """Build + connect a SURVIVABLE distributed-runtime client.
+
+    `jax.distributed.initialize`'s client is built with the defaults
+    that make peer loss fatal on this jaxlib (all three probed on
+    jax 0.4.37 / jaxlib 0.4.36):
+
+    - the default missed-heartbeat callback LOG(QFATAL)s the process;
+    - a PYTHON callback cannot replace it — the pybind Status caster
+      aborts (`std::bad_cast`) the moment a non-OK status is delivered,
+      so the callback must simply never fire: heartbeat windows are set
+      effectively infinite;
+    - `shutdown_on_destruction=True` (the default) runs the ShutdownTask
+      barrier from the C++ destructor at process exit, which blocks on a
+      dead peer and then aborts the survivor.
+
+    Hence: huge windows, a benign (never-invoked) callback, and no
+    shutdown-on-destruction.  The client is installed into jax's
+    distributed global state BEFORE any backend query, so the CPU
+    backend picks it up as the gloo rendezvous KV store exactly as the
+    stock path would.
+    """
+    from jax.lib import xla_extension as _xe
+
+    client = _xe.get_distributed_runtime_client(
+        coordinator_address, process_id,
+        init_timeout=300,
+        heartbeat_interval=_ELASTIC_HEARTBEAT_S,
+        max_missing_heartbeats=_ELASTIC_MAX_MISSING,
+        missed_heartbeat_callback=lambda *_: None,
+        shutdown_on_destruction=False)
+    client.connect()
+    return client
+
+
+def _install_distributed_state(client, coordinator_address: str,
+                               num_processes: int, process_id: int) -> None:
+    """Publish an externally-built client where jax (and the backend
+    factories) look for it — the same fields `jax.distributed.initialize`
+    fills (indirection point for the multihost unit tests)."""
+    state = _global_state()
+    state.client = client
+    state.coordinator_address = coordinator_address
+    state.num_processes = int(num_processes)
+    state.process_id = int(process_id)
+
+
+def serve_rendezvous(port: int, num_processes: int,
+                     block: bool = True) -> object:
+    """Host the coordination service as a STANDALONE rendezvous process.
+
+    Elastic worlds keep the coordination service OUT of the solver
+    ranks: a rank that hosts the service cannot exit cleanly once a
+    peer has died (destroying the service cancels the local agent's
+    error-poll RPC, whose status delivery aborts the process — the
+    probed jaxlib hazard documented on `_elastic_connect`).  A
+    sacrificial rendezvous process owns the service instead, exactly
+    like an external etcd/rendezvous daemon in elastic training stacks;
+    the harness SIGKILLs it when the world is done (no graceful
+    teardown exists or is needed).  Run via
+    `python -m megba_tpu.parallel.multihost --serve <port> <world>`.
+    """
+    from jax.lib import xla_extension as _xe
+
+    service = _xe.get_distributed_runtime_service(
+        f"[::]:{int(port)}", int(num_processes),
+        heartbeat_interval=_ELASTIC_HEARTBEAT_S,
+        max_missing_heartbeats=_ELASTIC_MAX_MISSING)
+    print(f"rendezvous serving {num_processes} processes on port {port}",
+          flush=True)
+    if block:
+        import time
+
+        while True:  # killed, never joined
+            time.sleep(3600)
+    return service
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    elastic: bool = False,
 ) -> dict:
     """Initialise JAX's distributed runtime (idempotent).
 
@@ -52,22 +149,46 @@ def initialize_multihost(
     metadata / SLURM / GKE) exactly as `jax.distributed.initialize`
     does.  Returns a summary dict {process_index, process_count,
     local_devices, global_devices}.
+
+    `elastic=True` selects the SURVIVABLE bring-up for worlds that must
+    outlive peer loss (robustness/elastic.py): explicit rendezvous
+    parameters are required, `coordinator_address` must point at an
+    external rendezvous process (`serve_rendezvous` — solver ranks are
+    clients only), and the client is built so that a dead peer can
+    never abort this process (see `_elastic_connect` for the probed
+    jaxlib failure modes this avoids).  After `shutdown_multihost`, a
+    process may legally re-initialize — including with different
+    parameters, the shrink-world resume path.
     """
     global _initialized_with
     initialized = _distributed_is_initialized()
     explicit = any(
         a is not None for a in (coordinator_address, num_processes, process_id)
     )
-    params = (coordinator_address, num_processes, process_id)
+    params = (coordinator_address, num_processes, process_id, bool(elastic))
     if initialized:
         # Idempotent on an exact repeat of OUR parameters; anything else
         # (different params, or an init we didn't perform) cannot be
         # applied and failing silently would leave hosts solo-solving.
+        # Re-initialization at NEW parameters is legal only through
+        # shutdown_multihost, which resets this record.
         if explicit and params != _initialized_with:
             raise RuntimeError(
                 "jax.distributed is already initialized with different "
-                "parameters; call initialize_multihost before any other "
-                "jax.distributed use")
+                "parameters; call shutdown_multihost() before "
+                "re-initializing, or initialize_multihost before any "
+                "other jax.distributed use")
+    elif elastic:
+        if not explicit or None in (coordinator_address, num_processes,
+                                    process_id):
+            raise ValueError(
+                "elastic=True requires explicit coordinator_address / "
+                "num_processes / process_id (the rendezvous process is "
+                "external; there is no auto-detection)")
+        client = _elastic_connect(coordinator_address, process_id)
+        _install_distributed_state(
+            client, coordinator_address, num_processes, process_id)
+        _initialized_with = params
     else:
         try:
             jax.distributed.initialize(
@@ -89,6 +210,73 @@ def initialize_multihost(
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+def shutdown_multihost(abandon: bool = False, timeout_s: float = 5.0) -> bool:
+    """Tear down the distributed runtime so re-initialization is legal.
+
+    Returns True when a runtime was actually torn down.  Two modes:
+
+    - **Cooperative** (default): every rank calls this — the normal
+      `jax.distributed.shutdown()` runs (its ShutdownTask barrier
+      completes because everyone arrives), bounded by `timeout_s` on a
+      helper thread.  If it fails to return in time (a peer died on the
+      way out), the attempt is abandoned and state is force-reset.
+    - **Abandon** (`abandon=True`): peers are presumed DEAD.  The
+      barrier-bearing shutdown paths are never invoked — on this jaxlib
+      they block on the dead peer and then abort the survivor (probed;
+      see `_elastic_connect`) — and the service, if this process hosts
+      one, is deliberately left running untouched: destroying it
+      cancels the local agent's error-poll RPC, whose status delivery
+      aborts the process.  jax-level references are dropped, which is
+      all re-initialization (or a purely local shrink-world solve)
+      needs.
+
+    Either way `_initialized_with` is cleared, making a subsequent
+    `initialize_multihost` — same OR different parameters — legal,
+    while an exact-repeat call before shutdown stays idempotent.
+    """
+    global _initialized_with
+    was_initialized = _distributed_is_initialized()
+    _initialized_with = None
+    if not was_initialized:
+        return False
+    state = _global_state()
+    if not abandon:
+        # The helper thread works on CAPTURED references, never on the
+        # global state: if it wedges on a dead peer and unblocks only
+        # after a later re-initialization installed a NEW client, it
+        # must not clobber that state (jax.distributed.shutdown()
+        # would — it nulls global_state fields whenever it returns).
+        client = state.client
+        service = getattr(state, "service", None)
+        done = threading.Event()
+
+        def _graceful():
+            try:
+                if client is not None:
+                    client.shutdown()  # the ShutdownTask barrier
+                if service is not None:
+                    service.shutdown()
+            except Exception:
+                pass  # force-reset below either way
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_graceful, daemon=True,
+                             name="multihost-shutdown")
+        t.start()
+        done.wait(timeout_s)
+        # Fell through on timeout: the graceful path is wedged on a
+        # dead peer; abandon it (daemon thread, captured refs only)
+        # and force-reset exactly like abandon=True.
+    state.client = None
+    state.coordinator_address = None
+    if getattr(state, "service", None) is not None and not abandon:
+        state.service = None
+    if getattr(state, "preemption_sync_manager", None) is not None:
+        state.preemption_sync_manager = None
+    return True
 
 
 def cpu_cross_process_collectives_available() -> bool:
@@ -199,3 +387,22 @@ def dispatch_on_mesh(prog, mesh, args, specs):
         dev0 = mesh.devices.flat[0]
     with jax.default_device(dev0):
         return prog(*args)
+
+
+def _main(argv=None) -> int:
+    """CLI: `python -m megba_tpu.parallel.multihost --serve <port> <world>`
+    runs the standalone rendezvous process for an elastic world (see
+    serve_rendezvous; SIGKILL it when the world is done)."""
+    import sys
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if len(argv) == 3 and argv[0] == "--serve":
+        serve_rendezvous(int(argv[1]), int(argv[2]))
+        return 0
+    print("usage: python -m megba_tpu.parallel.multihost "
+          "--serve <port> <num_processes>")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

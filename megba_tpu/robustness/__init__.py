@@ -19,7 +19,17 @@ Three pieces, spanning the solver stack:
 - **Host kill-resume harness** (`robustness.harness`): SIGKILLs a
   checkpointed-driver subprocess mid-chunk and resumes it, for
   preemption-safety tests that need a real process death rather than an
-  in-process simulation.
+  in-process simulation.  `run_world_until_snapshot_then_kill` scales
+  it to an N-rank world (kill one rank, assert the survivors exit on
+  their own — the elastic no-wedge contract).
+
+- **Elastic distribution** (`robustness.elastic`): liveness detection
+  (per-rank heartbeat files + injected-clock state machines), a
+  collective watchdog bounding every chunk dispatch, typed
+  `WorkerLost`/`CollectiveTimeout` failures at chunk boundaries, and
+  `resume_elastic` — tear down the distributed runtime, re-lower the
+  same problem at the surviving world size, continue from the latest
+  schema-v3 snapshot.
 """
 
 from megba_tpu.robustness.faults import (  # noqa: F401
@@ -39,7 +49,20 @@ from megba_tpu.robustness.faults import (  # noqa: F401
     stack_fault_plans,
     with_offset,
 )
+from megba_tpu.robustness.elastic import (  # noqa: F401
+    CollectiveTimeout,
+    CollectiveWatchdog,
+    ElasticConfig,
+    ElasticError,
+    ElasticMonitor,
+    HeartbeatBoard,
+    RankState,
+    WorkerLost,
+    resume_elastic,
+)
 from megba_tpu.robustness.harness import (  # noqa: F401
+    WorldKillOutcome,
     run_to_completion,
     run_until_snapshot_then_kill,
+    run_world_until_snapshot_then_kill,
 )

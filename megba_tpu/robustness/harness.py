@@ -7,17 +7,27 @@ delivers a REAL process death: it launches a worker subprocess, polls
 for the first durable snapshot, SIGKILLs the worker (no atexit, no
 signal handler, no flush — exactly a preempted host), and reruns the
 worker to completion against the surviving snapshot.
+
+`run_world_until_snapshot_then_kill` is the N-process (elastic) upgrade
+of the same idea: a whole WORLD of rank processes (plus an optional
+sacrificial rendezvous daemon, `parallel.multihost.serve_rendezvous`)
+runs until the first world-level snapshot lands, one rank is SIGKILLed
+mid-solve, and the SURVIVORS must exit on their own within the grace
+budget — the no-wedge contract: detection (robustness/elastic.py) plus
+shrink-world resume are bounded, so a survivor still running is itself
+the failure being tested for.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import signal
 import subprocess
 import sys
 import tempfile
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 def _snapshot_ready(path: str) -> bool:
@@ -91,6 +101,133 @@ def run_until_snapshot_then_kill(
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=60)
+
+
+@dataclasses.dataclass
+class WorldKillOutcome:
+    """What `run_world_until_snapshot_then_kill` observed.
+
+    `returncodes[kill_rank]` is the negative SIGKILL code; every other
+    rank's code is whatever it EXITED with on its own (the elastic
+    workers exit 0 after detect + shrink-world resume).  `outputs` maps
+    rank -> combined stdout/stderr.  `kill_monotonic` is the harness
+    clock at SIGKILL delivery, for latency cross-checks.
+    """
+
+    kill_rank: int
+    returncodes: Dict[int, int]
+    outputs: Dict[int, str]
+    kill_monotonic: float
+
+
+def run_world_until_snapshot_then_kill(
+    worker_argvs: Sequence[Sequence[str]],
+    snapshot_path: str,
+    kill_rank: int = 1,
+    rendezvous_argv: Optional[Sequence[str]] = None,
+    timeout: float = 600.0,
+    settle: float = 0.0,
+    survivor_timeout: float = 600.0,
+    env: Optional[dict] = None,
+) -> WorldKillOutcome:
+    """Run an N-rank world; SIGKILL `kill_rank` at the first snapshot.
+
+    `worker_argvs[i]` is rank i's argv.  `snapshot_path` is the durable
+    world-level snapshot to poll (conventionally rank 0's checkpoint —
+    atomic by `save_state`'s temp+fsync+rename contract, so existence
+    implies completeness).  The kill is a true SIGKILL mid-solve, after
+    an optional `settle`.  Every surviving rank must then EXIT ON ITS
+    OWN within `survivor_timeout` — the elastic no-wedge contract; a
+    survivor still running is killed and reported as a TimeoutError
+    naming the wedge.  `rendezvous_argv`, when given, is launched first
+    and SIGKILLed last (the sacrificial coordination-service daemon,
+    `python -m megba_tpu.parallel.multihost --serve <port> <world>` —
+    it has no graceful teardown by design).
+
+    Output handling matches `run_until_snapshot_then_kill`: unbuffered
+    temp files, never pipes, so a chatty worker can't deadlock the poll
+    loop.
+    """
+    n = len(worker_argvs)
+    if not 0 <= kill_rank < n:
+        raise ValueError(f"kill_rank {kill_rank} outside world {n}")
+    rdv = None
+    logs = [tempfile.TemporaryFile() for _ in range(n)]
+    procs: List[subprocess.Popen] = []
+
+    def drain(i: int) -> str:
+        logs[i].seek(0)
+        return logs[i].read().decode(errors="replace")
+
+    def drain_all() -> str:
+        return "\n".join(f"--- rank {i} ---\n{drain(i)}" for i in range(n))
+
+    try:
+        if rendezvous_argv is not None:
+            rdv = subprocess.Popen(
+                list(rendezvous_argv), env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        # Appended one by one (not a comprehension): if a later spawn
+        # raises, the already-running ranks are in `procs` and the
+        # finally block reaps them instead of leaking live solvers.
+        for i, argv in enumerate(worker_argvs):
+            procs.append(subprocess.Popen(
+                list(argv), env=env, stdout=logs[i],
+                stderr=subprocess.STDOUT))
+        deadline = time.monotonic() + timeout
+        while True:
+            if _snapshot_ready(snapshot_path):
+                break
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc is not None:
+                    raise AssertionError(
+                        f"rank {i} exited (rc={rc}) before the first "
+                        f"snapshot at {snapshot_path!r}:\n{drain_all()}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"no snapshot at {snapshot_path!r} within {timeout}s:"
+                    f"\n{drain_all()}")
+            time.sleep(0.005)
+        if settle:
+            time.sleep(settle)
+        if procs[kill_rank].poll() is not None:
+            raise AssertionError(
+                f"rank {kill_rank} finished before the SIGKILL landed "
+                f"(nothing was interrupted):\n{drain_all()}")
+        kill_monotonic = time.monotonic()
+        procs[kill_rank].kill()  # SIGKILL: uncatchable, nothing flushes
+        procs[kill_rank].wait(timeout=60)
+
+        # The no-wedge contract: survivors exit on their own, bounded.
+        survivor_deadline = time.monotonic() + survivor_timeout
+        for i, p in enumerate(procs):
+            if i == kill_rank:
+                continue
+            remaining = survivor_deadline - time.monotonic()
+            try:
+                p.wait(timeout=max(remaining, 0.001))
+            except subprocess.TimeoutExpired:
+                raise TimeoutError(
+                    f"survivor rank {i} still running "
+                    f"{survivor_timeout}s after the kill — wedged past "
+                    f"the watchdog budget:\n{drain_all()}")
+        return WorldKillOutcome(
+            kill_rank=kill_rank,
+            returncodes={i: p.returncode for i, p in enumerate(procs)},
+            outputs={i: drain(i) for i in range(n)},
+            kill_monotonic=kill_monotonic,
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=60)
+        if rdv is not None and rdv.poll() is None:
+            rdv.kill()
+            rdv.wait(timeout=60)
+        for log in logs:
+            log.close()
 
 
 def run_to_completion(argv: Sequence[str], timeout: float = 600.0,

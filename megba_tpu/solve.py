@@ -112,6 +112,7 @@ def flat_solve(
     fault_plan=None,
     jit_cache: Optional[dict] = None,
     timer: Optional[PhaseTimer] = None,
+    elastic_report: Optional[dict] = None,
     lower_only: bool = False,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
@@ -170,6 +171,12 @@ def flat_solve(
     "execute" phase is timed and a SolveReport JSONL line is appended;
     with it disabled the solve stays fully asynchronous and the sink
     module is never even imported.
+
+    `elastic_report` (a dict, robustness.elastic.ElasticMonitor.
+    report_block()) attaches the elastic-distribution ledger to this
+    call's SolveReport line — context only, like the serving layer's
+    `fleet` block; ignored when telemetry is off and never an operand
+    of the compiled program.
 
     `lower_only=True` returns the `jax.stages.Lowered` of the exact
     program this call would have dispatched — same host prep, same
@@ -413,7 +420,7 @@ def flat_solve(
             return result
         result = _result_to_edge_major(result)
         _maybe_emit_report(telemetry, report_option, result, timer,
-                           problem_shape)
+                           problem_shape, elastic=elastic_report)
         return result
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
@@ -444,11 +451,12 @@ def flat_solve(
         result = jitted(*call_args)
     result = _result_to_edge_major(result)
     _maybe_emit_report(telemetry, report_option, result, timer,
-                       problem_shape)
+                       problem_shape, elastic=elastic_report)
     return result
 
 
-def _maybe_emit_report(telemetry, option, result, timer, problem) -> None:
+def _maybe_emit_report(telemetry, option, result, timer, problem,
+                       elastic=None) -> None:
     """Append a SolveReport JSONL line when telemetry is on; no-op (no
     sink import, no device sync) when it is off."""
     if not telemetry:
@@ -485,7 +493,8 @@ def _maybe_emit_report(telemetry, option, result, timer, problem) -> None:
     from megba_tpu.observability.report import append_report, build_report
 
     append_report(
-        build_report(option, result, timer.as_dict(), problem), telemetry)
+        build_report(option, result, timer.as_dict(), problem,
+                     elastic=elastic), telemetry)
 
 
 def _result_to_edge_major(result: LMResult) -> LMResult:

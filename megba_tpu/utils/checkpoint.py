@@ -26,6 +26,15 @@ To resume with full fidelity, thread the saved trust region back in:
 `AlgoOption(initial_region=float(state["region"]))` — otherwise the
 resumed solve restarts from the default region and re-adapts (costing a
 few extra LM iterations, not correctness).
+
+Schema v3 adds the WORLD/TOPOLOGY header (`world_size`,
+`process_index`): a snapshot records the distribution it was written
+under, so the elastic shrink-world path (robustness/elastic.py) can
+resume the same problem at a DIFFERENT world size knowingly —
+`load_state(..., expect_world_size=...)` warns, never fails, on a
+mismatch (parameters are replicated, hence world-agnostic; only the
+edge re-partition changes, and that is re-derived at lowering).  v2 and
+legacy checksum-free snapshots load unchanged.
 """
 
 from __future__ import annotations
@@ -33,6 +42,7 @@ from __future__ import annotations
 import hashlib
 import os
 import tempfile
+import warnings
 import zipfile
 from typing import Dict, Optional
 
@@ -40,8 +50,9 @@ import numpy as np
 
 # Bumped when the on-disk layout changes incompatibly; load_state
 # refuses snapshots from a NEWER schema (an older binary must not
-# half-understand a future format).
-SCHEMA_VERSION = 2
+# half-understand a future format).  v3 = world/topology header fields
+# (additive; v2 and legacy snapshots still load).
+SCHEMA_VERSION = 3
 
 _CHECKSUM_KEY = "__checksum__"
 _SCHEMA_KEY = "__schema__"
@@ -64,8 +75,13 @@ def _digest(payload: Dict[str, np.ndarray]) -> np.ndarray:
 
 def save_state(path: str, cameras, points, *, region: float = None,
                cost: float = None, iteration: int = None,
+               world_size: int = None, process_index: int = None,
                extra: Optional[Dict[str, np.ndarray]] = None) -> None:
-    """Atomically snapshot solver state to `path` (.npz, checksummed)."""
+    """Atomically snapshot solver state to `path` (.npz, checksummed).
+
+    `world_size` / `process_index` are the schema-v3 world header: the
+    distribution this snapshot was written under, consumed by the
+    elastic resume path's mismatch warning (`expect_world_size`)."""
     payload = {
         "cameras": np.asarray(cameras),
         "points": np.asarray(points),
@@ -76,6 +92,10 @@ def save_state(path: str, cameras, points, *, region: float = None,
         payload["cost"] = np.asarray(cost)
     if iteration is not None:
         payload["iteration"] = np.asarray(iteration)
+    if world_size is not None:
+        payload["world_size"] = np.asarray(int(world_size))
+    if process_index is not None:
+        payload["process_index"] = np.asarray(int(process_index))
     for k, v in (extra or {}).items():
         payload[f"extra_{k}"] = np.asarray(v)
     payload[_SCHEMA_KEY] = np.asarray(SCHEMA_VERSION)
@@ -99,13 +119,21 @@ def save_state(path: str, cameras, points, *, region: float = None,
             os.unlink(tmp)
 
 
-def load_state(path: str) -> Dict[str, np.ndarray]:
+def load_state(path: str,
+               expect_world_size: Optional[int] = None) -> Dict[str, np.ndarray]:
     """Load + validate a snapshot; dict with cameras/points (+ extras).
 
     Raises ValueError with a clear message when the file is truncated /
     not an npz (a torn copy, a partial download) or when the stored
     content checksum does not match the arrays (bit rot, a concurrent
     writer that bypassed `save_state`).  Never returns garbage state.
+
+    `expect_world_size`: the world size the RESUMING solve will run at.
+    A v3 snapshot whose recorded `world_size` differs WARNS — it does
+    not fail: elastic shrink-world resume is the sanctioned path, the
+    replicated parameter state is world-agnostic, and the edge
+    partition is re-derived at lowering.  v2/legacy snapshots carry no
+    world header and load silently.
     """
     try:
         with np.load(path) as z:
@@ -136,4 +164,14 @@ def load_state(path: str) -> Dict[str, np.ndarray]:
                 f"checkpoint {path!r} failed its content checksum — the "
                 "snapshot is corrupt; refusing to resume from garbage "
                 "state (delete it and restart)")
+    if expect_world_size is not None and "world_size" in state:
+        saved_ws = int(state["world_size"])
+        if saved_ws != int(expect_world_size):
+            warnings.warn(
+                f"checkpoint {path!r} was written at world_size "
+                f"{saved_ws} but this solve runs at world_size "
+                f"{int(expect_world_size)}; resuming anyway (elastic "
+                "shrink/grow resume — parameters are replicated and "
+                "world-agnostic, the edge partition is re-derived)",
+                stacklevel=2)
     return state

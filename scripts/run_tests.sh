@@ -365,3 +365,128 @@ assert "breaker: 1 trips" in out, out
 assert summarize.main(["--aggregate", sink]) == 0
 PY
 echo "serving chaos smoke OK"
+
+# Elastic chaos smoke (ISSUE 9): a REAL 2-process gloo solve on the
+# venice-10% configuration (f64), rank 1 SIGKILL'd the moment the first
+# world-2 snapshot lands.  Rank 0 must surface a typed WorkerLost
+# within the watchdog budget (latency asserted), resume at world 1 from
+# the schema-v3 snapshot via resume_elastic, EXIT 0 on its own (the
+# harness's survivor wait is the no-wedge gate), and match the
+# uninterrupted world-2 run at rtol 1e-6 on cost+params with equal
+# SolveStatus.  `summarize --aggregate` must render the elastic
+# counters from the telemetry stream.  Gated on the same gloo probe as
+# the multi-process pytest lane: a jaxlib without CPU collectives skips
+# loudly instead of failing.
+if JAX_PLATFORMS=cpu python -c "import sys
+from megba_tpu.parallel.multihost import cpu_cross_process_collectives_available
+sys.exit(0 if cpu_cross_process_collectives_available() else 3)"; then
+ELASTIC_DIR=$(mktemp -d /tmp/megba_elastic_smoke.XXXXXX)
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK"; rm -rf "$ELASTIC_DIR"' EXIT
+JAX_PLATFORMS=cpu MEGBA_ELASTIC_DIR="$ELASTIC_DIR" python - <<'PY'
+import importlib.util
+import os
+import re
+import socket
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+import numpy as np
+
+from megba_tpu.observability import summarize
+from megba_tpu.robustness.harness import run_world_until_snapshot_then_kill
+from megba_tpu.utils.checkpoint import load_state
+
+work = os.environ["MEGBA_ELASTIC_DIR"]
+repo = os.getcwd()
+worker = os.path.join(repo, "tests", "_elastic_worker.py")
+
+with socket.socket() as s:
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+
+hb = os.path.join(work, "hb")
+ck0 = os.path.join(work, "ck.r0.npz")
+ck1 = os.path.join(work, "ck.r1.npz")
+out0 = os.path.join(work, "result.npz")
+sink = os.path.join(work, "telemetry.jsonl")
+env = dict(os.environ)
+env.pop("XLA_FLAGS", None)  # each worker pins its own single device
+env["JAX_PLATFORMS"] = "cpu"
+env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+env["MEGBA_TELEMETRY"] = sink
+
+
+def argv(rank, ck, out):
+    return [sys.executable, worker, str(rank), str(port), "2", ck, out,
+            "venice10", hb]
+
+
+t0 = time.monotonic()
+outcome = run_world_until_snapshot_then_kill(
+    [argv(0, ck0, out0), argv(1, ck1, "-")], ck0, kill_rank=1,
+    rendezvous_argv=[sys.executable, "-m", "megba_tpu.parallel.multihost",
+                     "--serve", str(port), "2"],
+    timeout=1800.0, survivor_timeout=1800.0, env=env)
+print(f"elastic smoke: world-2 venice-10% ran {time.monotonic() - t0:.1f}s, "
+      f"rcs={outcome.returncodes}")
+assert outcome.returncodes[1] < 0, outcome.outputs[1]
+assert outcome.returncodes[0] == 0, outcome.outputs[0]
+out = outcome.outputs[0]
+m = re.search(r"ELASTIC-DETECT kind=(\w+) latency=([0-9.]+) "
+              r"budget=([0-9.]+)", out)
+assert m, f"no detection line:\n{out}"
+kind, latency, budget = m.group(1), float(m.group(2)), float(m.group(3))
+assert kind == "worker_lost", out
+assert latency <= budget, (latency, budget)
+print(f"elastic smoke: rank 1 loss detected in {latency:.3f}s "
+      f"(watchdog budget {budget:.0f}s)")
+assert "ELASTIC-RESUME world=1" in out, out
+assert int(load_state(ck0)["world_size"]) == 1
+
+# Parity vs the uninterrupted world-2 run (single-process, virtual
+# devices: same mesh size, same program, same collectives as the
+# 2-process world — the equivalence test_multihost.py pins).
+spec = importlib.util.spec_from_file_location("_elastic_worker", worker)
+ew = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ew)
+from megba_tpu.algo.checkpointed import solve_checkpointed
+from megba_tpu.common import JacobianMode
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+s, option = ew.build_problem("venice10", 2)
+f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+ref = solve_checkpointed(
+    f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+    checkpoint_path=os.path.join(work, "clean.npz"),
+    checkpoint_every=ew.CHECKPOINT_EVERY, use_tiled=False)
+res = dict(np.load(out0))
+assert int(res["status"]) == int(ref.status), (
+    int(res["status"]), int(ref.status))
+np.testing.assert_allclose(float(res["cost"]), float(ref.cost), rtol=1e-6)
+np.testing.assert_allclose(res["cameras"], np.asarray(ref.cameras),
+                           rtol=1e-6, atol=1e-9)
+np.testing.assert_allclose(res["points"], np.asarray(ref.points),
+                           rtol=1e-6, atol=1e-9)
+gap = abs(float(res["cost"]) - float(ref.cost)) / abs(float(ref.cost))
+print(f"elastic smoke: shrink-world parity OK "
+      f"(cost relgap {gap:.2e}, status {int(ref.status)})")
+
+agg = summarize.aggregate_paths([sink])
+print(agg)
+assert "1 workers lost" in agg and "1 resumes" in agg, agg
+assert "time-to-detection" in agg, agg
+PY
+echo "elastic chaos smoke OK"
+else
+echo "elastic chaos smoke SKIPPED: jaxlib CPU client lacks gloo collectives"
+fi

@@ -1,0 +1,192 @@
+"""Elastic kill-resume worker: one rank of a world-N checkpointed solve.
+
+Run as `python tests/_elastic_worker.py <rank> <port> <world> <ckpt.npz>
+<result.npz|-> <config> <heartbeat_dir>`.  All ranks join one elastic
+jax.distributed cluster (client-only; the coordination service lives in
+the sacrificial rendezvous process the harness runs — see
+parallel/multihost.serve_rendezvous) and run the SAME deterministic
+checkpointed BA solve at world_size=<world> over gloo CPU collectives,
+one device per rank, under an ElasticMonitor.
+
+When a peer is SIGKILLed mid-solve (tests/test_elastic_killresume.py,
+scripts/run_tests.sh elastic smoke), the survivor must (1) surface a
+typed WorkerLost/CollectiveTimeout within the watchdog budget — printed
+as the ELASTIC-DETECT line the harness asserts on — then (2)
+resume_elastic at world 1 from the latest schema-v3 snapshot and run to
+completion, writing the final result for the parity check against an
+uninterrupted run.  Everything that could differ between runs is pinned
+(x64, CPU backend, one device per rank, persistent compile cache).
+"""
+
+import os
+import sys
+
+# Runnable from any cwd: the repo root is this file's parent's parent.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Worker-process pinning ONLY when run as a script: the pytest/smoke
+# orchestrators IMPORT this module for `build_problem` (so reference and
+# worker solve byte-identical problems) and own their own backend setup.
+if __name__ == "__main__":
+    # One CPU device per rank, pinned BEFORE jax import.
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+import numpy as np  # noqa: E402
+
+from megba_tpu.algo.checkpointed import solve_checkpointed  # noqa: E402
+from megba_tpu.common import (  # noqa: E402
+    AlgoOption,
+    ComputeKind,
+    JacobianMode,
+    ProblemOption,
+    SolverOption,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal  # noqa: E402
+from megba_tpu.ops.residuals import make_residual_jacobian_fn  # noqa: E402
+from megba_tpu.parallel.multihost import (  # noqa: E402
+    enable_cpu_cross_process_collectives,
+    initialize_multihost,
+)
+from megba_tpu.robustness.elastic import (  # noqa: E402
+    CollectiveTimeout,
+    ElasticConfig,
+    ElasticMonitor,
+    WorkerLost,
+    resume_elastic,
+)
+
+CHECKPOINT_EVERY = 2
+
+
+def build_problem(config: str, world: int):
+    """(synthetic problem, ProblemOption) for a named config — shared by
+    the workers, the pytest parity reference and the run_tests.sh smoke
+    so all three solve byte-identical problems."""
+    if config == "tiny":
+        s = make_synthetic_bal(
+            num_cameras=6, num_points=90, obs_per_point=5, seed=7,
+            param_noise=3e-2, pixel_noise=0.3, dtype=np.float64)
+        option = ProblemOption(
+            dtype=np.float64,
+            world_size=world,
+            compute_kind=ComputeKind.IMPLICIT,
+            jacobian_mode=JacobianMode.ANALYTICAL,
+            algo_option=AlgoOption(max_iter=8, epsilon1=1e-12,
+                                   epsilon2=1e-15),
+            solver_option=SolverOption(max_iter=30, tol=1e-12,
+                                       refuse_ratio=1e30),
+        )
+    elif config == "venice10":
+        # The venice-10% scale the fault smoke uses, in f64 so the
+        # shrink-world parity gate can ride the rtol 1e-6 contract.
+        s = make_synthetic_bal(
+            num_cameras=177, num_points=99392,
+            obs_per_point=5_001_946 / 993_923, seed=0,
+            param_noise=1e-2, pixel_noise=0.5, dtype=np.float64)
+        option = ProblemOption(
+            dtype=np.float64,
+            world_size=world,
+            compute_kind=ComputeKind.IMPLICIT,
+            jacobian_mode=JacobianMode.ANALYTICAL,
+            algo_option=AlgoOption(max_iter=6, epsilon1=1e-12,
+                                   epsilon2=1e-15),
+            solver_option=SolverOption(max_iter=30, tol=1e-10,
+                                       refuse_ratio=1e30),
+        )
+    else:
+        raise ValueError(f"unknown config {config!r}")
+    return s, option
+
+
+def elastic_config(rank: int, world: int, heartbeat_dir: str) -> ElasticConfig:
+    """The budgets the kill harness asserts against: a dead peer must
+    surface within ~dead_after_s (well inside watchdog_s); the first
+    dispatch of each (re)lowered program gets the compile grace."""
+    return ElasticConfig(
+        heartbeat_dir=heartbeat_dir, rank=rank, world=world,
+        interval_s=0.1, straggler_after_s=0.6, dead_after_s=1.5,
+        watchdog_s=30.0, compile_grace_s=1200.0, poll_s=0.05)
+
+
+def dump_result(path: str, res, detect_kind: str,
+                detect_latency_s: float) -> None:
+    payload = {
+        "cameras": np.asarray(res.cameras),
+        "points": np.asarray(res.points),
+        "cost": np.asarray(float(res.cost)),
+        "iterations": np.asarray(int(res.iterations)),
+        "status": np.asarray(int(res.status)),
+        "detect_kind": np.asarray(detect_kind),
+        "detect_latency_s": np.asarray(float(detect_latency_s)),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **payload)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    world = int(sys.argv[3])
+    ckpt = sys.argv[4]
+    out = sys.argv[5]
+    config = sys.argv[6]
+    hb_dir = sys.argv[7]
+
+    # gloo CPU collectives, selected before backend init; elastic
+    # (survivable) bring-up against the external rendezvous daemon.
+    assert enable_cpu_cross_process_collectives(), \
+        "jaxlib has no gloo CPU collectives"
+    info = initialize_multihost(f"localhost:{port}", world, rank,
+                                elastic=True)
+    assert info["process_count"] == world, info
+
+    s, option = build_problem(config, world)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    args = (f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx)
+    cfg = elastic_config(rank, world, hb_dir)
+    detect_kind, detect_latency = "none", float("nan")
+    with ElasticMonitor(cfg) as monitor:
+        try:
+            res = solve_checkpointed(
+                *args, option, checkpoint_path=ckpt,
+                checkpoint_every=CHECKPOINT_EVERY, use_tiled=False,
+                elastic=monitor)
+            print(f"worker {rank} CLEAN cost {float(res.cost):.17e} "
+                  f"iters {int(res.iterations)}", flush=True)
+        except (WorkerLost, CollectiveTimeout) as exc:
+            detect_kind = ("worker_lost" if isinstance(exc, WorkerLost)
+                           else "collective_timeout")
+            detect_latency = getattr(exc, "detected_after_s",
+                                     getattr(exc, "elapsed_s", float("nan")))
+            print(f"worker {rank} ELASTIC-DETECT kind={detect_kind} "
+                  f"latency={detect_latency:.3f} "
+                  f"budget={cfg.watchdog_s:.3f}", flush=True)
+            res = resume_elastic(
+                *args, option, ckpt, world_size=1, monitor=monitor,
+                checkpoint_every=CHECKPOINT_EVERY, use_tiled=False)
+            print(f"worker {rank} ELASTIC-RESUME world=1 "
+                  f"cost={float(res.cost):.17e} "
+                  f"iters={int(res.iterations)} "
+                  f"status={int(res.status)}", flush=True)
+    if out != "-":
+        dump_result(out, res, detect_kind, detect_latency)
+    print(f"worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,671 @@
+"""Elastic-distribution unit tests: compile-free tier-1 coverage.
+
+Everything here runs without tracing or compiling a solver program
+(tier-1 is near its time budget): the HeartbeatBoard and
+CollectiveWatchdog state machines under injected clocks, the
+ElasticMonitor guard with real threads but trivial host functions (the
+no-wedge regression), the multihost init/shutdown state machine with
+the jax calls monkeypatched out, the schema-v3 checkpoint header, the
+local-devices mesh scope, the N-process harness driven by stub
+subprocesses, and the summarize --aggregate elastic view.  The
+real-collectives / real-SIGKILL lane lives in
+tests/test_elastic_killresume.py (slow) and the run_tests.sh elastic
+smoke.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from megba_tpu.robustness.elastic import (
+    CollectiveTimeout,
+    CollectiveWatchdog,
+    ElasticConfig,
+    ElasticError,
+    ElasticMonitor,
+    HeartbeatBoard,
+    RankState,
+    WorkerLost,
+)
+from megba_tpu.utils.checkpoint import SCHEMA_VERSION, load_state, save_state
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# --------------------------------------------------- HeartbeatBoard
+
+
+def test_board_classifies_alive_straggler_dead(tmp_path):
+    clock = FakeClock()
+    b0 = HeartbeatBoard(str(tmp_path), 0, 2, straggler_after_s=1.0,
+                        dead_after_s=3.0, clock=clock)
+    b1 = HeartbeatBoard(str(tmp_path), 1, 2, straggler_after_s=1.0,
+                        dead_after_s=3.0, clock=clock)
+    b1.beat()
+    assert b0.observe() == {1: RankState.ALIVE}
+    clock.advance(1.5)  # past straggler, short of dead
+    assert b0.observe() == {1: RankState.STRAGGLER}
+    b1.beat()  # a fresh beat resurrects the straggler
+    assert b0.observe() == {1: RankState.ALIVE}
+    clock.advance(3.0)
+    assert b0.observe() == {1: RankState.DEAD}
+    assert b0.dead_ranks() == [1]
+    assert b0.staleness(1) == pytest.approx(3.0)
+
+
+def test_board_never_seen_rank_unknown_then_dead(tmp_path):
+    """A rank that never joins is UNKNOWN inside the join grace
+    (anchored at the FIRST observation, not process start), DEAD past
+    it — a worker that never came up is as lost as one that died."""
+    clock = FakeClock(100.0)
+    b0 = HeartbeatBoard(str(tmp_path), 0, 3, straggler_after_s=0.5,
+                        dead_after_s=2.0, clock=clock)
+    assert b0.observe() == {1: RankState.UNKNOWN, 2: RankState.UNKNOWN}
+    clock.advance(1.9)
+    assert b0.observe() == {1: RankState.UNKNOWN, 2: RankState.UNKNOWN}
+    clock.advance(0.2)
+    assert b0.observe() == {1: RankState.DEAD, 2: RankState.DEAD}
+
+
+def test_board_beat_counter_not_wall_clock(tmp_path):
+    """Liveness rides counter CHANGES on the observer's clock — a peer
+    whose file content never changes goes stale even though the file
+    exists, and cross-process wall clocks are never compared."""
+    clock = FakeClock()
+    b0 = HeartbeatBoard(str(tmp_path), 0, 2, straggler_after_s=0.5,
+                        dead_after_s=1.0, clock=clock)
+    with open(b0.path_for(1), "w") as fh:
+        fh.write("7 123\n")  # frozen counter
+    assert b0.observe() == {1: RankState.ALIVE}
+    clock.advance(0.7)
+    assert b0.observe() == {1: RankState.STRAGGLER}
+    clock.advance(0.5)
+    assert b0.observe() == {1: RankState.DEAD}
+    # A torn/garbage file reads as "no beat", not a crash.
+    with open(b0.path_for(1), "w") as fh:
+        fh.write("not-a-counter")
+    assert b0.observe() == {1: RankState.DEAD}
+
+
+def test_board_validates_configuration(tmp_path):
+    with pytest.raises(ValueError, match="outside world"):
+        HeartbeatBoard(str(tmp_path), 3, 2)
+    with pytest.raises(ValueError, match="straggler_after_s"):
+        HeartbeatBoard(str(tmp_path), 0, 2, straggler_after_s=5.0,
+                       dead_after_s=1.0)
+
+
+# --------------------------------------------------- CollectiveWatchdog
+
+
+def test_watchdog_arm_check_disarm_across_dispatches():
+    clock = FakeClock()
+    w = CollectiveWatchdog(clock=clock)
+    t1 = w.arm("chunk@iter0", 10.0, now=0.0)
+    assert w.armed_count() == 1
+    assert w.check(t1, now=9.0) == pytest.approx(9.0)
+    assert w.disarm(t1, now=9.5) == pytest.approx(9.5)
+    assert w.armed_count() == 0
+    # Re-arming for the next dispatch is a fresh deadline.
+    t2 = w.arm("chunk@iter2", 10.0, now=20.0)
+    assert w.check(t2, now=29.0) == pytest.approx(9.0)
+    w.disarm(t2, now=29.0)
+    assert w.timeouts == 0
+
+
+def test_watchdog_timeout_payload_and_counter():
+    w = CollectiveWatchdog(clock=FakeClock())
+    tok = w.arm("chunk@iter4", 2.0, now=100.0)
+    assert w.expired(now=101.0) == []
+    assert w.expired(now=103.0) == [(tok, "chunk@iter4", 3.0)]
+    with pytest.raises(CollectiveTimeout) as ei:
+        w.check(tok, now=103.0)
+    exc = ei.value
+    assert exc.label == "chunk@iter4"
+    assert exc.budget_s == pytest.approx(2.0)
+    assert exc.elapsed_s == pytest.approx(3.0)
+    assert isinstance(exc, ElasticError)
+    assert w.timeouts == 1
+    # The token stays armed: the guard's cleanup still owns the disarm.
+    assert w.disarm(tok, now=103.0) == pytest.approx(3.0)
+    with pytest.raises(ValueError, match="not armed"):
+        w.disarm(tok)
+
+
+def test_watchdog_rejects_bad_budgets_and_tokens():
+    w = CollectiveWatchdog(clock=FakeClock())
+    with pytest.raises(ValueError, match="budget_s"):
+        w.arm("x", 0.0)
+    with pytest.raises(ValueError, match="not armed"):
+        w.check(99)
+
+
+# --------------------------------------------------- ElasticMonitor guard
+
+
+def _fast_config(tmp_path, world=2, **kw):
+    defaults = dict(heartbeat_dir=str(tmp_path / "hb"), rank=0, world=world,
+                    interval_s=0.05, straggler_after_s=0.1,
+                    dead_after_s=0.25, watchdog_s=5.0,
+                    compile_grace_s=0.0, poll_s=0.02)
+    defaults.update(kw)
+    return ElasticConfig(**defaults)
+
+
+def test_guard_dead_peer_never_wedges_and_monitor_survives(tmp_path):
+    """The no-wedge contract: a dispatch parked forever with a silent
+    peer surfaces as a typed WorkerLost within ~dead_after_s — and the
+    monitor keeps working afterwards (the abandoned worker thread
+    cannot poison the next guard)."""
+    with ElasticMonitor(_fast_config(tmp_path)) as m:
+        blocker = threading.Event()
+        t0 = time.monotonic()
+        with pytest.raises(WorkerLost) as ei:
+            m.guard("chunk@iter0", blocker.wait)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, "typed error took longer than the watchdog"
+        assert ei.value.ranks == (1,)
+        assert ei.value.label == "chunk@iter0"
+        assert ei.value.detected_after_s <= 5.0
+        assert m.workers_lost == 1
+        assert len(m.detection_s) == 1
+        # Monitor (dispatcher side) survives: a later guard still runs.
+        assert m.guard("after", lambda: 41 + 1) == 42
+        blocker.set()
+
+
+def test_guard_timeout_with_live_peer_is_collective_timeout(tmp_path):
+    """A wedged dispatch while every peer still beats is a
+    CollectiveTimeout (straggler semantics), not a WorkerLost."""
+    cfg = _fast_config(tmp_path, watchdog_s=0.3, dead_after_s=10.0,
+                       straggler_after_s=5.0)
+    with ElasticMonitor(cfg) as m:
+        peer = HeartbeatBoard(cfg.heartbeat_dir, 1, 2)
+        stop = threading.Event()
+
+        def keep_beating():
+            while not stop.wait(0.03):
+                peer.beat()
+
+        beater = threading.Thread(target=keep_beating, daemon=True)
+        peer.beat()
+        beater.start()
+        try:
+            blocker = threading.Event()
+            with pytest.raises(CollectiveTimeout) as ei:
+                m.guard("chunk@iter2", blocker.wait)
+            assert ei.value.budget_s == pytest.approx(0.3)
+            assert m.collective_timeouts == 1
+            blocker.set()
+        finally:
+            stop.set()
+
+
+def test_guard_first_dispatch_compile_grace(tmp_path):
+    """The first guarded dispatch of EACH program (grace_key) gets
+    watchdog_s + compile_grace_s (jit compilation rides a program's
+    first call); repeats of a seen key drop to the bare budget.
+    Verified through the watchdog's armed budget — no sleeping."""
+    cfg = _fast_config(tmp_path, world=1, watchdog_s=1.0,
+                       compile_grace_s=9.0)
+    m = ElasticMonitor(cfg)
+    budgets = []
+    real_arm = m.watchdog.arm
+
+    def spy_arm(label, budget_s, now=None):
+        budgets.append(budget_s)
+        return real_arm(label, budget_s, now)
+
+    m.watchdog.arm = spy_arm
+    assert m.guard("first", lambda: 1) == 1
+    assert m.guard("second", lambda: 2) == 2
+    assert budgets == [10.0, 1.0]
+    # A DIFFERENT program (e.g. a short final chunk, or the 0-iter
+    # evaluate dispatch — max_iter is static) gets its own grace.
+    assert m.guard("chunk2", lambda: 9, grace_key=("chunk", 2)) == 9
+    assert m.guard("chunk2b", lambda: 9, grace_key=("chunk", 2)) == 9
+    assert m.guard("evaluate", lambda: 9, grace_key=("chunk", 0)) == 9
+    assert budgets == [10.0, 1.0, 10.0, 1.0, 10.0]
+    # A reshard re-grants every grace: the shrunk mesh re-lowers all
+    # programs.
+    m.record_reshard(2, 1)
+    assert m.guard("resumed", lambda: 3, grace_key=("chunk", 2)) == 3
+    assert budgets == [10.0, 1.0, 10.0, 1.0, 10.0, 10.0]
+    m.stop()
+
+
+def test_guard_classifies_dispatch_error_with_dead_peer(tmp_path):
+    """gloo surfaces a SIGKILL'd peer as a transport error within
+    milliseconds — before the heartbeat threshold can elapse.  The
+    guard must wait out the death window and classify it WorkerLost
+    (with the original error as __cause__), not leak a bare
+    ValueError."""
+    with ElasticMonitor(_fast_config(tmp_path)) as m:
+        def exploding_dispatch():
+            raise ValueError("Gloo all-reduce failed: connection reset")
+
+        with pytest.raises(WorkerLost) as ei:
+            m.guard("chunk@iter0", exploding_dispatch)
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert m.workers_lost == 1
+
+
+def test_guard_passes_through_genuine_errors_when_peers_alive(tmp_path):
+    """A dispatch exception with every peer beating is the program's
+    own failure and must surface unchanged."""
+    cfg = _fast_config(tmp_path, dead_after_s=0.2, straggler_after_s=0.1)
+    with ElasticMonitor(cfg) as m:
+        peer = HeartbeatBoard(cfg.heartbeat_dir, 1, 2)
+        stop = threading.Event()
+
+        def keep_beating():
+            while not stop.wait(0.03):
+                peer.beat()
+
+        beater = threading.Thread(target=keep_beating, daemon=True)
+        peer.beat()
+        beater.start()
+        try:
+            with pytest.raises(ZeroDivisionError):
+                m.guard("chunk@iter0", lambda: 1 / 0)
+            assert m.workers_lost == 0
+        finally:
+            stop.set()
+
+
+def test_check_peers_retired_after_reshard(tmp_path):
+    """After the reshard the lost peers are retired: liveness checks
+    stop raising (the shrunk world no longer contains them)."""
+    with ElasticMonitor(_fast_config(tmp_path)) as m:
+        m.check_peers()  # anchors rank 1's join grace (UNKNOWN for now)
+        time.sleep(0.3)  # nobody ever beats for rank 1: grace expires
+        with pytest.raises(WorkerLost):
+            m.check_peers()
+        m.record_reshard(2, 1)
+        m.check_peers()  # no raise
+        m.record_reshard(2, 1)  # idempotent per transition
+        assert m.reshards == 1
+        m.record_resume()
+        block = m.report_block()
+        assert block["workers_lost"] == 1
+        assert block["reshards"] == 1 and block["resumes"] == 1
+        assert block["monitor"]
+        # Transitions also landed as PhaseTimer events.
+        counts = {k: v["calls"] for k, v in m.timer.as_dict().items()}
+        assert counts["elastic_worker_lost"] == 1
+        assert counts["elastic_reshard"] == 1
+        assert counts["elastic_resume"] == 1
+
+
+def test_monitor_ensure_contract(tmp_path):
+    monitor, owned = ElasticMonitor.ensure(None)
+    assert monitor is None and owned is False
+    cfg = _fast_config(tmp_path, world=1)
+    m1, owned = ElasticMonitor.ensure(cfg)
+    assert owned is True and m1._beater.is_alive()
+    m1.stop()
+    m2, owned = ElasticMonitor.ensure(m1)
+    assert m2 is m1 and owned is False and m1._beater.is_alive()
+    m1.stop()
+    with pytest.raises(TypeError, match="ElasticConfig or ElasticMonitor"):
+        ElasticMonitor.ensure("nope")
+
+
+def test_elastic_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="outside world"):
+        ElasticConfig(heartbeat_dir=str(tmp_path), rank=2, world=2)
+    with pytest.raises(ValueError, match="watchdog_s"):
+        ElasticConfig(heartbeat_dir=str(tmp_path), watchdog_s=0.0)
+    with pytest.raises(ValueError, match="straggler_after_s"):
+        ElasticConfig(heartbeat_dir=str(tmp_path), straggler_after_s=9.0,
+                      dead_after_s=1.0)
+
+
+# --------------------------------------------------- multihost state machine
+
+
+def test_initialize_idempotent_and_reinit_after_shutdown(monkeypatch):
+    """The satellite contract: exact-repeat init is a no-op; different
+    params while initialized raise; after shutdown_multihost a process
+    may legally re-initialize at a DIFFERENT world size."""
+    from megba_tpu.parallel import multihost as mh
+
+    inits = []
+    state = {"up": False}
+    monkeypatch.setattr(mh, "_distributed_is_initialized",
+                        lambda: state["up"])
+    monkeypatch.setattr(mh, "_elastic_connect",
+                        lambda addr, pid: f"client:{addr}:{pid}")
+
+    def fake_install(client, addr, n, pid):
+        inits.append((client, addr, n, pid))
+        state["up"] = True
+
+    monkeypatch.setattr(mh, "_install_distributed_state", fake_install)
+    monkeypatch.setattr(mh.jax, "process_index", lambda: 0)
+    monkeypatch.setattr(mh.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(mh.jax, "local_devices", lambda: [object()])
+    monkeypatch.setattr(mh.jax, "devices", lambda: [object(), object()])
+    monkeypatch.setattr(mh, "_initialized_with", None)
+
+    info = mh.initialize_multihost("localhost:1234", 2, 0, elastic=True)
+    assert info["process_count"] == 2 and len(inits) == 1
+    # Exact repeat: idempotent, no second bring-up.
+    mh.initialize_multihost("localhost:1234", 2, 0, elastic=True)
+    assert len(inits) == 1
+    # Different params while initialized: hard error naming the remedy.
+    with pytest.raises(RuntimeError, match="shutdown_multihost"):
+        mh.initialize_multihost("localhost:1234", 1, 0, elastic=True)
+
+    # Shutdown (abandon): resets the record without touching the
+    # barrier-bearing paths; re-init at a DIFFERENT world size is legal.
+    class FakeState:
+        client = "c"
+        coordinator_address = "a"
+
+    fake = FakeState()
+    monkeypatch.setattr(mh, "_global_state", lambda: fake)
+    assert mh.shutdown_multihost(abandon=True) is True
+    assert fake.client is None
+    state["up"] = False
+    mh.initialize_multihost("localhost:1234", 1, 0, elastic=True)
+    assert len(inits) == 2 and inits[-1][2] == 1
+    # Cleanup for other tests.
+    monkeypatch.setattr(mh, "_initialized_with", None)
+
+
+def test_shutdown_not_initialized_is_noop(monkeypatch):
+    from megba_tpu.parallel import multihost as mh
+
+    monkeypatch.setattr(mh, "_distributed_is_initialized", lambda: False)
+    assert mh.shutdown_multihost() is False
+
+
+class _FakeClient:
+    def __init__(self, block=False):
+        self.block = block
+        self.started = threading.Event()
+        self.calls = 0
+
+    def shutdown(self):
+        self.started.set()
+        self.calls += 1
+        if self.block:
+            threading.Event().wait()  # never returns (dead-peer barrier)
+
+
+def test_shutdown_graceful_bounded_when_peer_dead(monkeypatch):
+    """The cooperative path must never block past timeout_s: a shutdown
+    barrier wedged on a dead peer is abandoned (daemon thread, working
+    only on CAPTURED refs — it can never clobber a later re-init's
+    state) and the jax-level state force-reset, like abandon=True."""
+    from megba_tpu.parallel import multihost as mh
+
+    monkeypatch.setattr(mh, "_distributed_is_initialized", lambda: True)
+    client = _FakeClient(block=True)
+
+    class FakeState:
+        coordinator_address = "a"
+
+    fake = FakeState()
+    fake.client = client
+    monkeypatch.setattr(mh, "_global_state", lambda: fake)
+    t0 = time.monotonic()
+    assert mh.shutdown_multihost(timeout_s=0.2) is True
+    assert time.monotonic() - t0 < 2.0
+    assert client.started.is_set() and fake.client is None
+
+
+def test_shutdown_graceful_fast_path(monkeypatch):
+    """Cooperative teardown: the CAPTURED client's barrier runs (not
+    jax.distributed.shutdown, whose eventual return would null whatever
+    client is globally installed at that moment), then the jax-level
+    refs are dropped by this call itself."""
+    from megba_tpu.parallel import multihost as mh
+
+    monkeypatch.setattr(mh, "_distributed_is_initialized", lambda: True)
+    client = _FakeClient()
+    fake = type("S", (), {"coordinator_address": "a"})()
+    fake.client = client
+    monkeypatch.setattr(mh, "_global_state", lambda: fake)
+    assert mh.shutdown_multihost(timeout_s=5.0) is True
+    assert client.calls == 1
+    assert fake.client is None
+
+
+def test_elastic_requires_explicit_rendezvous():
+    from megba_tpu.parallel import multihost as mh
+
+    with pytest.raises(ValueError, match="explicit"):
+        mh.initialize_multihost(elastic=True)
+
+
+# --------------------------------------------------- mesh local scope
+
+
+def test_make_mesh_local_devices_only_scope():
+    import jax
+
+    from megba_tpu.parallel.mesh import (
+        local_devices_only,
+        local_only_active,
+        make_mesh,
+    )
+
+    assert not local_only_active()
+    with local_devices_only():
+        assert local_only_active()
+        with local_devices_only():  # re-entrant
+            assert local_only_active()
+        assert local_only_active()
+        mesh = make_mesh(2)
+        pi = jax.process_index()
+        assert all(d.process_index == pi for d in mesh.devices.flat)
+    assert not local_only_active()
+
+
+# --------------------------------------------------- checkpoint schema v3
+
+
+def test_snapshot_world_header_roundtrip(tmp_path):
+    path = str(tmp_path / "snap.npz")
+    save_state(path, np.ones((2, 2)), np.zeros((3,)), region=1.0,
+               iteration=4, world_size=8, process_index=3)
+    st = load_state(path)
+    assert int(st["world_size"]) == 8
+    assert int(st["process_index"]) == 3
+    assert SCHEMA_VERSION == 3
+
+
+def test_snapshot_world_mismatch_warns_not_fails(tmp_path):
+    path = str(tmp_path / "snap.npz")
+    save_state(path, np.ones((2, 2)), np.zeros((3,)), world_size=2)
+    with pytest.warns(UserWarning, match="elastic shrink/grow"):
+        st = load_state(path, expect_world_size=1)
+    assert int(st["world_size"]) == 2  # loaded anyway: the sanctioned path
+    # Matching world: silent.
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        load_state(path, expect_world_size=2)
+
+
+def test_snapshot_v2_and_legacy_load_unchanged(tmp_path):
+    from megba_tpu.utils import checkpoint as ckpt
+
+    # A v2 snapshot (pre-world-header): loads silently even when the
+    # caller states an expectation — there is nothing to compare.
+    path = str(tmp_path / "v2.npz")
+    payload = {"cameras": np.ones((2, 2)), "points": np.zeros((3,)),
+               ckpt._SCHEMA_KEY: np.asarray(2)}
+    payload[ckpt._CHECKSUM_KEY] = ckpt._digest(payload)
+    np.savez(path, **payload)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        st = load_state(path, expect_world_size=4)
+    assert "world_size" not in st
+    # Legacy checksum-free: best-effort pass-through, unchanged.
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, cameras=np.ones((2, 2)), points=np.zeros((3,)))
+    st = load_state(legacy, expect_world_size=4)
+    np.testing.assert_array_equal(st["cameras"], np.ones((2, 2)))
+
+
+def test_snapshot_v3_corrupt_truncated_repinned(tmp_path):
+    """The corruption contract survives the v3 header: truncation and
+    checksum failure still refuse with the same clear errors."""
+    path = str(tmp_path / "v3.npz")
+    save_state(path, np.ones((4, 4)), np.zeros((5,)), region=2.0,
+               iteration=1, world_size=2, process_index=0)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(ValueError, match="corrupt or truncated"):
+        load_state(path)
+    # Valid zip, tampered array: only the content checksum catches it.
+    save_state(path, np.ones((4, 4)), np.zeros((5,)), world_size=2)
+    with np.load(path) as z:
+        st = {k: z[k] for k in z.files}
+    st["world_size"] = np.asarray(7)  # tampered header, stale checksum
+    np.savez(path, **st)
+    with pytest.raises(ValueError, match="checksum"):
+        load_state(path)
+
+
+# --------------------------------------------------- world kill harness
+
+
+def _stub_worker(body: str) -> list:
+    return [sys.executable, "-c", textwrap.dedent(body)]
+
+
+def test_world_harness_kills_rank_and_collects_survivors(tmp_path):
+    from megba_tpu.robustness.harness import run_world_until_snapshot_then_kill
+
+    snap = str(tmp_path / "snap.npz")
+    rank0 = _stub_worker(f"""
+        import time
+        open({snap!r}, "w").write("x" * 64)
+        time.sleep(0.8)   # "detect + resume", then exit on its own
+        print("rank0 resumed")
+    """)
+    rank1 = _stub_worker("""
+        import time
+        time.sleep(300)   # parked in the "collective" until SIGKILLed
+    """)
+    outcome = run_world_until_snapshot_then_kill(
+        [rank0, rank1], snap, kill_rank=1, timeout=30,
+        survivor_timeout=30)
+    assert outcome.kill_rank == 1
+    assert outcome.returncodes[1] == -9  # SIGKILL, nothing graceful
+    assert outcome.returncodes[0] == 0
+    assert "rank0 resumed" in outcome.outputs[0]
+
+
+def test_world_harness_flags_wedged_survivor(tmp_path):
+    """A survivor that does NOT exit within the grace is the failure
+    the harness exists to catch — named, with outputs, not a hang."""
+    from megba_tpu.robustness.harness import run_world_until_snapshot_then_kill
+
+    snap = str(tmp_path / "snap.npz")
+    rank0 = _stub_worker(f"""
+        import time
+        open({snap!r}, "w").write("x" * 64)
+        time.sleep(300)   # wedged: never exits
+    """)
+    rank1 = _stub_worker("import time; time.sleep(300)")
+    with pytest.raises(TimeoutError, match="wedged past the watchdog"):
+        run_world_until_snapshot_then_kill(
+            [rank0, rank1], snap, kill_rank=1, timeout=30,
+            survivor_timeout=1.0)
+
+
+def test_world_harness_rejects_early_exit_before_snapshot(tmp_path):
+    from megba_tpu.robustness.harness import run_world_until_snapshot_then_kill
+
+    snap = str(tmp_path / "never.npz")
+    rank0 = _stub_worker("print('crashed early'); raise SystemExit(3)")
+    rank1 = _stub_worker("import time; time.sleep(300)")
+    with pytest.raises(AssertionError, match="rank 0 exited"):
+        run_world_until_snapshot_then_kill(
+            [rank0, rank1], snap, kill_rank=1, timeout=30)
+
+
+def test_world_harness_validates_kill_rank(tmp_path):
+    from megba_tpu.robustness.harness import run_world_until_snapshot_then_kill
+
+    with pytest.raises(ValueError, match="kill_rank"):
+        run_world_until_snapshot_then_kill(
+            [["true"]], str(tmp_path / "s.npz"), kill_rank=5)
+
+
+# --------------------------------------------------- summarize elastic view
+
+
+def _elastic_report_line(monitor_id, created, **counters):
+    from megba_tpu.observability.report import SolveReport
+
+    block = {"monitor": monitor_id, "rank": 0, "world": 2,
+             "workers_lost": 0, "collective_timeouts": 0, "reshards": 0,
+             "resumes": 0, "detection_s": []}
+    block.update(counters)
+    return SolveReport(
+        problem={}, config={}, backend={}, phases={},
+        result={"status_name": "converged"}, elastic=block,
+        created_unix=created).to_json()
+
+
+def test_aggregate_renders_elastic_counters(tmp_path):
+    """Per-chunk elastic blocks are cumulative snapshots: the aggregate
+    must keep the LAST per monitor and sum ACROSS monitors — and render
+    the time-to-detection percentiles."""
+    from megba_tpu.observability import summarize
+
+    sink = tmp_path / "elastic.jsonl"
+    lines = [
+        # monitor A: two chunk snapshots, later one supersedes
+        _elastic_report_line("aaa", 100.0, workers_lost=1,
+                             detection_s=[1.5]),
+        _elastic_report_line("aaa", 200.0, workers_lost=1, reshards=1,
+                             resumes=1, detection_s=[1.5]),
+        # monitor B: a straggler timeout on another rank
+        _elastic_report_line("bbb", 150.0, collective_timeouts=2,
+                             workers_lost=1, detection_s=[0.5]),
+    ]
+    sink.write_text("\n".join(lines) + "\n")
+    out = summarize.aggregate_paths([str(sink)])
+    assert ("elastic: 2 workers lost, 2 collective timeouts, 1 reshards, "
+            "1 resumes (2 monitors)") in out
+    assert "time-to-detection: p50 0.500s / max 1.500s over 2 losses" in out
+    assert summarize.main(["--aggregate", str(sink)]) == 0
+
+
+def test_report_without_elastic_block_renders_no_elastic_line(tmp_path):
+    from megba_tpu.observability import summarize
+    from megba_tpu.observability.report import SolveReport
+
+    sink = tmp_path / "plain.jsonl"
+    sink.write_text(SolveReport(
+        problem={}, config={}, backend={}, phases={},
+        result={"status_name": "converged"},
+        created_unix=1.0).to_json() + "\n")
+    out = summarize.aggregate_paths([str(sink)])
+    assert "elastic:" not in out

@@ -1,0 +1,179 @@
+"""Elastic kill-resume: a REAL multi-process worker death, end to end.
+
+The multi-process analogue of tests/test_killresume.py's bitwise
+kill-resume proof: two rank processes join one elastic gloo cluster
+(client-only; the coordination service lives in a sacrificial
+rendezvous process) and run ONE world-2 checkpointed BA solve.  The
+harness SIGKILLs rank 1 the moment the first world-2 snapshot lands —
+mid-chunk, no atexit, no flush — and rank 0 must then, ON ITS OWN:
+
+1. surface the loss as a typed `WorkerLost` within the watchdog budget
+   (the ELASTIC-DETECT line carries the measured time-to-detection);
+2. tear down the distributed runtime and resume at world 1 from the
+   latest schema-v3 snapshot (`resume_elastic`);
+3. run to completion and EXIT 0 — the no-wedge contract is enforced by
+   the harness itself (a survivor still running past the grace is a
+   TimeoutError).
+
+The result must match an uninterrupted single-process world-2 run of
+the byte-identical problem at the sharded-parity tolerance: rtol 1e-6
+on final cost AND parameters, equal SolveStatus.  (A 2-process world-2
+solve matches the single-process world-2 solve — same mesh size, same
+program, same collectives — per test_multihost.py's parity lane, so
+the single-process run is a valid clean reference.)
+"""
+
+import importlib.util
+import os
+import re
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+from megba_tpu.parallel.multihost import (
+    cpu_cross_process_collectives_available,
+)
+from megba_tpu.robustness.harness import run_world_until_snapshot_then_kill
+from megba_tpu.utils.checkpoint import load_state
+
+needs_cpu_collectives = pytest.mark.skipif(
+    not cpu_cross_process_collectives_available(),
+    reason="jaxlib CPU client lacks gloo TCP collectives: multiprocess "
+           "computations aren't implemented on the plain CPU backend")
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_elastic_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _load_worker_module():
+    spec = importlib.util.spec_from_file_location("_elastic_worker", _WORKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+@needs_cpu_collectives
+def test_world2_sigkill_rank1_detect_shrink_resume_parity(tmp_path,
+                                                          retrace_sentinel):
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    hb_dir = str(tmp_path / "hb")
+    ck0 = str(tmp_path / "ck.r0.npz")
+    ck1 = str(tmp_path / "ck.r1.npz")
+    out0 = str(tmp_path / "result.npz")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # each worker pins its own single device
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    def worker_argv(rank: int, ckpt: str, out: str):
+        return [sys.executable, _WORKER, str(rank), str(port), "2",
+                ckpt, out, "tiny", hb_dir]
+
+    rendezvous = [sys.executable, "-m", "megba_tpu.parallel.multihost",
+                  "--serve", str(port), "2"]
+    outcome = run_world_until_snapshot_then_kill(
+        [worker_argv(0, ck0, out0), worker_argv(1, ck1, "-")],
+        ck0, kill_rank=1, rendezvous_argv=rendezvous,
+        timeout=600.0, survivor_timeout=600.0, env=env)
+
+    # Rank 1 died by SIGKILL; rank 0 detected, resumed, and exited 0
+    # on its own (the harness's survivor wait IS the no-wedge gate).
+    assert outcome.returncodes[1] < 0, outcome.outputs[1]
+    assert outcome.returncodes[0] == 0, outcome.outputs[0]
+    out = outcome.outputs[0]
+
+    # Typed detection within the watchdog budget, latency measured.
+    m = re.search(r"ELASTIC-DETECT kind=(\w+) latency=([0-9.]+) "
+                  r"budget=([0-9.]+)", out)
+    assert m, f"rank 0 printed no detection line:\n{out}"
+    kind, latency, budget = m.group(1), float(m.group(2)), float(m.group(3))
+    assert kind == "worker_lost", out
+    assert latency <= budget, (latency, budget)
+    assert re.search(r"ELASTIC-RESUME world=1", out), out
+
+    # The surviving snapshot chain: written at world 2 before the kill
+    # (the recovery line), finished at world 1 after the shrink.
+    final = load_state(ck0)
+    assert int(final["world_size"]) == 1
+    ew = _load_worker_module()
+
+    # Parity vs the uninterrupted world-2 run of the byte-identical
+    # problem (single-process, 2 virtual devices — same mesh size and
+    # program as the 2-process world).
+    from megba_tpu.algo.checkpointed import solve_checkpointed
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    s, option = ew.build_problem("tiny", 2)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    ref = solve_checkpointed(
+        f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option,
+        checkpoint_path=str(tmp_path / "clean.npz"),
+        checkpoint_every=ew.CHECKPOINT_EVERY, use_tiled=False)
+
+    res = dict(np.load(out0))
+    assert str(res["detect_kind"]) == "worker_lost"
+    assert int(final["iteration"]) == int(res["iterations"])
+    assert int(res["status"]) == int(ref.status)
+    assert int(res["iterations"]) == int(ref.iterations)
+    np.testing.assert_allclose(float(res["cost"]), float(ref.cost),
+                               rtol=1e-6)
+    np.testing.assert_allclose(res["cameras"], np.asarray(ref.cameras),
+                               rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(res["points"], np.asarray(ref.points),
+                               rtol=1e-6, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_shrink_world_resume_in_process_parity(tmp_path, retrace_sentinel):
+    """The shrink arithmetic without processes: run world-2 chunks
+    (virtual devices), stop at the snapshot, resume_elastic at world 1,
+    and match the uninterrupted world-2 run.  Also pins that the
+    resumed lowering compiles at most one NEW program (a fresh shape
+    class, certified by the retrace sentinel fixture at teardown)."""
+    import dataclasses
+
+    from megba_tpu.algo.checkpointed import solve_checkpointed
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.robustness.elastic import resume_elastic
+
+    ew = _load_worker_module()
+    s, option = ew.build_problem("tiny", 2)
+    f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    args = (f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx)
+
+    clean = solve_checkpointed(
+        *args, option, checkpoint_path=str(tmp_path / "clean.npz"),
+        checkpoint_every=ew.CHECKPOINT_EVERY, use_tiled=False)
+
+    # Interrupted run: first chunk at world 2, then "the world shrank".
+    ck = str(tmp_path / "elastic.npz")
+    short = dataclasses.replace(option, algo_option=dataclasses.replace(
+        option.algo_option, max_iter=ew.CHECKPOINT_EVERY))
+    solve_checkpointed(*args, short, checkpoint_path=ck,
+                       checkpoint_every=ew.CHECKPOINT_EVERY,
+                       use_tiled=False)
+    assert int(load_state(ck)["world_size"]) == 2
+    res = resume_elastic(*args, option, ck, world_size=1,
+                         checkpoint_every=ew.CHECKPOINT_EVERY,
+                         use_tiled=False)
+    assert int(load_state(ck)["world_size"]) == 1
+    assert int(res.status) == int(clean.status)
+    np.testing.assert_allclose(float(res.cost), float(clean.cost),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res.cameras),
+                               np.asarray(clean.cameras),
+                               rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(res.points),
+                               np.asarray(clean.points),
+                               rtol=1e-6, atol=1e-9)
