@@ -163,7 +163,9 @@ def _is_semantic_error(exc: BaseException) -> bool:
     return str(exc).startswith("BAL semantic error")
 
 
-def _validate(bal: BALFile, where: str) -> None:
+def validate_problem(cameras: np.ndarray, points: np.ndarray,
+                     obs: np.ndarray, cam_idx: np.ndarray,
+                     pt_idx: np.ndarray, *, where: str) -> None:
     """Reject semantically-poisoned problems with actionable context.
 
     A single NaN observation silently poisons every psum-reduced cost in
@@ -172,29 +174,48 @@ def _validate(bal: BALFile, where: str) -> None:
     boundary, not recovered from); duplicate (cam, pt) edges double-
     count a factor, which BAL — unlike g2o's repeated-constraint
     convention — never legitimately encodes.
+
+    THE shared ingestion gate: both BAL parsers, the synthetic
+    generator (io/synthetic.py) and the serving layer's FleetProblem
+    boundary (serving/batcher.py, serving/queue.py) all route through
+    this one definition, so no path into the solver accepts what
+    another rejects.  Array-based so callers without a BALFile (fleet
+    problems, synthetic scenes) pay no repacking.  The pre-flight
+    triage checks (robustness/triage.py) are the REPAIRING superset;
+    a caller that armed triage skips this gate — triage either fixes
+    or typed-rejects the same pathologies with a full HealthReport.
     """
-    bad = ~np.isfinite(bal.obs).all(axis=1)
+    cam_idx = np.asarray(cam_idx).reshape(-1)
+    pt_idx = np.asarray(pt_idx).reshape(-1)
+    n_cam, n_pt = int(cameras.shape[0]), int(points.shape[0])
+    n_obs = int(cam_idx.shape[0])
+    if n_obs and (int(cam_idx.max()) >= n_cam or int(pt_idx.max()) >= n_pt
+                  or int(cam_idx.min()) < 0 or int(pt_idx.min()) < 0):
+        raise ValueError(
+            f"BAL semantic error in {where}: observation indices out of "
+            f"range for {n_cam} cameras / {n_pt} points")
+    bad = ~np.isfinite(obs).all(axis=1)
     if bad.any():
         i = int(np.argmax(bad))
         raise ValueError(
             f"BAL semantic error in {where}: observation {i} "
-            f"(cam {int(bal.cam_idx[i])}, pt {int(bal.pt_idx[i])}) has "
-            f"non-finite pixel coordinates {bal.obs[i].tolist()}")
-    bad = ~np.isfinite(bal.cameras).all(axis=1)
+            f"(cam {int(cam_idx[i])}, pt {int(pt_idx[i])}) has "
+            f"non-finite pixel coordinates {np.asarray(obs)[i].tolist()}")
+    bad = ~np.isfinite(cameras).all(axis=1)
     if bad.any():
         i = int(np.argmax(bad))
         raise ValueError(
             f"BAL semantic error in {where}: camera {i} has non-finite "
-            f"parameters {bal.cameras[i].tolist()}")
-    bad = ~np.isfinite(bal.points).all(axis=1)
+            f"parameters {np.asarray(cameras)[i].tolist()}")
+    bad = ~np.isfinite(points).all(axis=1)
     if bad.any():
         i = int(np.argmax(bad))
         raise ValueError(
             f"BAL semantic error in {where}: point {i} has non-finite "
-            f"coordinates {bal.points[i].tolist()}")
-    if bal.num_observations:
-        key = (bal.cam_idx.astype(np.int64) * np.int64(bal.num_points)
-               + bal.pt_idx.astype(np.int64))
+            f"coordinates {np.asarray(points)[i].tolist()}")
+    if n_obs:
+        key = (cam_idx.astype(np.int64) * np.int64(n_pt)
+               + pt_idx.astype(np.int64))
         uniq, first, counts = np.unique(key, return_index=True,
                                         return_counts=True)
         if (counts > 1).any():
@@ -202,9 +223,15 @@ def _validate(bal: BALFile, where: str) -> None:
             dupes = np.nonzero(key == key[d])[0]
             raise ValueError(
                 f"BAL semantic error in {where}: duplicate observation of "
-                f"(cam {int(bal.cam_idx[d])}, pt {int(bal.pt_idx[d])}) at "
+                f"(cam {int(cam_idx[d])}, pt {int(pt_idx[d])}) at "
                 f"observation indices {dupes.tolist()} — BAL edges must be "
                 "unique (a repeated row double-counts the factor)")
+
+
+def _validate(bal: BALFile, where: str) -> None:
+    """BALFile adapter over the shared array-based gate."""
+    validate_problem(bal.cameras, bal.points, bal.obs, bal.cam_idx,
+                     bal.pt_idx, where=where)
 
 
 def _assemble(tokens: np.ndarray, dtype, where: str = "<tokens>") -> BALFile:

@@ -27,10 +27,14 @@ class SyntheticBAL:
     pt_idx: np.ndarray  # [nE] int32
 
 
-def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
-    """Vectorised NumPy projection: cameras [n,9] x points [n,3] -> [n,2]."""
-    w, t = cameras[:, 0:3], cameras[:, 3:6]
-    f, k1, k2 = cameras[:, 6], cameras[:, 7], cameras[:, 8]
+def rotate_batch(w: np.ndarray, points: np.ndarray) -> np.ndarray:
+    """Vectorised NumPy Rodrigues rotation: R(w_i) @ points_i, [n, 3].
+
+    The host-side twin of the on-device rotation in ops/geo.py — shared
+    by the synthetic generator and the pre-flight triage checks
+    (robustness/triage.py), so "what does this camera see" has exactly
+    one host definition.
+    """
     theta = np.linalg.norm(w, axis=1, keepdims=True)
     safe = theta > 1e-12
     theta_safe = np.where(safe, theta, 1.0)
@@ -39,11 +43,32 @@ def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
     sin_t = np.sin(theta)
     dot = np.sum(k * points, axis=1, keepdims=True)
     RX = points * cos_t + np.cross(k, points) * sin_t + k * dot * (1 - cos_t)
-    RX = np.where(safe, RX, points + np.cross(w, points))
-    P = RX + t
-    p = -P[:, 0:2] / P[:, 2:3]
-    n = np.sum(p * p, axis=1)
-    return (f * (1 + k1 * n + k2 * n * n))[:, None] * p
+    return np.where(safe, RX, points + np.cross(w, points))
+
+
+def project_batch_depth(
+    cameras: np.ndarray, points: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorised NumPy BAL projection with the camera-frame depth.
+
+    cameras [n, 9] x points [n, 3] -> (uv [n, 2], z [n]) where z is the
+    camera-frame third coordinate BEFORE the -P/P.z divide: the BAL
+    convention puts visible scene at z < 0, so z >= 0 is a cheirality
+    violation (point behind — or exactly on — the camera plane).
+    """
+    w, t = cameras[:, 0:3], cameras[:, 3:6]
+    f, k1, k2 = cameras[:, 6], cameras[:, 7], cameras[:, 8]
+    P = rotate_batch(w, points) + t
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p = -P[:, 0:2] / P[:, 2:3]
+        n = np.sum(p * p, axis=1)
+        uv = (f * (1 + k1 * n + k2 * n * n))[:, None] * p
+    return uv, P[:, 2]
+
+
+def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
+    """Vectorised NumPy projection: cameras [n,9] x points [n,3] -> [n,2]."""
+    return project_batch_depth(cameras, points)[0]
 
 
 def make_synthetic_bal(
@@ -54,6 +79,9 @@ def make_synthetic_bal(
     param_noise: float = 1e-2,
     seed: int = 0,
     dtype: np.dtype = np.float64,
+    n_orphan_points: int = 0,
+    n_behind_camera: int = 0,
+    n_disconnect: int = 0,
 ) -> SyntheticBAL:
     """Build a well-posed synthetic scene.
 
@@ -68,7 +96,32 @@ def make_synthetic_bal(
     count tracks `num_points * obs_per_point` — this is how the bench
     matches the real BAL datasets' observation counts while keeping the
     point count exact.
+
+    Degeneracy injection (pre-flight triage test fixtures — each knob
+    appends a deterministic pathology the robustness/triage.py checks
+    must catch; all draws come from the SAME rng, strictly after the
+    base scene's draws, so every knob at 0 reproduces the unmodified
+    scene byte-for-byte and the make_fleet prefix-stability contract is
+    untouched):
+
+    - `n_orphan_points`: points observed by exactly ONE camera (deg-1
+      — the predicted-singular-Hll pathology), with a garbage initial
+      estimate placed far along the viewing ray (the failed-
+      triangulation model: a single ray fixes bearing, not depth).
+    - `n_behind_camera`: points placed BEHIND the rig (world z ~ +6,
+      cameras look down from z ~ -5), each observed by two cameras —
+      every such edge is a cheirality violation at the initial
+      estimate.
+    - `n_disconnect`: a disconnected island of `n_disconnect` extra
+      cameras observing `4 * n_disconnect` extra points that no main
+      camera sees (gauge-deficient second component).  With
+      n_disconnect = 1 the island's points are additionally deg-1.
     """
+    for name, v in (("n_orphan_points", n_orphan_points),
+                    ("n_behind_camera", n_behind_camera),
+                    ("n_disconnect", n_disconnect)):
+        if v < 0:
+            raise ValueError(f"{name} must be >= 0, got {v}")
     r = np.random.default_rng(seed)
     obs_per_point = min(float(obs_per_point), float(num_cameras))
 
@@ -117,6 +170,72 @@ def make_synthetic_bal(
                 cameras_gt[cam_idx[lo:hi]], points_gt[pt_idx[lo:hi]])
     obs = uv + r.normal(scale=pixel_noise, size=uv.shape)
 
+    # ---- degeneracy injection (knob order: orphan, behind, island) ----
+    # Draws happen only inside taken branches, strictly after the base
+    # scene's draws: all-zero knobs leave the rng stream — and thus the
+    # scene — byte-identical to the knob-free generator.
+    orphan_rows: Optional[np.ndarray] = None
+    orphan_init: Optional[np.ndarray] = None
+    if n_orphan_points:
+        gt = r.uniform(-1.0, 1.0, size=(n_orphan_points, 3))
+        cam = r.integers(0, num_cameras, size=n_orphan_points)
+        uv1 = _project_batch(cameras_gt[cam], gt)
+        ob1 = uv1 + r.normal(scale=pixel_noise, size=uv1.shape)
+        orphan_rows = points_gt.shape[0] + np.arange(n_orphan_points)
+        # Failed-triangulation initial estimate: one ray fixes bearing
+        # but not depth, so the "triangulated" depth lands far out along
+        # the viewing ray from the observing camera's center.
+        centers = -rotate_batch(-cameras_gt[cam, 0:3], cameras_gt[cam, 3:6])
+        ray = gt - centers
+        ray = ray / np.linalg.norm(ray, axis=1, keepdims=True)
+        depth_far = np.linalg.norm(gt - centers, axis=1, keepdims=True) \
+            * r.uniform(50.0, 150.0, size=(n_orphan_points, 1))
+        orphan_init = centers + depth_far * ray
+        points_gt = np.concatenate([points_gt, gt])
+        cam_idx = np.concatenate([cam_idx, cam])
+        pt_idx = np.concatenate([pt_idx, orphan_rows])
+        obs = np.concatenate([obs, ob1])
+    if n_behind_camera:
+        gt = r.uniform(-1.0, 1.0, size=(n_behind_camera, 3))
+        gt[:, 2] = 6.0 + r.uniform(0.0, 1.0, size=n_behind_camera)
+        rows = points_gt.shape[0] + np.arange(n_behind_camera)
+        c1 = r.integers(0, num_cameras, size=n_behind_camera)
+        if num_cameras > 1:
+            c2 = (c1 + 1 + r.integers(0, num_cameras - 1,
+                                      size=n_behind_camera)) % num_cameras
+        else:
+            c2 = None
+        cams_b = [c1] if c2 is None else [c1, c2]
+        for cb in cams_b:
+            uvb = _project_batch(cameras_gt[cb], gt)
+            obb = uvb + r.normal(scale=pixel_noise, size=uvb.shape)
+            cam_idx = np.concatenate([cam_idx, cb])
+            pt_idx = np.concatenate([pt_idx, rows])
+            obs = np.concatenate([obs, obb])
+        points_gt = np.concatenate([points_gt, gt])
+    if n_disconnect:
+        nis = n_disconnect
+        isl = np.zeros((nis, 9))
+        isl[:, 0:3] = r.normal(scale=0.05, size=(nis, 3))
+        isl[:, 3:5] = r.normal(scale=0.2, size=(nis, 2))
+        isl[:, 5] = -5.0 + r.normal(scale=0.2, size=nis)
+        isl[:, 6] = 500.0 + r.normal(scale=5.0, size=nis)
+        isl[:, 7] = r.normal(scale=1e-4, size=nis)
+        isl[:, 8] = r.normal(scale=1e-6, size=nis)
+        gt = r.uniform(-1.0, 1.0, size=(4 * nis, 3))
+        rows = points_gt.shape[0] + np.arange(4 * nis)
+        j = np.arange(4 * nis)
+        pairs = [j % nis] if nis == 1 else [j % nis, (j + 1) % nis]
+        cam_base = cameras_gt.shape[0]
+        for cb in pairs:
+            uvi = _project_batch(isl[cb], gt)
+            obi = uvi + r.normal(scale=pixel_noise, size=uvi.shape)
+            cam_idx = np.concatenate([cam_idx, cam_base + cb])
+            pt_idx = np.concatenate([pt_idx, rows])
+            obs = np.concatenate([obs, obi])
+        cameras_gt = np.concatenate([cameras_gt, isl])
+        points_gt = np.concatenate([points_gt, gt])
+
     order = np.argsort(cam_idx, kind="stable")  # BAL files are cam-sorted
     cam_idx = np.asarray(cam_idx, dtype=np.int32)[order]
     pt_idx = np.asarray(pt_idx, dtype=np.int32)[order]
@@ -126,6 +245,19 @@ def make_synthetic_bal(
         [1, 1, 1, 1, 1, 1, 100.0, 1e-3, 1e-5]
     )
     points0 = points_gt + r.normal(scale=param_noise, size=points_gt.shape)
+    if orphan_rows is not None:
+        points0[orphan_rows] = orphan_init
+
+    # Same ingestion gate as the BAL parsers: a generator bug can no
+    # longer hand the solver what a file would have been refused for.
+    # The degeneracy knobs stay within the gate by construction — they
+    # inject GEOMETRIC/STRUCTURAL pathologies (deg-1, behind-camera,
+    # disconnection: the triage layer's jurisdiction), never the
+    # non-finite/duplicate poison the parser boundary rejects.
+    from megba_tpu.io.bal import validate_problem
+
+    validate_problem(cameras0, points0, obs, cam_idx, pt_idx,
+                     where=f"make_synthetic_bal(seed={seed})")
 
     return SyntheticBAL(
         cameras_gt=cameras_gt.astype(dtype),

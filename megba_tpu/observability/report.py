@@ -105,6 +105,13 @@ class SolveReport:
     # snapshot per monitor and sum across monitors without double
     # counting (chunked solves emit one snapshot per chunk).
     elastic: Optional[Dict[str, Any]] = None
+    # Optional pre-flight triage context (robustness/triage.py): the
+    # HealthReport dict of this solve's problem — findings by kind,
+    # component count, the action taken and (after REPAIR) the repair
+    # counters the `summarize --aggregate` triage view sums.  REJECTED
+    # problems never emit a report (zero dispatch): their count rides
+    # the fleet stats embedded in later reports, like sheds.
+    health: Optional[Dict[str, Any]] = None
     schema: str = SCHEMA
     created_unix: float = 0.0
 
@@ -137,7 +144,8 @@ def build_report(option, result, phases: Dict[str, Any],
                  problem: Dict[str, Any],
                  audit: Optional[Dict[str, Any]] = None,
                  fleet: Optional[Dict[str, Any]] = None,
-                 elastic: Optional[Dict[str, Any]] = None) -> SolveReport:
+                 elastic: Optional[Dict[str, Any]] = None,
+                 health: Optional[Dict[str, Any]] = None) -> SolveReport:
     """Assemble a SolveReport from a finished solve.
 
     `result` is an LMResult (trace included when the solve populated
@@ -188,6 +196,7 @@ def build_report(option, result, phases: Dict[str, Any],
         program_audit=audit,
         fleet=fleet,
         elastic=elastic,
+        health=health,
         created_unix=time.time(),
     )
 

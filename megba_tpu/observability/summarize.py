@@ -13,7 +13,10 @@ latency, and (when the reports carry the serving layer's `fleet`
 context) per-bucket problem counts plus the resilience counters
 (escalated attempts / retries / sheds / deadline misses / rejections
 and circuit-breaker transitions) — so a multi-problem run's JSONL is
-readable without ad-hoc scripts.  Reports carrying the elastic-
+readable without ad-hoc scripts.  Reports carrying a pre-flight triage
+`health` block (robustness/triage.py) add a triage line — rejected /
+repaired counts, repair totals (points fixed, edges masked, cams
+anchored, edges downweighted) and findings by kind.  Reports carrying the elastic-
 distribution context (`SolveReport.elastic`, robustness/elastic.py)
 add an elastic line: workers lost, collective timeouts, reshards,
 resumes, and time-to-detection p50/max (last snapshot per monitor,
@@ -191,6 +194,55 @@ def aggregate_reports(reports: List[SolveReport]) -> str:
             f"{stats.get('breaker_probes', 0)} probes / "
             f"{stats.get('breaker_recoveries', 0)} recoveries / "
             f"{stats.get('breaker_fast_fails', 0)} fast-fails")
+
+    # Triage view (PR 10): per-report `health` blocks carry each solved
+    # problem's pre-flight findings and repair counters; REJECTED
+    # problems never emit a report (zero dispatch), so — like sheds —
+    # their count can only come from the service-lifetime counters
+    # embedded in the NEWEST fleet report's stats.
+    health_reps = [r for r in reports if r.health]
+    stats_t: dict = {}
+    if fleet_reps:
+        latest_f = max(fleet_reps, key=lambda r: (r.created_unix or 0.0))
+        stats_t = latest_f.fleet.get("stats") or {}
+    if health_reps or stats_t.get("triage_rejected"):
+        # Escalation retries emit one report per ATTEMPT, each carrying
+        # the same health block — dedupe by the fleet problem name so a
+        # rung-1 re-solve doesn't double its repair counters (reports
+        # without a fleet name are standalone solves and count as-is).
+        seen_names: set = set()
+        deduped = []
+        for rep in health_reps:
+            name = (rep.fleet or {}).get("name")
+            if name:
+                if name in seen_names:
+                    continue
+                seen_names.add(name)
+            deduped.append(rep)
+        health_reps = deduped
+        by_kind: dict = {}
+        repaired = 0
+        repair_tot = {"points_fixed": 0, "edges_masked": 0,
+                      "cams_anchored": 0, "edges_downweighted": 0}
+        for rep in health_reps:
+            for f in rep.health.get("findings") or []:
+                k = f.get("kind", "unknown")
+                by_kind[k] = by_kind.get(k, 0) + int(f.get("count", 0))
+            r = rep.health.get("repair")
+            if r:
+                repaired += 1
+                for k in repair_tot:
+                    repair_tot[k] += int(r.get(k, 0))
+        lines.append(
+            f"   triage: {stats_t.get('triage_rejected', 0)} rejected / "
+            f"{repaired} repaired solves "
+            f"({repair_tot['points_fixed']} points fixed, "
+            f"{repair_tot['edges_masked']} edges masked, "
+            f"{repair_tot['cams_anchored']} cams anchored, "
+            f"{repair_tot['edges_downweighted']} edges downweighted)")
+        if by_kind:
+            lines.append("   findings: " + ", ".join(
+                f"{k}={by_kind[k]}" for k in sorted(by_kind)))
 
     # Elastic view (PR 9): each elastic block is a CUMULATIVE snapshot
     # of one rank's ElasticMonitor (chunked solves emit one per chunk),

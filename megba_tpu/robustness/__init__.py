@@ -23,6 +23,18 @@ Three pieces, spanning the solver stack:
   it to an N-rank world (kill one rank, assert the survivors exit on
   their own — the elastic no-wedge contract).
 
+- **Pre-flight triage** (`robustness.triage`): host-side health checks
+  BEFORE any device work — structural (connectivity, observation
+  degrees, duplicate edges) and geometric (non-finite data,
+  cheirality, parallax, initial-residual outliers) — with a
+  REJECT / REPAIR / WARN policy: reject degenerate problems with a
+  typed `ProblemRejected` and zero dispatch, or repair them
+  deterministically through operands the programs already carry
+  (edge_mask soft-deletes/downweights, cam_fixed/pt_fixed freezes,
+  per-component gauge anchors).  The shift-left layer: what the
+  guards above would contain at runtime, triage catches in host
+  milliseconds.
+
 - **Elastic distribution** (`robustness.elastic`): liveness detection
   (per-rank heartbeat files + injected-clock state machines), a
   collective watchdog bounding every chunk dispatch, typed
@@ -65,4 +77,18 @@ from megba_tpu.robustness.harness import (  # noqa: F401
     run_to_completion,
     run_until_snapshot_then_kill,
     run_world_until_snapshot_then_kill,
+)
+from megba_tpu.robustness.triage import (  # noqa: F401
+    CheckKind,
+    Finding,
+    HealthReport,
+    ProblemRejected,
+    TriageAction,
+    TriageOutcome,
+    TriagePolicy,
+    TriageRepair,
+    check_problem,
+    connected_components,
+    plan_repair,
+    triage_problem,
 )

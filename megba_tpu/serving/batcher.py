@@ -64,6 +64,17 @@ class FleetProblem:
     # on inert plans); problems without plans in a plan-free batch ride
     # the ordinary program unchanged.
     fault_plan: Optional[Any] = None
+    # Optional repair operands (robustness/triage.py, NATURAL edge /
+    # vertex order): `edge_mask` [nE] in [0, 1] soft-deletes or
+    # downweights edges, `cam_fixed`/`pt_fixed` freeze blocks.  Folded
+    # into the bucket's padding masks by pad_to_class — pure operands
+    # of the batched program, so a repaired problem and its pristine
+    # batch-mates share one compilation.  `health` carries the triage
+    # HealthReport dict through to FleetResult / telemetry.
+    edge_mask: Optional[np.ndarray] = None
+    cam_fixed: Optional[np.ndarray] = None
+    pt_fixed: Optional[np.ndarray] = None
+    health: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_synthetic(cls, s, name: str = "") -> "FleetProblem":
@@ -106,6 +117,9 @@ class FleetResult:
     attempts: int = 1
     rung: int = 0
     history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # Pre-flight triage context (robustness/triage.py): the HealthReport
+    # dict of the submitted problem when triage ran (None otherwise).
+    health: Optional[Dict[str, Any]] = None
 
     @property
     def status_name(self) -> str:
@@ -130,6 +144,30 @@ def _check_option(option: ProblemOption) -> None:
             "program; world_size must be 1 (got "
             f"{option.world_size}) — shard the FLEET across hosts, not "
             "one problem across devices")
+
+
+def _validate_problem(p: FleetProblem, index: int = -1) -> None:
+    """The serving layer's ingestion gate: the SAME semantic validation
+    the BAL parsers apply (io/bal.validate_problem), so duplicate
+    (cam, pt) edges and non-finite values cannot sneak into a batch
+    through `solve_many` / `FleetQueue.submit` when no triage policy is
+    armed.  Skipped only when the problem carries a triage `health`
+    record whose STRUCTURAL pass ran — that pass subsumes this gate's
+    duplicate check (non-finite checks are unconditional in triage), so
+    a `TriagePolicy(structural=False)` submission still hits the gate
+    here."""
+    if p.health is not None and p.health.get("structural", False):
+        return
+    from megba_tpu.io.bal import validate_problem
+
+    if p.name:
+        where = f"FleetProblem {p.name!r}"
+    elif index >= 0:
+        where = f"FleetProblem #{index}"
+    else:
+        where = "FleetProblem"
+    validate_problem(p.cameras, p.points, p.obs, p.cam_idx, p.pt_idx,
+                     where=where)
 
 
 def _group_by_bucket(problems: Sequence[FleetProblem], option: ProblemOption,
@@ -217,7 +255,9 @@ def _solve_bucket(
     faulted = any(p.fault_plan is not None for _, p in items)
     with timer.phase("lowering"):
         padded = [pad_to_class(p.cameras, p.points, p.obs, p.cam_idx,
-                               p.pt_idx, shape) for _, p in items]
+                               p.pt_idx, shape, edge_mask=p.edge_mask,
+                               cam_fixed=p.cam_fixed, pt_fixed=p.pt_fixed)
+                  for _, p in items]
         operands = _stack_bucket(padded, lanes, dtype)
         plan_stack = None
         if faulted:
@@ -288,6 +328,7 @@ def _solve_bucket(
             trace=lane_res.trace,
             rung=rung,
             attempts=attempts,
+            health=prob.health,
         )
         out.append((orig_i, fr))
         if telemetry and jax.process_index() == 0:
@@ -318,7 +359,8 @@ def _solve_bucket(
             append_report(
                 build_report(report_option, lane_res,
                              _phase_delta(phases_before, timer.as_dict()),
-                             problem_shape, fleet=fleet), telemetry)
+                             problem_shape, fleet=fleet,
+                             health=prob.health), telemetry)
     return out
 
 
@@ -351,6 +393,8 @@ def solve_many(
     """
     option = option or ProblemOption()
     _check_option(option)
+    for i, p in enumerate(problems):
+        _validate_problem(p, i)
     option, telemetry, report_option = _strip_telemetry(option)
     warn_if_x64_unavailable(np.dtype(option.dtype))
     ladder = ladder or BucketLadder()

@@ -207,6 +207,43 @@ class FleetQueue:
             return self._report_option
         return self.escalation.option_for_rung(self._report_option, rung)
 
+    def _triage_problem(self, problem: FleetProblem, policy) -> FleetProblem:
+        """Run pre-flight triage on one submission (host-side, on the
+        submitter's thread).  Raises `ProblemRejected` under REJECT;
+        returns the (possibly repaired) problem otherwise, with the
+        HealthReport dict attached so it rides FleetResult/telemetry."""
+        from megba_tpu.robustness.triage import TriageAction, triage_problem
+
+        # The problem's own mask/fixed operands ride into the checks so
+        # triage sees the graph the solver will (see check_problem).
+        outcome = triage_problem(problem.cameras, problem.points,
+                                 problem.obs, problem.cam_idx,
+                                 problem.pt_idx, policy,
+                                 edge_mask=problem.edge_mask,
+                                 cam_fixed=problem.cam_fixed,
+                                 pt_fixed=problem.pt_fixed)
+        health = outcome.report.to_dict()
+        rep = outcome.repair
+        if rep is None or rep.is_noop:
+            if outcome.report.degenerate:
+                # WARN on a degenerate problem: flagged, not touched.
+                self.stats.record_triage("warned")
+                self.timer.count_event("triage_warn")
+            return dataclasses.replace(problem, health=health)
+        assert outcome.action == TriageAction.REPAIR
+        self.stats.record_triage("repaired", rep.counters())
+        self.timer.count_event("triage_repair")
+        for name, n in rep.counters().items():
+            if n:
+                self.timer.count_event(f"triage_{name}", n)
+        cameras, points, obs = rep.merged_arrays(
+            problem.cameras, problem.points, problem.obs)
+        em, cf, pf = rep.merge_operands(
+            problem.edge_mask, problem.cam_fixed, problem.pt_fixed)
+        return dataclasses.replace(
+            problem, cameras=cameras, points=points, obs=obs,
+            edge_mask=em, cam_fixed=cf, pt_fixed=pf, health=health)
+
     def _key_for(self, problem: FleetProblem,
                  rung: int) -> Tuple[ShapeClass, Tuple[int, int, int], int]:
         opt = self._rung_option(rung)
@@ -225,17 +262,52 @@ class FleetQueue:
 
     # -- submission ------------------------------------------------------
     def submit(self, problem: FleetProblem,
-               deadline_s: Optional[float] = None) -> "Future":
+               deadline_s: Optional[float] = None,
+               triage=None) -> "Future":
         """Enqueue one problem; the Future resolves to its FleetResult
         (or raises what its batch raised / `DeadlineExceeded` when it
-        was shed / `QueueRejected` / `BucketTripped`).
+        was shed / `QueueRejected` / `BucketTripped` /
+        `ProblemRejected` when triage refused it).
 
         `deadline_s` is relative to NOW: once it expires the problem is
         shed before dispatch; a result completing after it is delivered
         flagged `deadline_missed`.
+
+        `triage` (robustness.triage.TriagePolicy) arms CONTENT
+        admission control next to `max_pending`'s capacity admission:
+        the problem is health-checked on the submitter's thread (host
+        NumPy, milliseconds) BEFORE it touches the queue.  Under
+        REJECT a degenerate problem's Future resolves immediately with
+        `ProblemRejected` (full HealthReport attached) — it never
+        holds queue capacity, never enters the escalation ladder, and
+        costs ZERO device time.  Under REPAIR the repaired problem
+        (masks + sanitised arrays as pure operands) is enqueued in its
+        place; under WARN the report is attached and the problem rides
+        unchanged.  Without `triage`, the shared ingestion gate
+        (io/bal.validate_problem) still refuses non-finite/duplicate
+        poison by raising at this boundary.
         """
         if deadline_s is not None and deadline_s < 0:
             raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        from megba_tpu.serving.batcher import _validate_problem
+
+        if triage is not None:
+            from megba_tpu.robustness.triage import ProblemRejected
+
+            try:
+                problem = self._triage_problem(problem, triage)
+            except ProblemRejected as exc:
+                # Content rejection resolves the Future FAST: no queue
+                # capacity held, no escalation ladder, zero dispatch.
+                self.stats.record_triage("rejected")
+                self.timer.count_event("triage_reject")
+                f: Future = Future()
+                f.set_exception(exc)
+                return f
+        # The shared ingestion gate still runs after triage when the
+        # policy's structural pass (which subsumes the duplicate check)
+        # was disabled — _validate_problem skips itself otherwise.
+        _validate_problem(problem)
         key = self._key_for(problem, rung=0)
         now = time.monotonic()
         item = _Pending(

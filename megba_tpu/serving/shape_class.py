@@ -166,7 +166,10 @@ class PaddedProblem:
 
 def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
                  cam_idx: np.ndarray, pt_idx: np.ndarray,
-                 shape: ShapeClass) -> PaddedProblem:
+                 shape: ShapeClass,
+                 edge_mask: Optional[np.ndarray] = None,
+                 cam_fixed: Optional[np.ndarray] = None,
+                 pt_fixed: Optional[np.ndarray] = None) -> PaddedProblem:
     """Lower one problem's host arrays onto its shape class.
 
     Mirrors `solve.flat_solve`'s host prep for the non-tiled path:
@@ -175,6 +178,15 @@ def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
     pad region.  Padded edges repeat the last REAL edge's vertex
     indices (pad_edges), which point at real vertices, so the masked
     residual evaluation stays finite.
+
+    `edge_mask` ([nE], caller's edge order, values in [0, 1]) rides the
+    camera-sort permutation and MULTIPLIES into the padding mask —
+    exactly `flat_solve(..., edge_mask=)`'s soft-delete/downweight
+    semantics, so a triage-repaired problem (robustness/triage.py)
+    lowers onto its bucket as pure operands.  `cam_fixed` / `pt_fixed`
+    ([Nc]/[Np] bool) OR into the padding-region flags the same way.
+    None of the three changes the program: the batched solve always
+    carries mask/cam_fixed/pt_fixed operands.
     """
     from megba_tpu.core.types import is_cam_sorted, pad_edges
     from megba_tpu.native import sort_edges_by_camera
@@ -190,17 +202,32 @@ def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
         raise ValueError(
             f"problem ({n_cam} cams, {n_pt} pts, {n_edge} edges) does not "
             f"fit shape class {shape}")
+    em = None
+    if edge_mask is not None:
+        em = np.asarray(edge_mask).astype(dtype, copy=False).reshape(-1)
+        if em.shape[0] != n_edge:
+            raise ValueError(
+                f"edge_mask has {em.shape[0]} entries for a problem "
+                f"with {n_edge} edges")
 
     perm = None
     if not is_cam_sorted(cam_idx):
         perm = sort_edges_by_camera(cam_idx, n_cam)
         cam_idx, pt_idx, obs = cam_idx[perm], pt_idx[perm], obs[perm]
+        if em is not None:
+            em = em[perm]
 
     # pad_edges pads to a MULTIPLE of its argument; the bucket size is
     # the multiple here, and n_edge <= shape.n_edge, so the result is
     # exactly one bucket long.
     obs, cam_idx, pt_idx, mask = pad_edges(
         obs, cam_idx, pt_idx, shape.n_edge, dtype=dtype)
+    if em is not None:
+        # 1*em on the real region, 0 stays 0 on the pad region (the
+        # flat_solve identity: 1.0 * {0.0, 1.0} is exact, and fractional
+        # downweights ride unchanged).
+        mask = mask * np.concatenate(
+            [em, np.ones(mask.shape[0] - em.shape[0], dtype)])
 
     pad_c = shape.n_cam - n_cam
     pad_p = shape.n_pt - n_pt
@@ -210,10 +237,15 @@ def pad_to_class(cameras: np.ndarray, points: np.ndarray, obs: np.ndarray,
     if pad_p:
         points = np.concatenate(
             [points, np.zeros((pad_p, points.shape[1]), dtype)])
-    cam_fixed = np.zeros(shape.n_cam, dtype=bool)
-    cam_fixed[n_cam:] = True
-    pt_fixed = np.zeros(shape.n_pt, dtype=bool)
-    pt_fixed[n_pt:] = True
+    cam_fixed_out = np.zeros(shape.n_cam, dtype=bool)
+    cam_fixed_out[n_cam:] = True
+    if cam_fixed is not None:
+        cam_fixed_out[:n_cam] |= np.asarray(cam_fixed, bool).reshape(-1)
+    pt_fixed_out = np.zeros(shape.n_pt, dtype=bool)
+    pt_fixed_out[n_pt:] = True
+    if pt_fixed is not None:
+        pt_fixed_out[:n_pt] |= np.asarray(pt_fixed, bool).reshape(-1)
+    cam_fixed, pt_fixed = cam_fixed_out, pt_fixed_out
 
     return PaddedProblem(
         shape=shape, cameras=cameras, points=points, obs=obs,

@@ -17,7 +17,7 @@ and `report()` the human-readable block.
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 
 class FleetStats:
@@ -45,6 +45,14 @@ class FleetStats:
         self.breaker_recoveries = 0  # probes that closed the breaker
         self.breaker_fast_fails = 0  # submits failed fast on a tripped bucket
         self.queue_depth_peak = 0  # max pending problems ever observed
+        # -- pre-flight triage counters (robustness/triage.py) -----------
+        self.triage_rejected = 0  # problems refused with ZERO dispatch
+        self.triage_repaired = 0  # problems auto-repaired before enqueue
+        self.triage_warned = 0  # degenerate problems passed through flagged
+        self.triage_points_fixed = 0  # point blocks frozen by repairs
+        self.triage_edges_masked = 0  # edges soft-deleted by repairs
+        self.triage_cams_anchored = 0  # gauge anchors added by repairs
+        self.triage_edges_downweighted = 0  # robust-downweighted outliers
 
     # -- recording -------------------------------------------------------
     def record_batch(self, bucket: str, lanes: int, n_real: int,
@@ -106,6 +114,27 @@ class FleetStats:
             if depth > self.queue_depth_peak:
                 self.queue_depth_peak = depth
 
+    def record_triage(self, action: str,
+                      repair: Optional[Dict[str, int]] = None) -> None:
+        """One triaged problem: `action` is 'rejected' / 'repaired' /
+        'warned'; `repair` carries TriageRepair.counters() for repairs."""
+        field = {"rejected": "triage_rejected",
+                 "repaired": "triage_repaired",
+                 "warned": "triage_warned"}.get(action)
+        if field is None:
+            raise ValueError(f"unknown triage action {action!r}")
+        with self._lock:
+            setattr(self, field, getattr(self, field) + 1)
+            if repair:
+                self.triage_points_fixed += int(
+                    repair.get("points_fixed", 0))
+                self.triage_edges_masked += int(
+                    repair.get("edges_masked", 0))
+                self.triage_cams_anchored += int(
+                    repair.get("cams_anchored", 0))
+                self.triage_edges_downweighted += int(
+                    repair.get("edges_downweighted", 0))
+
     # -- derived metrics -------------------------------------------------
     def problems_per_sec(self) -> float:
         with self._lock:
@@ -159,6 +188,13 @@ class FleetStats:
                 "breaker_recoveries": self.breaker_recoveries,
                 "breaker_fast_fails": self.breaker_fast_fails,
                 "queue_depth_peak": self.queue_depth_peak,
+                "triage_rejected": self.triage_rejected,
+                "triage_repaired": self.triage_repaired,
+                "triage_warned": self.triage_warned,
+                "triage_points_fixed": self.triage_points_fixed,
+                "triage_edges_masked": self.triage_edges_masked,
+                "triage_cams_anchored": self.triage_cams_anchored,
+                "triage_edges_downweighted": self.triage_edges_downweighted,
             }
         base["problems_per_sec"] = self.problems_per_sec()
         base["padding_waste"] = self.padding_waste()
@@ -188,6 +224,15 @@ class FleetStats:
                 f"{d['breaker_recoveries']} recoveries / "
                 f"{d['breaker_fast_fails']} fast-fails "
                 f"(peak depth {d['queue_depth_peak']})")
+        if d["triage_rejected"] or d["triage_repaired"] or d["triage_warned"]:
+            lines.append(
+                f"  triage: {d['triage_rejected']} rejected / "
+                f"{d['triage_repaired']} repaired / "
+                f"{d['triage_warned']} warned "
+                f"({d['triage_points_fixed']} points fixed, "
+                f"{d['triage_edges_masked']} edges masked, "
+                f"{d['triage_cams_anchored']} cams anchored, "
+                f"{d['triage_edges_downweighted']} edges downweighted)")
         for bucket, occ in sorted(d["bucket_occupancy"].items()):
             b = d["per_bucket"][bucket]
             lines.append(

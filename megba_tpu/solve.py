@@ -113,6 +113,7 @@ def flat_solve(
     jit_cache: Optional[dict] = None,
     timer: Optional[PhaseTimer] = None,
     elastic_report: Optional[dict] = None,
+    triage=None,
     lower_only: bool = False,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
@@ -178,6 +179,19 @@ def flat_solve(
     `fleet` block; ignored when telemetry is off and never an operand
     of the compiled program.
 
+    `triage` (robustness.triage.TriagePolicy) arms PRE-FLIGHT health
+    checks: the problem is structurally and geometrically checked on
+    host (pure NumPy, a "triage" PhaseTimer phase) BEFORE any lowering
+    or device work.  Under REJECT a degenerate problem raises the
+    typed `ProblemRejected` (HealthReport attached) with ZERO device
+    dispatch — the timer records a triage phase and no dispatch phase,
+    and the retrace sentinel sees no new traces.  Under REPAIR the
+    deterministic repairs merge into this call's operands (edge_mask
+    multiplies, fixed masks OR, non-finite values sanitised) and the
+    repair counters land as `triage_*` PhaseTimer events; under WARN
+    the report is attached and the solve is unchanged.  The
+    HealthReport rides `SolveReport.health` when telemetry is on.
+
     `lower_only=True` returns the `jax.stages.Lowered` of the exact
     program this call would have dispatched — same host prep, same
     operands, same jit cache — without executing it.  This is the
@@ -192,6 +206,34 @@ def flat_solve(
     if option.telemetry is not None:
         option = dataclasses.replace(option, telemetry=None)
     timer = PhaseTimer() if timer is None else timer
+
+    health = None
+    if triage is not None:
+        from megba_tpu.robustness.triage import triage_problem
+
+        # Pre-flight triage BEFORE any lowering: a REJECT propagates
+        # `ProblemRejected` out of this phase with nothing traced,
+        # compiled or dispatched (the timer ends with a "triage" phase
+        # and no "dispatch" phase — the zero-dispatch assertion the
+        # tests pin).
+        with timer.phase("triage"):
+            # Caller-supplied mask/fixed operands are passed through so
+            # the checks see the graph the SOLVER will see (a caller-
+            # masked edge doesn't count toward degrees, a caller-fixed
+            # point can't be "under-constrained").
+            outcome = triage_problem(
+                cameras, points, obs, cam_idx, pt_idx, triage,
+                edge_mask=edge_mask, cam_fixed=cam_fixed,
+                pt_fixed=pt_fixed)
+        health = outcome.report.to_dict()
+        rep = outcome.repair
+        if rep is not None and not rep.is_noop:
+            for name, n in rep.counters().items():
+                if n:
+                    timer.count_event(f"triage_{name}", n)
+            cameras, points, obs = rep.merged_arrays(cameras, points, obs)
+            edge_mask, cam_fixed, pt_fixed = rep.merge_operands(
+                edge_mask, cam_fixed, pt_fixed)
 
     dtype = np.dtype(option.dtype)
     warn_if_x64_unavailable(dtype)
@@ -420,7 +462,8 @@ def flat_solve(
             return result
         result = _result_to_edge_major(result)
         _maybe_emit_report(telemetry, report_option, result, timer,
-                           problem_shape, elastic=elastic_report)
+                           problem_shape, elastic=elastic_report,
+                           health=health)
         return result
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
@@ -451,12 +494,13 @@ def flat_solve(
         result = jitted(*call_args)
     result = _result_to_edge_major(result)
     _maybe_emit_report(telemetry, report_option, result, timer,
-                       problem_shape, elastic=elastic_report)
+                       problem_shape, elastic=elastic_report,
+                       health=health)
     return result
 
 
 def _maybe_emit_report(telemetry, option, result, timer, problem,
-                       elastic=None) -> None:
+                       elastic=None, health=None) -> None:
     """Append a SolveReport JSONL line when telemetry is on; no-op (no
     sink import, no device sync) when it is off."""
     if not telemetry:
@@ -494,7 +538,7 @@ def _maybe_emit_report(telemetry, option, result, timer, problem,
 
     append_report(
         build_report(option, result, timer.as_dict(), problem,
-                     elastic=elastic), telemetry)
+                     elastic=elastic, health=health), telemetry)
 
 
 def _result_to_edge_major(result: LMResult) -> LMResult:

@@ -366,6 +366,222 @@ assert summarize.main(["--aggregate", sink]) == 0
 PY
 echo "serving chaos smoke OK"
 
+# Triage smoke (ISSUE 10): pre-flight problem triage at venice-10%
+# scale and through the fleet queue.  (1) venice-10% with injected
+# degeneracies (600 deg-1 far points, 120 behind-camera points):
+# REJECT must fail fast — host milliseconds, ZERO device dispatch
+# (retrace sentinel + no dispatch phase) — and REPAIR must converge
+# within 1e-5 of the clean (un-injected) run: the repairs soft-delete
+# exactly the injected pathology, so the surviving system IS the clean
+# one.  (2) A fleet of 16 with 3 poisoned problems through
+# FleetQueue.submit(triage=...): 2 REJECTed futures resolve instantly
+# (never dispatched), 1 REPAIRed problem solves in-batch, and the 13
+# clean batch-mates stay BITWISE identical to a solve_many control of
+# the same composition.  `summarize --aggregate` renders the triage
+# counters from the report stream.
+TRIAGE_SINK=$(mktemp /tmp/megba_triage_smoke.XXXXXX.jsonl)
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"' EXIT
+JAX_PLATFORMS=cpu python - <<'PY'
+import time
+
+import numpy as np
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.analysis import retrace
+from megba_tpu.common import (
+    AlgoOption, ComputeKind, JacobianMode, ProblemOption, SolverOption,
+    status_name)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.robustness.triage import (
+    ProblemRejected, TriageAction, TriagePolicy)
+from megba_tpu.solve import flat_solve
+from megba_tpu.utils.timing import PhaseTimer
+
+kw = dict(num_cameras=177, num_points=99392,
+          obs_per_point=5_001_946 / 993_923, seed=0, param_noise=1e-2,
+          pixel_noise=0.5, dtype=np.float32)
+clean = make_synthetic_bal(**kw)
+deg = make_synthetic_bal(**kw, n_orphan_points=600, n_behind_camera=120)
+option = ProblemOption(
+    dtype=np.float32, compute_kind=ComputeKind.IMPLICIT,
+    jacobian_mode=JacobianMode.ANALYTICAL,
+    algo_option=AlgoOption(max_iter=10, epsilon1=1e-12, epsilon2=1e-15),
+    solver_option=SolverOption(max_iter=30, tol=1e-10, refuse_ratio=1e30))
+f = make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+
+# -- REJECT: fast, typed, zero dispatch --------------------------------
+base = retrace.snapshot()
+timer = PhaseTimer()
+t0 = time.perf_counter()
+try:
+    flat_solve(f, deg.cameras0, deg.points0, deg.obs, deg.cam_idx,
+               deg.pt_idx, option, use_tiled=False, timer=timer,
+               triage=TriagePolicy())
+    raise AssertionError("degenerate venice problem was not rejected")
+except ProblemRejected as e:
+    wall = time.perf_counter() - t0
+    counts = e.report.counts()
+assert counts["under_constrained_point"] == 720, counts  # 600 + 120 starved
+assert counts["behind_camera"] == 240, counts
+assert "dispatch" not in timer.totals and "lowering" not in timer.totals, (
+    timer.totals)
+assert retrace.snapshot() == base, "REJECT traced a program"
+assert wall < 30.0, f"REJECT took {wall:.1f}s (want host-side fast-fail)"
+print(f"triage smoke: venice-10% REJECT in {wall * 1e3:.0f} ms, "
+      f"zero dispatch, findings {counts}")
+
+# -- REPAIR: converges to the clean run --------------------------------
+rc = flat_solve(f, clean.cameras0, clean.points0, clean.obs, clean.cam_idx,
+                clean.pt_idx, option, use_tiled=False)
+rr = flat_solve(f, deg.cameras0, deg.points0, deg.obs, deg.cam_idx,
+                deg.pt_idx, option, use_tiled=False,
+                triage=TriagePolicy(on_degenerate=TriageAction.REPAIR))
+gap = abs(float(rr.cost) - float(rc.cost)) / abs(float(rc.cost))
+print(f"triage smoke: clean={float(rc.cost):.8e} "
+      f"repaired={float(rr.cost):.8e} gap={gap:.2e} "
+      f"status={status_name(rr.status)}")
+assert gap <= 1e-5, f"triaged REPAIR cost off the clean run by {gap:.2e}"
+PY
+JAX_PLATFORMS=cpu MEGBA_TRIAGE_SINK="$TRIAGE_SINK" python - <<'PY'
+import dataclasses
+import os
+
+import numpy as np
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_fleet
+from megba_tpu.observability import summarize
+from megba_tpu.robustness.triage import (
+    ProblemRejected, TriageAction, TriagePolicy, triage_problem)
+from megba_tpu.serving import (
+    BucketLadder, FleetProblem, FleetQueue, FleetStats, classify,
+    solve_many)
+
+OPT = ProblemOption(dtype=np.float64, algo_option=AlgoOption(max_iter=6),
+                    solver_option=SolverOption(max_iter=12, tol=1e-10))
+sink = os.environ["MEGBA_TRIAGE_SINK"]
+
+fleet = [FleetProblem.from_synthetic(s, name=f"triage{i}")
+         for i, s in enumerate(make_fleet(16, size_range=(12, 96), seed=0,
+                                          dtype=np.float64))]
+ladder = BucketLadder()
+buckets = {}
+for i, p in enumerate(fleet):
+    buckets.setdefault(classify(*p.dims(), OPT.dtype, ladder), []).append(i)
+big = max(buckets.values(), key=len)
+assert len(big) >= 2, buckets
+
+
+def poison(p):
+    # Append one deg-1 point: same bucket class is NOT required for the
+    # reject pair (they never join a batch), and the repair pair keeps
+    # its bucket if the point/edge counts stay under the rungs.
+    pts = np.concatenate([p.points, [[0.05, 0.05, 0.05]]])
+    return dataclasses.replace(
+        p, points=pts,
+        cam_idx=np.concatenate([p.cam_idx, [0]]).astype(np.int32),
+        pt_idx=np.concatenate([p.pt_idx,
+                               [p.points.shape[0]]]).astype(np.int32),
+        obs=np.concatenate([p.obs, [[0.0, 0.0]]]))
+
+
+reject_idx = [i for i in range(16) if i not in big][:2]
+assert len(reject_idx) == 2, buckets
+# The repaired problem must stay in its CLEAN batch-mates' bucket after
+# the poison appends a point+edge, or the in-batch isolation claim is
+# vacuous.
+repair_idx = next(
+    i for i in big
+    if classify(*poison(fleet[i]).dims(), OPT.dtype, ladder)
+    == classify(*fleet[i].dims(), OPT.dtype, ladder))
+poisoned = set(reject_idx) | {repair_idx}
+submitted = [poison(p) if i in poisoned else p for i, p in enumerate(fleet)]
+
+stats = FleetStats()
+opt_tele = dataclasses.replace(OPT, telemetry=sink)
+results = {}
+with FleetQueue(opt_tele, max_batch=16, max_wait_s=30.0, stats=stats) as q:
+    futs = {}
+    for i, p in enumerate(submitted):
+        if i in reject_idx:
+            futs[i] = q.submit(p, triage=TriagePolicy())
+            assert futs[i].done(), "rejected future not resolved at submit"
+        elif i == repair_idx:
+            futs[i] = q.submit(p, triage=TriagePolicy(
+                on_degenerate=TriageAction.REPAIR))
+        else:
+            futs[i] = q.submit(p)
+    q.flush()
+    for i, fu in futs.items():
+        if i in reject_idx:
+            try:
+                fu.result()
+                raise AssertionError(f"problem {i} was not rejected")
+            except ProblemRejected:
+                pass
+        else:
+            results[i] = fu.result(timeout=60)
+assert stats.triage_rejected == 2 and stats.triage_repaired == 1, (
+    stats.as_dict())
+print(f"triage smoke: 2 rejected at submit, 1 repaired in-batch "
+      f"({stats.triage_points_fixed} pts fixed, "
+      f"{stats.triage_edges_masked} edges masked)")
+
+# Control: the same composition built by hand — rejected problems
+# dropped, the repaired one hand-repaired — so batches match exactly
+# and the 13 clean problems must be BITWISE identical.
+out = triage_problem(
+    submitted[repair_idx].cameras, submitted[repair_idx].points,
+    submitted[repair_idx].obs, submitted[repair_idx].cam_idx,
+    submitted[repair_idx].pt_idx,
+    TriagePolicy(on_degenerate=TriageAction.REPAIR))
+hand = dataclasses.replace(
+    submitted[repair_idx], edge_mask=out.repair.edge_mask,
+    cam_fixed=out.repair.cam_fixed, pt_fixed=out.repair.pt_fixed,
+    health=out.report.to_dict())
+control_probs, control_ids = [], []
+for i in range(16):
+    if i in reject_idx:
+        continue
+    control_probs.append(hand if i == repair_idx else submitted[i])
+    control_ids.append(i)
+control = dict(zip(control_ids, solve_many(control_probs, OPT,
+                                           ladder=ladder)))
+clean_ids = [i for i in range(16) if i not in poisoned]
+assert len(clean_ids) == 13
+for i in clean_ids:
+    r, c = results[i], control[i]
+    assert r.cameras.tobytes() == c.cameras.tobytes(), (
+        f"clean problem {i} drifted next to a repaired batch-mate")
+    assert r.cost.tobytes() == c.cost.tobytes(), i
+r, c = results[repair_idx], control[repair_idx]
+assert r.cameras.tobytes() == c.cameras.tobytes(), "repair != hand-repair"
+assert np.isfinite(float(r.cost))
+print("triage smoke: 13 clean batch-mates BITWISE identical to control, "
+      "queue repair == hand repair")
+
+out_text = summarize.aggregate_paths([sink])
+print(out_text)
+assert "triage: 2 rejected / 1 repaired" in out_text, out_text
+assert "1 points fixed" in out_text and "1 edges masked" in out_text, out_text
+assert "under_constrained_point=1" in out_text, out_text
+PY
+echo "triage smoke OK"
+
 # Elastic chaos smoke (ISSUE 9): a REAL 2-process gloo solve on the
 # venice-10% configuration (f64), rank 1 SIGKILL'd the moment the first
 # world-2 snapshot lands.  Rank 0 must surface a typed WorkerLost
@@ -381,7 +597,7 @@ if JAX_PLATFORMS=cpu python -c "import sys
 from megba_tpu.parallel.multihost import cpu_cross_process_collectives_available
 sys.exit(0 if cpu_cross_process_collectives_available() else 3)"; then
 ELASTIC_DIR=$(mktemp -d /tmp/megba_elastic_smoke.XXXXXX)
-trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK"; rm -rf "$ELASTIC_DIR"' EXIT
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$ELASTIC_DIR"' EXIT
 JAX_PLATFORMS=cpu MEGBA_ELASTIC_DIR="$ELASTIC_DIR" python - <<'PY'
 import importlib.util
 import os

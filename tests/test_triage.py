@@ -1,0 +1,742 @@
+"""Pre-flight triage: health checks, repairs, admission control.
+
+Covers megba_tpu/robustness/triage.py and its integrations:
+
+- unit checks/repairs are pure host NumPy (compile-free tests);
+- REJECT dispatches NOTHING (retrace sentinel sees no traces, the
+  PhaseTimer records a triage phase and no dispatch phase);
+- the shift-left regression: a seeded deg-1-point problem solved
+  UN-triaged fires runtime `precond_fallback` events; the SAME problem
+  under TriagePolicy(REPAIR) solves clean with zero fallback events and
+  a final cost within rtol 1e-6 of a hand-repaired control;
+- the serving ingestion gate: duplicate edges / non-finite values are
+  refused at `solve_many` / `FleetQueue.submit` (the PR 5 parser
+  `_validate`, now shared) — the adversarial regression for data that
+  used to sneak in through make_fleet / pad_to_class unchecked.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from megba_tpu.common import (
+    AlgoOption,
+    JacobianMode,
+    PreconditionerKind,
+    ProblemOption,
+    RobustOption,
+    SolverOption,
+    SolveStatus,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal, project_batch_depth
+from megba_tpu.robustness.triage import (
+    CheckKind,
+    HealthReport,
+    ProblemRejected,
+    TriageAction,
+    TriagePolicy,
+    check_problem,
+    connected_components,
+    huber_weight,
+    triage_problem,
+)
+
+F32 = np.float32
+
+
+def _clean(seed=0, **kw):
+    return make_synthetic_bal(num_cameras=6, num_points=48, obs_per_point=3,
+                              seed=seed, dtype=np.float64, **kw)
+
+
+def _triage_args(s):
+    return (s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx)
+
+
+# ---------------------------------------------------------------------------
+# checks (compile-free)
+# ---------------------------------------------------------------------------
+
+
+def test_clean_problem_is_warn_noop():
+    s = _clean()
+    out = triage_problem(*_triage_args(s))
+    assert out.action == TriageAction.WARN
+    assert out.repair is None
+    assert not out.report.degenerate
+    assert out.report.findings == []
+    assert out.report.n_components == 1
+    # Clean stays clean under every action policy.
+    for act in TriageAction:
+        out2 = triage_problem(*_triage_args(s),
+                              TriagePolicy(on_degenerate=act))
+        assert out2.repair is None and not out2.report.degenerate
+
+
+def test_connected_components_toy_graphs():
+    # one component
+    n, cc, pc = connected_components(
+        np.array([0, 1, 1]), np.array([0, 0, 1]), 2, 2)
+    assert n == 1 and set(cc) == {0} and set(pc) == {0}
+    # two components + an isolated point singleton
+    n, cc, pc = connected_components(
+        np.array([0, 1]), np.array([0, 1]), 2, 3)
+    assert n == 3  # {c0,p0}, {c1,p1}, {p2}
+    assert cc[0] != cc[1]
+    assert pc[2] not in (pc[0], pc[1])
+    # long chain exercises the path-halving loop
+    k = 50
+    ci = np.repeat(np.arange(k), 2)[1:-1]
+    pi = np.repeat(np.arange(k - 1), 2)
+    n, cc, pc = connected_components(ci, pi, k, k - 1)
+    assert n == 1
+    # masked edges split the graph
+    n, cc, pc = connected_components(
+        np.array([0, 1, 1]), np.array([0, 0, 1]), 2, 2,
+        edge_alive=np.array([True, False, True]))
+    assert n == 2 and cc[0] != cc[1]
+
+
+def test_degree_checks_and_orphans():
+    s = _clean()
+    # append: a deg-1 point, a deg-0 point, an edge-less camera
+    pts = np.concatenate([s.points0, [[0.1, 0.2, 0.3], [0.3, 0.1, 0.2]]])
+    cams = np.concatenate([s.cameras0, s.cameras0[:1]])
+    np_pt = s.points0.shape[0]
+    ci = np.concatenate([s.cam_idx, [0]]).astype(np.int32)
+    pi = np.concatenate([s.pt_idx, [np_pt]]).astype(np.int32)
+    obs = np.concatenate([s.obs, [[0.0, 0.0]]])
+    rep, internals = check_problem(cams, pts, obs, ci, pi,
+                                   TriagePolicy(geometric=False))
+    counts = rep.counts()
+    assert counts["under_constrained_point"] == 2  # deg-1 AND deg-0
+    assert counts["orphan_camera"] == 1
+    f = rep.finding(CheckKind.UNDER_CONSTRAINED_POINT)
+    assert set(f.exemplars) == {np_pt, np_pt + 1}
+    assert internals["bad_pt"][np_pt] and internals["bad_pt"][np_pt + 1]
+    assert rep.degenerate  # deg<2 points predict a singular Hll
+
+
+def test_under_constrained_camera_is_advisory():
+    # 3 cameras, 5 points; cameras 0/1 see all five (deg 5 = the
+    # default floor), camera 2 sees a single point -> 2 residual rows
+    # vs 9 dof.  Advisory: flagged, but NOT degenerate on its own.
+    cams = np.zeros((3, 9))
+    cams[:, 5] = -5.0
+    cams[:, 6] = 500.0
+    pts = np.array([[0.0, 0.0, 0.0], [0.1, 0.0, 0.0], [0.0, 0.1, 0.0],
+                    [-0.1, 0.1, 0.0], [0.1, -0.1, 0.0]])
+    ci = np.array([0] * 5 + [1] * 5 + [2], np.int32)
+    pi = np.array(list(range(5)) * 2 + [0], np.int32)
+    uv, _ = project_batch_depth(cams[ci], pts[pi])
+    rep, _ = check_problem(cams, pts, uv, ci, pi,
+                           TriagePolicy(geometric=False))
+    counts = rep.counts()
+    f = rep.finding(CheckKind.UNDER_CONSTRAINED_CAMERA)
+    assert counts.get("under_constrained_camera") == 1
+    assert f.exemplars == [2]
+    assert not rep.degenerate
+
+
+def test_duplicate_edges_first_occurrence_survives():
+    s = _clean()
+    ci = np.concatenate([s.cam_idx, s.cam_idx[5:6]]).astype(np.int32)
+    pi = np.concatenate([s.pt_idx, s.pt_idx[5:6]]).astype(np.int32)
+    obs = np.concatenate([s.obs, s.obs[5:6] + 1.0])
+    out = triage_problem(s.cameras0, s.points0, obs, ci, pi,
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    f = out.report.finding(CheckKind.DUPLICATE_EDGE)
+    assert f is not None and f.count == 1
+    em = out.repair.edge_mask
+    assert em is not None
+    assert em[len(s.cam_idx)] == 0.0  # the APPENDED copy is masked
+    assert em[5] == 1.0  # the first occurrence survives
+
+
+def test_nonfinite_findings_sanitised_and_masked():
+    s = _clean()
+    cams = s.cameras0.copy()
+    cams[2, 4] = np.inf
+    pts = s.points0.copy()
+    pts[7] = np.nan
+    obs = s.obs.copy()
+    obs[11, 0] = np.nan
+    out = triage_problem(cams, pts, obs, s.cam_idx, s.pt_idx,
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    counts = out.report.counts()
+    assert counts["nonfinite_camera"] == 1
+    assert counts["nonfinite_point"] == 1
+    assert counts["nonfinite_obs"] == 1
+    rep = out.repair
+    # Frozen blocks + masked edges + SANITISED values (the mask
+    # multiplies residuals; 0 * NaN is NaN, so scrubbing is load-bearing)
+    assert rep.cam_fixed[2] and rep.pt_fixed[7]
+    assert np.isfinite(rep.cameras).all()
+    assert np.isfinite(rep.points).all()
+    assert np.isfinite(rep.obs).all()
+    dead = (s.cam_idx == 2) | (s.pt_idx == 7)
+    dead[11] = True
+    assert (rep.edge_mask[dead] == 0.0).all()
+    # untouched data is never rewritten
+    keep = ~np.isnan(pts).any(axis=1)
+    assert rep.points[keep].tobytes() == pts[keep].tobytes()
+
+
+def test_freeze_only_repair_is_not_a_noop():
+    """A repair whose ONLY effect is freezing/sanitising a zero-degree
+    non-finite camera has no masked edges or anchors — it must still be
+    applied (the NaN params would otherwise dispatch unscrubbed)."""
+    s = _clean()
+    cams = np.concatenate([s.cameras0, np.full((1, 9), np.nan)])
+    out = triage_problem(cams, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    rep = out.repair
+    assert rep is not None and not rep.is_noop
+    assert rep.edges_masked == 0 and rep.cams_anchored == 0
+    assert rep.cams_fixed == 1 and rep.cam_fixed[-1]
+    assert np.isfinite(rep.cameras).all()
+    # ...and the integration point applies it: flat_solve sanitises
+    from megba_tpu.serving import FleetProblem, FleetQueue, FleetStats
+
+    stats = FleetStats()
+    option = ProblemOption(dtype=np.float64,
+                           algo_option=AlgoOption(max_iter=2))
+    p = FleetProblem(cameras=cams, points=s.points0, obs=s.obs,
+                     cam_idx=s.cam_idx, pt_idx=s.pt_idx, name="nan-cam")
+    with FleetQueue(option, max_wait_s=10.0, stats=stats) as q:
+        fut = q.submit(p, triage=TriagePolicy(
+            on_degenerate=TriageAction.REPAIR))
+        q.flush()
+        r = fut.result(timeout=120)
+    assert stats.triage_repaired == 1
+    assert np.isfinite(float(r.cost))
+    assert np.isfinite(r.cameras).all()
+
+
+def test_behind_camera_knob_and_check():
+    s = _clean(n_behind_camera=2)
+    rep, internals = check_problem(*_triage_args(s))
+    f = rep.finding(CheckKind.BEHIND_CAMERA)
+    assert f is not None and f.count == 4  # 2 points x 2 observing cams
+    # the flagged edges' depths really are behind (z >= 0)
+    uv, z = project_batch_depth(s.cameras0[s.cam_idx], s.points0[s.pt_idx])
+    flagged = np.zeros(len(s.cam_idx), bool)
+    flagged[np.nonzero(internals["bad_edge"])[0]] = True
+    assert (z[flagged] >= -TriagePolicy().min_depth).all()
+    # composition: masking both edges drops the points to deg 0
+    assert rep.counts()["under_constrained_point"] == 2
+
+
+def test_orphan_knob_deg1_and_far_initial_estimate():
+    s = _clean(n_orphan_points=5)
+    deg = np.bincount(s.pt_idx, minlength=s.points0.shape[0])
+    orphans = np.nonzero(deg == 1)[0]
+    assert orphans.size == 5
+    # failed-triangulation model: initial estimate far out along the ray
+    assert (np.linalg.norm(s.points0[orphans], axis=1) > 50).all()
+    rep, _ = check_problem(*_triage_args(s))
+    assert rep.counts()["under_constrained_point"] == 5
+    # the far placement stays ON the observed ray: no extreme-residual
+    # or cheirality finding rides along
+    assert rep.finding(CheckKind.EXTREME_RESIDUAL) is None
+    assert rep.finding(CheckKind.BEHIND_CAMERA) is None
+
+
+def test_disconnect_knob_components_and_anchor():
+    s = _clean(n_disconnect=2)
+    out = triage_problem(*_triage_args(s),
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    f = out.report.finding(CheckKind.DISCONNECTED)
+    assert f is not None and f.count == 1  # one EXTRA camera component
+    rep = out.repair
+    assert rep.cams_anchored == 1
+    # the anchor lands in the island (cameras 6..7), not the main rig
+    assert rep.cam_fixed is not None
+    assert np.nonzero(rep.cam_fixed)[0].min() >= 6
+    # clean problems never anchor
+    out2 = triage_problem(*_triage_args(_clean()),
+                          TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    assert out2.repair is None
+
+
+def test_extreme_residual_downweight_matches_robust_kernel():
+    s = _clean()
+    obs = s.obs.copy()
+    obs[3] += 1e6  # gross outlier, still finite
+    pol = TriagePolicy(on_degenerate=TriageAction.REPAIR,
+                       max_residual_px=1e3)
+    out = triage_problem(s.cameras0, s.points0, obs, s.cam_idx, s.pt_idx,
+                         pol)
+    f = out.report.finding(CheckKind.EXTREME_RESIDUAL)
+    assert f is not None and f.count >= 1 and 3 in f.exemplars
+    rep = out.repair
+    assert rep.edges_downweighted >= 1
+    em = rep.edge_mask
+    assert 0.0 < em[3] < 1.0
+    # the mask weight IS the solver's own Huber kernel: mask = sqrt(w),
+    # with w = rho'(s) from ops/robust.rho_and_weight at the initial
+    # squared residual — the numpy twin must match the jnp kernel.
+    uv, _ = project_batch_depth(s.cameras0[s.cam_idx[3:4]],
+                                s.points0[s.pt_idx[3:4]])
+    s2 = float(np.sum((uv[0] - obs[3]) ** 2))
+    from megba_tpu.ops.robust import RobustKind, rho_and_weight
+
+    _, w_kernel = rho_and_weight(np.float64(s2), RobustKind.HUBER,
+                                 pol.max_residual_px)
+    np.testing.assert_allclose(em[3] ** 2, huber_weight(
+        np.asarray([s2]), pol.max_residual_px)[0], rtol=1e-12)
+    np.testing.assert_allclose(em[3], float(w_kernel), rtol=1e-6)
+    # downweight_outliers=False soft-deletes instead
+    out2 = triage_problem(
+        s.cameras0, s.points0, obs, s.cam_idx, s.pt_idx,
+        TriagePolicy(on_degenerate=TriageAction.REPAIR,
+                     max_residual_px=1e3, downweight_outliers=False))
+    assert out2.repair.edge_mask[3] == 0.0
+
+
+def test_low_parallax_frozen_but_edges_kept():
+    # two cameras at the SAME center: every ray pair is parallel, all
+    # points are zero-parallax; repair freezes the points but keeps the
+    # edges (fixed-landmark treatment).
+    cams = np.zeros((2, 9))
+    cams[:, 5] = -5.0
+    cams[:, 6] = 500.0
+    pts = np.array([[0.0, 0.0, 0.0], [0.3, 0.1, 0.0], [0.1, 0.3, 0.0],
+                    [-0.2, 0.1, 0.0]])
+    ci = np.array([0, 1] * 4, np.int32)
+    pi = np.repeat(np.arange(4), 2).astype(np.int32)
+    uv, _ = project_batch_depth(cams[ci], pts[pi])
+    out = triage_problem(cams, pts, uv, ci, pi,
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    f = out.report.finding(CheckKind.LOW_PARALLAX)
+    assert f is not None and f.count == 4
+    rep = out.repair
+    assert rep.pt_fixed.all()
+    assert rep.points_fixed == 4
+    # edges KEPT: no mask entry dropped to zero for low parallax
+    assert rep.edge_mask is None or (rep.edge_mask > 0).all()
+
+
+def test_checks_honor_caller_operands():
+    """Triage sees the graph the SOLVER will see: caller-masked edges
+    don't count toward degrees, caller-fixed points are never
+    under-constrained, and a component holding a caller-fixed camera is
+    already anchored."""
+    s = _clean()
+    # masking one of a deg-2 point's edges makes it deg-1 HERE
+    p0 = int(s.pt_idx[0])
+    edges_p0 = np.nonzero(s.pt_idx == p0)[0]
+    assert edges_p0.size >= 2
+    em = np.ones(len(s.cam_idx))
+    em[edges_p0[1:]] = 0.0  # leave exactly one alive observation
+    rep, _ = check_problem(*_triage_args(s), TriagePolicy(), edge_mask=em)
+    f = rep.finding(CheckKind.UNDER_CONSTRAINED_POINT)
+    assert f is not None and p0 in f.exemplars
+    # ...unless the caller already FIXED that point (identity Hll)
+    ptf = np.zeros(s.points0.shape[0], bool)
+    ptf[p0] = True
+    rep2, _ = check_problem(*_triage_args(s), TriagePolicy(),
+                            edge_mask=em, pt_fixed=ptf)
+    assert rep2.finding(CheckKind.UNDER_CONSTRAINED_POINT) is None
+    # a deg-1 knob problem whose orphans are pre-fixed is clean too
+    s2 = _clean(n_orphan_points=3)
+    deg = np.bincount(s2.pt_idx, minlength=s2.points0.shape[0])
+    out = triage_problem(*_triage_args(s2), TriagePolicy(),
+                         pt_fixed=deg < 2,
+                         edge_mask=np.where((deg < 2)[s2.pt_idx], 0.0, 1.0))
+    assert not out.report.degenerate
+    # caller-masked duplicate copies don't double-count
+    ci = np.concatenate([s.cam_idx, s.cam_idx[:1]]).astype(np.int32)
+    pi = np.concatenate([s.pt_idx, s.pt_idx[:1]]).astype(np.int32)
+    obs = np.concatenate([s.obs, s.obs[:1]])
+    em2 = np.ones(len(ci))
+    em2[-1] = 0.0
+    rep3, _ = check_problem(s.cameras0, s.points0, obs, ci, pi,
+                            TriagePolicy(), edge_mask=em2)
+    assert rep3.finding(CheckKind.DUPLICATE_EDGE) is None
+
+
+def test_anchored_component_needs_no_anchor():
+    s = _clean(n_disconnect=2)
+    n_cam = s.cameras0.shape[0]
+    # fix one ISLAND camera (cameras 6..7): the island is anchored, so
+    # the MAIN component is now the one needing a gauge (g2o semantics:
+    # with any anchor present, every unanchored component gets one).
+    cf = np.zeros(n_cam, bool)
+    cf[6] = True
+    out = triage_problem(*_triage_args(s),
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR),
+                         cam_fixed=cf)
+    f = out.report.finding(CheckKind.DISCONNECTED)
+    assert f is not None and f.count == 1
+    assert out.repair.cams_anchored == 1
+    anchors = np.nonzero(out.repair.cam_fixed & ~cf)[0]
+    assert anchors.size == 1 and anchors[0] < 6  # lands in the MAIN rig
+    # fixing a camera in EVERY component: nothing to flag
+    cf2 = np.zeros(n_cam, bool)
+    cf2[0] = cf2[6] = True
+    rep2, _ = check_problem(*_triage_args(s), TriagePolicy(),
+                            cam_fixed=cf2)
+    assert rep2.finding(CheckKind.DISCONNECTED) is None
+
+
+def test_structural_false_still_hits_ingestion_gate():
+    """TriagePolicy(structural=False) never scans for duplicates, so the
+    shared parser gate must still refuse them at the serving boundary."""
+    from megba_tpu.serving import FleetProblem, FleetQueue, solve_many
+
+    s = _clean()
+    option = ProblemOption(dtype=np.float64,
+                           algo_option=AlgoOption(max_iter=2))
+    dup = FleetProblem(
+        cameras=s.cameras0, points=s.points0,
+        obs=np.concatenate([s.obs, s.obs[:1]]),
+        cam_idx=np.concatenate([s.cam_idx, s.cam_idx[:1]]),
+        pt_idx=np.concatenate([s.pt_idx, s.pt_idx[:1]]),
+        name="dup")
+    pol = TriagePolicy(on_degenerate=TriageAction.REPAIR, structural=False)
+    with FleetQueue(option, max_wait_s=10.0) as q:
+        with pytest.raises(ValueError, match="duplicate observation"):
+            q.submit(dup, triage=pol)
+    # solve_many: a hand-attached health dict without a structural pass
+    # does not bypass the gate either
+    out = triage_problem(*_triage_args(s), pol)
+    tagged = dataclasses.replace(dup, health=out.report.to_dict())
+    assert tagged.health["structural"] is False
+    with pytest.raises(ValueError, match="duplicate observation"):
+        solve_many([tagged], option)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        TriagePolicy(min_point_degree=0)
+    with pytest.raises(ValueError):
+        TriagePolicy(max_residual_px=0.0)
+    with pytest.raises(ValueError):
+        TriagePolicy(min_depth=-1.0)
+    with pytest.raises(ValueError):
+        TriagePolicy(exemplar_cap=0)
+    with pytest.raises(ValueError):
+        make_synthetic_bal(num_cameras=4, num_points=8, n_orphan_points=-1)
+
+
+def test_report_roundtrip_and_rejection_payload():
+    s = _clean(n_orphan_points=3)
+    with pytest.raises(ProblemRejected) as ei:
+        triage_problem(*_triage_args(s))
+    rep = ei.value.report
+    assert rep.degenerate and rep.action == "reject"
+    assert "under_constrained_point" in str(ei.value)
+    d = rep.to_dict()
+    back = HealthReport.from_dict(d)
+    assert back.to_dict() == d
+    assert back.counts() == rep.counts()
+    # exemplars are BOUNDED
+    s2 = _clean(n_orphan_points=30)
+    rep2, _ = check_problem(*_triage_args(s2),
+                            TriagePolicy(exemplar_cap=4))
+    f = rep2.finding(CheckKind.UNDER_CONSTRAINED_POINT)
+    assert f.count == 30 and len(f.exemplars) == 4
+
+
+def test_synthetic_knobs_zero_is_byte_identical():
+    a = make_synthetic_bal(num_cameras=5, num_points=32, obs_per_point=2.5,
+                           seed=11)
+    b = make_synthetic_bal(num_cameras=5, num_points=32, obs_per_point=2.5,
+                           seed=11, n_orphan_points=0, n_behind_camera=0,
+                           n_disconnect=0)
+    for f in ("cameras_gt", "points_gt", "cameras0", "points0", "obs",
+              "cam_idx", "pt_idx"):
+        assert getattr(a, f).tobytes() == getattr(b, f).tobytes(), f
+
+
+def test_synthetic_knobs_still_cam_sorted_and_validated():
+    s = _clean(n_orphan_points=2, n_behind_camera=2, n_disconnect=2, seed=4)
+    assert (np.diff(s.cam_idx) >= 0).all()
+    # the generator's own ingestion gate passed (no duplicates, finite)
+    from megba_tpu.io.bal import validate_problem
+
+    validate_problem(s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+                     where="test")
+
+
+# ---------------------------------------------------------------------------
+# zero-dispatch REJECT + integration (compile-free)
+# ---------------------------------------------------------------------------
+
+
+def test_flat_solve_reject_zero_dispatch():
+    from megba_tpu.analysis import retrace
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+    from megba_tpu.utils.timing import PhaseTimer
+
+    s = _clean(n_orphan_points=4)
+    option = ProblemOption(dtype=F32, algo_option=AlgoOption(max_iter=4))
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    base = retrace.snapshot()
+    timer = PhaseTimer()
+    with pytest.raises(ProblemRejected) as ei:
+        flat_solve(f, s.cameras0.astype(F32), s.points0.astype(F32),
+                   s.obs.astype(F32), s.cam_idx, s.pt_idx, option,
+                   use_tiled=False, timer=timer, triage=TriagePolicy())
+    # ZERO device dispatch: no program traced, no dispatch/lowering
+    # phase — the triage phase is the only thing the timer saw.
+    assert retrace.snapshot() == base
+    assert "dispatch" not in timer.totals
+    assert "lowering" not in timer.totals
+    assert "triage" in timer.totals
+    assert ei.value.report.counts()["under_constrained_point"] == 4
+
+
+def test_queue_triage_reject_resolves_future_fast():
+    from megba_tpu.serving import FleetProblem, FleetQueue, FleetStats
+
+    s = _clean(n_orphan_points=3)
+    stats = FleetStats()
+    option = ProblemOption(dtype=np.float64,
+                           algo_option=AlgoOption(max_iter=2))
+    with FleetQueue(option, max_batch=4, max_wait_s=10.0,
+                    stats=stats) as q:
+        fut = q.submit(FleetProblem.from_synthetic(s, name="deg"),
+                       triage=TriagePolicy())
+        # resolved IMMEDIATELY on the submitter's thread: never queued,
+        # never dispatched, never in the escalation ladder
+        assert fut.done()
+        with pytest.raises(ProblemRejected):
+            fut.result()
+    assert stats.triage_rejected == 1
+    assert stats.problems == 0 and stats.batches == 0  # nothing dispatched
+    d = stats.as_dict()
+    assert d["triage_rejected"] == 1
+
+
+def test_serving_ingestion_gate_adversarial():
+    """The PR 5 parser gate, now shared: duplicate edges and non-finite
+    values are refused at BOTH serving boundaries (they used to sneak in
+    through make_fleet / pad_to_class unchecked)."""
+    from megba_tpu.serving import FleetProblem, FleetQueue, solve_many
+
+    s = _clean()
+    option = ProblemOption(dtype=np.float64,
+                           algo_option=AlgoOption(max_iter=2))
+    dup = FleetProblem(
+        cameras=s.cameras0, points=s.points0,
+        obs=np.concatenate([s.obs, s.obs[:1]]),
+        cam_idx=np.concatenate([s.cam_idx, s.cam_idx[:1]]),
+        pt_idx=np.concatenate([s.pt_idx, s.pt_idx[:1]]),
+        name="dup")
+    bad_obs = FleetProblem(
+        cameras=s.cameras0, points=s.points0,
+        obs=np.where(np.arange(s.obs.shape[0])[:, None] == 3,
+                     np.nan, s.obs),
+        cam_idx=s.cam_idx, pt_idx=s.pt_idx, name="nan")
+    oob = FleetProblem(
+        cameras=s.cameras0, points=s.points0, obs=s.obs,
+        cam_idx=np.where(np.arange(s.cam_idx.shape[0]) == 0,
+                         99, s.cam_idx).astype(np.int32),
+        pt_idx=s.pt_idx, name="oob")
+    for bad, what in ((dup, "duplicate"), (bad_obs, "non-finite"),
+                      (oob, "out of range")):
+        with pytest.raises(ValueError, match="BAL semantic error"):
+            solve_many([bad], option)
+        with FleetQueue(option, max_wait_s=10.0) as q:
+            with pytest.raises(ValueError, match="BAL semantic error"):
+                q.submit(bad)
+    # triage REPAIR turns the duplicate-edge reject into a masked solve
+    # (content admission repairs what the plain gate refuses) — pure
+    # host decision, queue drained empty without dispatching anything.
+    with FleetQueue(option, max_wait_s=10.0) as q:
+        fut = q.submit(
+            dup, triage=TriagePolicy(on_degenerate=TriageAction.REJECT))
+        assert fut.done()
+
+
+def test_aggregate_cli_renders_triage_counters(tmp_path):
+    """Compile-free aggregate rendering over hand-built report lines."""
+    import json
+
+    from megba_tpu.observability import summarize
+    from megba_tpu.observability.report import SolveReport
+
+    s = _clean(n_orphan_points=2)
+    out = triage_problem(*_triage_args(s),
+                         TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    health = out.report.to_dict()
+    base = dict(problem={}, config={}, backend={}, phases={},
+                result={"status_name": "converged"})
+    lines = [
+        SolveReport(**base, health=health, created_unix=1.0,
+                    fleet={"bucket": "b", "latency_s": 0.1,
+                           "stats": {"triage_rejected": 3}}).to_json(),
+        SolveReport(**base, created_unix=2.0).to_json(),
+    ]
+    path = tmp_path / "reports.jsonl"
+    path.write_text("\n".join(lines) + "\n")
+    text = summarize.aggregate_paths([str(path)])
+    assert "triage: 3 rejected / 1 repaired" in text, text
+    assert "2 points fixed" in text and "2 edges masked" in text, text
+    assert "under_constrained_point=2" in text, text
+    # round-trips through from_json too
+    rep = SolveReport.from_json(lines[0])
+    assert rep.health == json.loads(json.dumps(health))
+
+
+def test_triage_module_is_jit_free():
+    """The hygiene gate: triage is pure host NumPy — it never imports
+    jax and contributes no jit entries to the analysis callgraph."""
+    import megba_tpu.robustness.triage as triage_mod
+
+    src = open(triage_mod.__file__).read()
+    assert "import jax" not in src
+    from megba_tpu.analysis.callgraph import PackageIndex
+
+    index = PackageIndex.build([triage_mod.__file__])
+    entries = [q for q, fn in index.functions.items() if fn.is_entry]
+    assert entries == [], f"triage exposes jit entries: {entries}"
+
+
+# ---------------------------------------------------------------------------
+# the shift-left regression (compiles two small programs)
+# ---------------------------------------------------------------------------
+
+
+def _shift_left_option():
+    return ProblemOption(
+        dtype=F32,
+        algo_option=AlgoOption(max_iter=10),
+        solver_option=SolverOption(
+            max_iter=20, tol=1e-10,
+            preconditioner=PreconditionerKind.SCHUR_DIAG),
+        robust_option=RobustOption(guards=True))
+
+
+def test_shift_left_repair_eliminates_runtime_fallbacks():
+    """A deg-1-point problem solved UN-triaged fires the runtime
+    precond_fallback path (the far points' near-singular Hll crushes the
+    Schur diagonal; its Cholesky goes NaN and falls back per block);
+    the SAME problem under TriagePolicy(REPAIR) solves with ZERO
+    fallback/recovery events and matches a hand-repaired control at
+    rtol 1e-6 — i.e. triage de-loads the reactive guard layer."""
+    from megba_tpu.observability.report import _decode_fallback_totals
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    s = make_synthetic_bal(num_cameras=6, num_points=48, obs_per_point=3,
+                           seed=3, dtype=F32, n_orphan_points=6)
+    option = _shift_left_option()
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    args = (f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, option)
+
+    untriaged = flat_solve(*args, use_tiled=False)
+    fb_un = _decode_fallback_totals(untriaged.trace,
+                                    int(untriaged.iterations))
+    assert fb_un["block"] > 0, (
+        "expected the un-triaged deg-1 problem to fire runtime "
+        f"precond_fallback events, got {fb_un} "
+        f"(recoveries={int(untriaged.recoveries)})")
+
+    triaged = flat_solve(
+        *args, use_tiled=False,
+        triage=TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    fb_tr = _decode_fallback_totals(triaged.trace, int(triaged.iterations))
+    assert fb_tr == {"block": 0, "coarse": 0}, fb_tr
+    assert int(triaged.recoveries) == 0
+    assert int(triaged.status) in (SolveStatus.CONVERGED,
+                                   SolveStatus.MAX_ITER)
+    assert np.isfinite(float(triaged.cost))
+
+    # hand-repaired control: manually freeze deg-1 points + mask edges
+    deg = np.bincount(s.pt_idx, minlength=s.points0.shape[0])
+    ptf = deg < 2
+    em = np.where(ptf[s.pt_idx], 0.0, 1.0)
+    control = flat_solve(*args, use_tiled=False, edge_mask=em, pt_fixed=ptf)
+    assert int(control.status) == int(triaged.status)
+    np.testing.assert_allclose(float(triaged.cost), float(control.cost),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(triaged.cameras),
+                               np.asarray(control.cameras),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(triaged.points)[~ptf],
+                               np.asarray(control.points)[~ptf],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_warn_solves_like_untriaged_with_report(tmp_path):
+    """WARN changes nothing about the solve (bitwise) — it only attaches
+    the health report; rides the programs the shift-left test compiled."""
+    from megba_tpu.observability.report import SolveReport
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    s = make_synthetic_bal(num_cameras=6, num_points=48, obs_per_point=3,
+                           seed=3, dtype=F32, n_orphan_points=6)
+    option = _shift_left_option()
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    args = (f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx)
+
+    plain = flat_solve(*args, option, use_tiled=False)
+    sink = tmp_path / "warn.jsonl"
+    opt_t = dataclasses.replace(option, telemetry=str(sink))
+    warned = flat_solve(
+        *args, opt_t, use_tiled=False,
+        triage=TriagePolicy(on_degenerate=TriageAction.WARN))
+    assert np.asarray(warned.cameras).tobytes() == \
+        np.asarray(plain.cameras).tobytes()
+    assert float(warned.cost) == float(plain.cost)
+    rep = SolveReport.from_json(sink.read_text().strip().splitlines()[-1])
+    assert rep.health is not None
+    assert rep.health["action"] == "warn"
+    assert rep.health["degenerate"]
+    assert rep.health["repair"] is None
+
+
+@pytest.mark.slow
+def test_queue_triage_repair_end_to_end_batched():
+    """REPAIR through the fleet queue: the repaired problem rides the
+    batched program as pure operands next to a clean batch-mate, whose
+    result stays BITWISE identical to a solo solve_many control."""
+    from megba_tpu.serving import (
+        FleetProblem,
+        FleetQueue,
+        FleetStats,
+        solve_many,
+    )
+
+    deg = make_synthetic_bal(num_cameras=6, num_points=48, obs_per_point=3,
+                             seed=3, dtype=np.float64, n_orphan_points=6)
+    clean = _clean(seed=9)
+    option = ProblemOption(dtype=np.float64,
+                           algo_option=AlgoOption(max_iter=5),
+                           solver_option=SolverOption(max_iter=10, tol=1e-9))
+    p_deg = FleetProblem.from_synthetic(deg, name="deg")
+    p_clean = FleetProblem.from_synthetic(clean, name="clean")
+    stats = FleetStats()
+    with FleetQueue(option, max_batch=4, max_wait_s=30.0,
+                    stats=stats) as q:
+        f_deg = q.submit(
+            p_deg, triage=TriagePolicy(on_degenerate=TriageAction.REPAIR))
+        f_clean = q.submit(p_clean)
+        q.flush()
+        r_deg = f_deg.result(timeout=10)
+        r_clean = f_clean.result(timeout=10)
+    assert stats.triage_repaired == 1
+    assert r_deg.health is not None
+    assert r_deg.health["repair"]["points_fixed"] == 6
+    assert np.isfinite(float(r_deg.cost))
+    # Control: the SAME two-lane batch built by hand — triage repair
+    # applied directly, then solve_many (lane count is part of the
+    # compiled program, so the control must match the composition).
+    out = triage_problem(
+        deg.cameras0, deg.points0, deg.obs, deg.cam_idx, deg.pt_idx,
+        TriagePolicy(on_degenerate=TriageAction.REPAIR))
+    rep = out.repair
+    p_repaired = dataclasses.replace(
+        p_deg, edge_mask=rep.edge_mask, cam_fixed=rep.cam_fixed,
+        pt_fixed=rep.pt_fixed, health=out.report.to_dict())
+    ctrl_deg, ctrl_clean = solve_many([p_repaired, p_clean], option)
+    assert r_clean.cameras.tobytes() == ctrl_clean.cameras.tobytes()
+    assert r_clean.cost.tobytes() == ctrl_clean.cost.tobytes()
+    assert r_deg.cameras.tobytes() == ctrl_deg.cameras.tobytes()
+    assert r_deg.cost.tobytes() == ctrl_deg.cost.tobytes()
