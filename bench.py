@@ -267,6 +267,12 @@ def main() -> None:
     jac_mode = JacobianMode[jac_name]
     compute_kind = ComputeKind[ck_name]
 
+    # MEGBA_BENCH_LOCALITY=ring|grid swaps the expander observation
+    # assignment for a locality-structured scene (banded camera
+    # co-observation — the structure real BAL graphs have and the
+    # camera-graph coarse-space preconditioners need; see
+    # io/synthetic.py).  Default: the historical expander scene.
+    locality = os.environ.get("MEGBA_BENCH_LOCALITY") or None
     s = make_synthetic_bal(
         num_cameras=NUM_CAMERAS,
         num_points=NUM_POINTS,
@@ -275,6 +281,7 @@ def main() -> None:
         param_noise=1e-2,
         pixel_noise=0.5,
         dtype=dtype,
+        locality=locality,
     )
     n_edge = s.obs.shape[0]
 
@@ -448,13 +455,21 @@ def main() -> None:
         cand_kind = PrecondKind[precond_kind_env.upper()]
         n_clusters = int(os.environ.get("MEGBA_BENCH_CLUSTERS", "0") or "0")
         n_order = int(os.environ.get("MEGBA_BENCH_NEUMANN_ORDER", "1"))
+        # Hierarchy / smoothed-aggregation knobs (MULTILEVEL /
+        # TWO_LEVEL): per-level coarsening factor, total level cap,
+        # prolongator smoothing weight.
+        coarsen = float(os.environ.get("MEGBA_BENCH_COARSEN", "4.0"))
+        n_levels = int(os.environ.get("MEGBA_BENCH_LEVELS", "3"))
+        sm_omega = float(os.environ.get("MEGBA_BENCH_SMOOTH_OMEGA", "0.0"))
         base_opt = _dcp.replace(option, solver_option=SolverOption(
             max_iter=100, refuse_ratio=1e30, forcing=True, warm_start=True))
         cand_opt = _dcp.replace(option, solver_option=SolverOption(
             max_iter=100, refuse_ratio=1e30, forcing=True, warm_start=True,
             precond=cand_kind, neumann_order=n_order,
-            coarse_clusters=n_clusters))
+            coarse_clusters=n_clusters, coarsen_factor=coarsen,
+            max_levels=n_levels, smooth_omega=sm_omega))
         cand_cluster_plan = None
+        hierarchy_levels = None
         if cand_kind == PrecondKind.TWO_LEVEL:
             from megba_tpu.ops.segtiles import cached_cluster_plan
 
@@ -462,10 +477,30 @@ def main() -> None:
                 (_, cand_cluster_plan), _hit = cached_cluster_plan(
                     np.asarray(cam_idx_p), np.asarray(pt_idx_p),
                     NUM_CAMERAS, NUM_POINTS, n_clusters,
-                    mask=np.asarray(mask))
+                    mask=np.asarray(mask), smooth_omega=sm_omega)
+            hierarchy_levels = 2
+        elif cand_kind == PrecondKind.MULTILEVEL:
+            from megba_tpu.ops.segtiles import cached_multilevel_plan
+
+            with timer.phase("plan"):
+                (mplan, cand_cluster_plan), _hit = cached_multilevel_plan(
+                    np.asarray(cam_idx_p), np.asarray(pt_idx_p),
+                    NUM_CAMERAS, NUM_POINTS, n_clusters,
+                    mask=np.asarray(mask), coarsen_factor=coarsen,
+                    max_levels=n_levels, smooth_omega=sm_omega)
+            # fine level + every planned coarse level
+            hierarchy_levels = 1 + len(mplan.level_sizes)
         p_base, p_base_s = timed_solve(base_opt, "precond_base")
         p_cand, p_cand_s = timed_solve(cand_opt, "precond_cand",
                                        cluster_plan=cand_cluster_plan)
+        # Per-level fallback totals decoded from the candidate's trace
+        # (solver/precond.py enum codes): the head-to-head artifact
+        # records whether the stronger operator actually ran its full
+        # hierarchy or spent iterations degraded.
+        from megba_tpu.observability.report import _decode_fallback_totals
+
+        cand_fallback = _decode_fallback_totals(
+            p_cand.trace, int(p_cand.iterations))
         b_pcg, c_pcg = int(p_base.pcg_iterations), int(p_cand.pcg_iterations)
         b_cost = float(p_base.cost)
         b_iter_ms = 1000.0 * p_base_s / max(b_pcg, 1)
@@ -473,8 +508,14 @@ def main() -> None:
         precond_cmp = {
             "kind": cand_kind.name.lower(),
             "baseline_kind": "jacobi",
+            "locality": locality,
             "coarse_clusters": n_clusters,
             "neumann_order": n_order,
+            "coarsen_factor": coarsen,
+            "max_levels": n_levels,
+            "smooth_omega": sm_omega,
+            "hierarchy_levels": hierarchy_levels,
+            "fallback": cand_fallback,
             "pcg_iters_total": c_pcg,
             "pcg_iters_total_jacobi": b_pcg,
             "pcg_reduction": round(1.0 - c_pcg / max(b_pcg, 1), 4),
@@ -578,7 +619,8 @@ def main() -> None:
                     f"({NUM_CAMERAS} cams / {NUM_POINTS} pts / {n_edge} edges, "
                     f"{measured_pcg_per_lm:.1f} PCG iters/LM), "
                     f"{dtype_name} {jac_name.lower()} {ck_name.lower()}"
-                    f"{' bf16-mixed' if mixed else ''}, "
+                    f"{' bf16-mixed' if mixed else ''}"
+                    f"{f' locality={locality}' if locality else ''}, "
                     f"1 chip [{backend}]{backend_note}"
                 ),
                 "value": round(lm_iters_per_sec, 3),
@@ -587,6 +629,9 @@ def main() -> None:
                 "fallback": fallback,
                 "extra": {
                     "backend": backend,
+                    # Scene structure (MEGBA_BENCH_LOCALITY): None =
+                    # the historical expander assignment.
+                    "locality": locality,
                     # Termination semantics (common.SolveStatus): a
                     # driver reading this line can tell a converged
                     # number from a stalled or recovered one.

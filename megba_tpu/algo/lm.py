@@ -201,13 +201,15 @@ def lm_solve(
     consumer; verbose emission is the one vmap-hostile feature (host
     callback), so batched programs run `verbose=False`.
 
-    `cluster_plan` (ops/segtiles.DeviceClusterPlan) is the host-planned
-    camera-cluster coarse space consumed by the TWO_LEVEL
-    preconditioner (solver/precond.py); its per-edge `pc_slot` stream
-    is in this call's edge order (shard-local when `axis_name` names a
-    mesh axis), everything else replicated.  Required when
-    `SolverOption.precond == PrecondKind.TWO_LEVEL`, ignored otherwise
-    — the flat_solve lowering threads it automatically.
+    `cluster_plan` (ops/segtiles.DeviceClusterPlan, or
+    DeviceMultiLevelPlan for the MULTILEVEL hierarchy) is the
+    host-planned camera-cluster coarse space consumed by the
+    TWO_LEVEL/MULTILEVEL preconditioners (solver/precond.py); its
+    per-edge `pc_slot` stream is in this call's edge order
+    (shard-local when `axis_name` names a mesh axis), everything else
+    replicated.  Required when `SolverOption.precond` is TWO_LEVEL or
+    MULTILEVEL, ignored otherwise — the flat_solve lowering threads it
+    automatically.
 
     `fault_plan` (robustness.faults.FaultPlan, edge_nan already in this
     call's edge order) injects deterministic faults at the residual /
@@ -345,11 +347,14 @@ def lm_solve(
         return (s["k"] < algo_opt.max_iter) & (~s["stop"])
 
     if (option.use_schur and cluster_plan is None
-            and solver_opt.precond == PrecondKind.TWO_LEVEL):
+            and solver_opt.precond in (PrecondKind.TWO_LEVEL,
+                                       PrecondKind.MULTILEVEL)):
         raise ValueError(
-            "SolverOption.precond=TWO_LEVEL needs a camera-cluster plan "
-            "operand: solve through flat_solve (which plans + caches it) "
-            "or pass cluster_plan=ops.segtiles.device_cluster_plan(...)")
+            f"SolverOption.precond={solver_opt.precond.name} needs a "
+            "camera-cluster plan operand: solve through flat_solve (which "
+            "plans + caches it) or pass cluster_plan="
+            "ops.segtiles.device_cluster_plan(...) / "
+            "device_multilevel_plan(...)")
 
     pcg_solve = schur_pcg_solve if option.use_schur else plain_pcg_solve
 
@@ -376,7 +381,8 @@ def lm_solve(
                 max_restarts=robust_opt.pcg_max_restarts if guards else 0,
                 precond=solver_opt.precond,
                 neumann_order=solver_opt.neumann_order,
-                cluster_plan=cluster_plan, cam_fixed=cam_fixed)
+                cluster_plan=cluster_plan, cam_fixed=cam_fixed,
+                smooth_omega=solver_opt.smooth_omega)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply

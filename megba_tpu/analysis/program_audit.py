@@ -237,15 +237,29 @@ def _ba_option():
         solver_option=SolverOption(max_iter=8, tol=1e-8))
 
 
+def _ba_ml_problem():
+    # The multilevel canonical program needs a camera graph big enough
+    # to plan >= 2 coarse levels (the 4-camera problem aggregates to 2
+    # clusters, under the hierarchy's own coarsest floor) — a small
+    # RING-locality scene, the structure the operator targets.
+    from megba_tpu.io.synthetic import make_synthetic_bal
+
+    return make_synthetic_bal(
+        num_cameras=12, num_points=60, obs_per_point=3, seed=0,
+        param_noise=4e-2, pixel_noise=0.3, dtype=np.float32,
+        locality="ring")
+
+
 def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
-              guarded: bool = False, twolevel: bool = False):
+              guarded: bool = False, twolevel: bool = False,
+              multilevel: bool = False):
     import dataclasses as _dc
 
     from megba_tpu.common import JacobianMode, RobustOption, SolverOption
     from megba_tpu.ops.residuals import make_residual_jacobian_fn
     from megba_tpu.solve import flat_solve
 
-    s = _ba_problem()
+    s = _ba_ml_problem() if multilevel else _ba_problem()
     option = _ba_option()
     if world > 1:
         option = _dc.replace(option, world_size=world)
@@ -267,6 +281,16 @@ def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
 
         option = _dc.replace(option, solver_option=_dc.replace(
             option.solver_option, precond=PrecondKind.TWO_LEVEL))
+    if multilevel:
+        # Recursive-hierarchy canonical program: the
+        # DeviceMultiLevelPlan operand carries the level-1 cluster plan
+        # + coarse assignment chain; every per-level Galerkin build
+        # (edge-scale level 1, dense above) lives OUTSIDE pcg_core.
+        from megba_tpu.common import PrecondKind
+
+        option = _dc.replace(option, solver_option=_dc.replace(
+            option.solver_option, precond=PrecondKind.MULTILEVEL,
+            coarsen_factor=2.0, max_levels=3))
     f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
                       option, use_tiled=use_tiled, lower_only=True)
@@ -381,6 +405,22 @@ def program_specs() -> Dict[str, ProgramSpec]:
             donate_leaves=_sharded_donation(),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     twolevel=True)),
+        "ba_multilevel_w2_f32": ProgramSpec(
+            name="ba_multilevel_w2_f32", float_family="f32", world=2,
+            # Recursive multilevel Schur preconditioner (3-level
+            # hierarchy on a ring-locality scene): the level-1 coarse
+            # build psums V and G once per PCG solve and every DEEPER
+            # level is a replicated dense Galerkin contraction with
+            # ZERO collectives of its own — so the while-BODY census
+            # stays exactly two all-reduces per S·p, identical to
+            # block-Jacobi and the two-level cycle.  A hierarchy level
+            # that added an in-body collective (or a per-level build
+            # that slid inside pcg_core) is precisely the regression
+            # this spec pins against.
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            build=lambda: _lower_ba(world=2, use_tiled=False,
+                                    multilevel=True)),
         "ba_batched_b4_f32": ProgramSpec(
             name="ba_batched_b4_f32", float_family="f32", world=1,
             # The batched program is a vmap over a LANE axis on one

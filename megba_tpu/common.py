@@ -110,11 +110,35 @@ class PrecondKind(enum.Enum):
     becomes exactly the base apply) → Hpp (per-block, SCHUR_DIAG
     only), each level COUNTED in `PCGResult.precond_fallback`
     (enum-coded per level — solver/precond.py encode/decode).
+    MULTILEVEL = the TWO_LEVEL scheme generalized to an L-level
+    camera-graph hierarchy (solver/precond.py): the level-1 coarse
+    space is the same host-planned co-observation aggregation, and
+    every coarser level re-aggregates the previous level's cluster
+    graph (`SolverOption.coarsen_factor` per level, up to
+    `SolverOption.max_levels` levels — planned host-side ONCE,
+    ops/segtiles.build_multilevel_plan).  Level 1's Galerkin operator
+    and coupling are assembled from the materialised solve quantities
+    exactly as TWO_LEVEL's; every deeper level's Galerkin operator
+    A_{l+1} = R_l A_l R_lᵀ is a tiny replicated dense contraction, so
+    the recursive symmetrized multiplicative V-cycle keeps ZERO
+    collectives inside the PCG while body (pinned by the
+    `ba_multilevel_w2_f32` canonical audit program) and only the
+    COARSEST level pays the dense filtered pseudo-inverse.  Per-level
+    health rides the same enum code as a BIT-FIELD (bit l-1 of the
+    high half = level-l coarse operator degraded), so a mid-hierarchy
+    degrade truncates the cycle at that level, never poisons it.
+    Both TWO_LEVEL and MULTILEVEL accept `SolverOption.smooth_omega`:
+    smoothed-aggregation prolongators P = Rᵀ − ω D⁻¹ S_d Rᵀ (the
+    expander-robust variant — the already-materialised G = S_d Rᵀ
+    makes the smoothing itself free; the exact smoothed Galerkin costs
+    one extra column-blocked S·(D⁻¹G) pass per build, still outside
+    the PCG body).
     """
 
     JACOBI = 0
     NEUMANN = 1
     TWO_LEVEL = 2
+    MULTILEVEL = 3
 
 
 class PreconditionerKind(enum.Enum):
@@ -268,6 +292,20 @@ class SolverOption:
     precond: PrecondKind = PrecondKind.JACOBI
     neumann_order: int = 2
     coarse_clusters: int = 0
+    # Multilevel hierarchy knobs (MULTILEVEL only): every level beyond
+    # the first re-aggregates the previous level's cluster graph by
+    # ~`coarsen_factor`, until `max_levels` total levels (fine level
+    # included) or the coarse space stops shrinking.  TWO_LEVEL is
+    # exactly MULTILEVEL at max_levels=2.
+    coarsen_factor: float = 4.0
+    max_levels: int = 3
+    # Smoothed-aggregation prolongator weight (TWO_LEVEL/MULTILEVEL):
+    # 0 = piecewise-constant aggregation (the PR 7 operator, bitwise);
+    # omega > 0 smooths the level-1 prolongator to Rᵀ − ω D⁻¹ S_d Rᵀ,
+    # widening the coarse space so it captures smooth error even on
+    # cluster-poor (expander-like) camera graphs.  Conventional range
+    # (0, 1); ~2/3 is the classical damped-Jacobi choice.
+    smooth_omega: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -381,12 +419,34 @@ def validate_options(option: ProblemOption) -> None:
         raise ValueError(
             f"coarse_clusters must be >= 0 (0 = auto sqrt(Nc)), got "
             f"{option.solver_option.coarse_clusters}")
+    if not option.solver_option.coarsen_factor > 1.0:
+        raise ValueError(
+            f"coarsen_factor must be > 1 (each level must shrink), got "
+            f"{option.solver_option.coarsen_factor}")
+    # The per-level fallback bit-field shares one int32 with the 16-bit
+    # block count (solver/precond.py): coarse levels ride bits 16..30.
+    if not 2 <= option.solver_option.max_levels <= 15:
+        raise ValueError(
+            f"max_levels must be in [2, 15] (fine level included; the "
+            f"per-level fallback bit-field carries at most 15 coarse "
+            f"levels), got {option.solver_option.max_levels}")
+    if not 0.0 <= option.solver_option.smooth_omega < 2.0:
+        raise ValueError(
+            f"smooth_omega must be in [0, 2) (0 = plain aggregation), "
+            f"got {option.solver_option.smooth_omega}")
+    if (option.solver_option.smooth_omega
+            and option.solver_option.precond not in (
+                PrecondKind.TWO_LEVEL, PrecondKind.MULTILEVEL)):
+        raise ValueError(
+            "smooth_omega smooths the camera-graph coarse space; it "
+            "requires precond=TWO_LEVEL or MULTILEVEL, got "
+            f"{option.solver_option.precond.name}")
     if (not option.use_schur
             and option.solver_option.precond != PrecondKind.JACOBI):
         raise ValueError(
-            "precond=NEUMANN/TWO_LEVEL is only implemented for the Schur "
-            "solver (use_schur=True); the plain full-system solver's "
-            "exact block diagonal IS its preconditioner")
+            "precond=NEUMANN/TWO_LEVEL/MULTILEVEL is only implemented for "
+            "the Schur solver (use_schur=True); the plain full-system "
+            "solver's exact block diagonal IS its preconditioner")
     if option.robust_option.max_recoveries < 1:
         raise ValueError(
             f"max_recoveries must be >= 1, got "

@@ -71,6 +71,73 @@ def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
     return project_batch_depth(cameras, points)[0]
 
 
+LOCALITY_MODES = (None, "ring", "grid")
+
+
+def _locality_assign(
+    anchors: np.ndarray,
+    pts_xy: np.ndarray,
+    kf: int,
+    kc: int,
+    n_hi: int,
+):
+    """k-nearest-anchor windowed visibility: [Np, 2] point positions vs
+    [Nc, 2] camera anchors -> (cam_idx, pt_idx) edge streams.
+
+    Each point keeps its `kc` nearest cameras sorted nearest-first, the
+    tail points beyond `n_hi` drop down to their `kf` nearest — the
+    fractional obs-per-point rule of the base generator, applied in
+    DISTANCE order so dropping observations never breaks locality.
+    """
+    num_points = pts_xy.shape[0]
+    num_cameras = anchors.shape[0]
+    kc = min(kc, num_cameras)
+    kf = min(kf, kc)
+    # Chunk the [chunk, Nc] distance/argpartition work over points: a
+    # full [Np, Nc] matrix is ~14 GB f64 at venice scale and ~480 GB at
+    # BAL-Final — the same host-RAM blowup the base generator's chunked
+    # projection loop guards against.  ~5e7 elements per chunk keeps
+    # the transient a few hundred MB at any supported scale.
+    chunk = max(1, int(50_000_000 // max(num_cameras, 1)))
+    near = np.empty((num_points, kc), np.int64)
+    # Per-camera running nearest point (for the missing-camera fixup
+    # below) — accumulated chunk-wise so no full column is ever needed.
+    nearest_pt_d2 = np.full(num_cameras, np.inf)
+    nearest_pt = np.zeros(num_cameras, np.int64)
+    for lo in range(0, num_points, chunk):
+        hi = min(lo + chunk, num_points)
+        d2 = np.sum((pts_xy[lo:hi, None, :] - anchors[None, :, :]) ** 2,
+                    axis=2)
+        if kc < num_cameras:
+            nc = np.argpartition(d2, kc - 1, axis=1)[:, :kc]
+        else:
+            nc = np.broadcast_to(np.arange(num_cameras),
+                                 (hi - lo, kc)).copy()
+        order = np.argsort(np.take_along_axis(d2, nc, axis=1), axis=1,
+                           kind="stable")
+        near[lo:hi] = np.take_along_axis(nc, order, axis=1)  # nearest 1st
+        cmin = np.argmin(d2, axis=0)
+        cd2 = d2[cmin, np.arange(num_cameras)]
+        better = cd2 < nearest_pt_d2
+        nearest_pt_d2[better] = cd2[better]
+        nearest_pt[better] = cmin[better] + lo
+    keep = np.ones((num_points, kc), dtype=bool)
+    if kc > kf:
+        keep[n_hi:, kf:] = False
+    cam_idx = near[keep]
+    pt_idx = np.broadcast_to(
+        np.arange(num_points)[:, None], (num_points, kc))[keep]
+    # Guarantee every camera appears: attach a missing camera to its
+    # NEAREST point (not a random one — a long-range edge would puncture
+    # the banded structure this mode exists to produce).
+    missing = np.setdiff1d(np.arange(num_cameras), cam_idx,
+                           assume_unique=False)
+    if missing.size:
+        cam_idx = np.concatenate([cam_idx, missing])
+        pt_idx = np.concatenate([pt_idx, nearest_pt[missing]])
+    return cam_idx, pt_idx
+
+
 def make_synthetic_bal(
     num_cameras: int = 4,
     num_points: int = 24,
@@ -82,6 +149,7 @@ def make_synthetic_bal(
     n_orphan_points: int = 0,
     n_behind_camera: int = 0,
     n_disconnect: int = 0,
+    locality: Optional[str] = None,
 ) -> SyntheticBAL:
     """Build a well-posed synthetic scene.
 
@@ -116,7 +184,26 @@ def make_synthetic_bal(
       cameras observing `4 * n_disconnect` extra points that no main
       camera sees (gauge-deficient second component).  With
       n_disconnect = 1 the island's points are additionally deg-1.
+
+    Locality modes (`locality="ring"` / `"grid"`; same strictly-after-
+    the-base-draws contract as the degeneracy knobs, so `locality=None`
+    reproduces the historical scene byte-for-byte): the base generator
+    assigns each point's cameras as `(base + j*stride) mod Nc` — an
+    EXPANDER camera graph with no cluster structure, which real BAL
+    scenes (street-level ladybug rigs, photo-tourism venice) do not
+    have.  A locality mode instead stations cameras on a spatial
+    layout (a closed ring of arc anchors, or a ceil(sqrt(Nc))-wide
+    grid), scatters points NEAR the camera track, and gives every
+    point WINDOWED visibility: its `obs_per_point` nearest cameras.
+    Camera co-observation is then banded/blocked — cameras share
+    points only with spatial neighbours — producing exactly the
+    cluster-constant slow modes the camera-graph coarse-space
+    preconditioners (solver/precond.py TWO_LEVEL / MULTILEVEL) exist
+    to remove.  The degeneracy knobs compose on top unchanged.
     """
+    if locality not in LOCALITY_MODES:
+        raise ValueError(
+            f"locality must be one of {LOCALITY_MODES}, got {locality!r}")
     for name, v in (("n_orphan_points", n_orphan_points),
                     ("n_behind_camera", n_behind_camera),
                     ("n_disconnect", n_disconnect)):
@@ -156,6 +243,38 @@ def make_synthetic_bal(
         cam_idx = np.concatenate([cam_idx, missing])
         pt_idx = np.concatenate(
             [pt_idx, r.integers(0, num_points, size=missing.size)])
+    if locality is not None:
+        # Locality mode: REPLACE the expander observation assignment
+        # with a spatial camera layout + windowed visibility.  The base
+        # scene's draws above are kept (and burned) so these draws sit
+        # strictly after them — locality=None stays byte-identical, and
+        # every locality scene is deterministic in (seed, knobs).
+        if locality == "ring":
+            # Camera anchors on a closed ring; points scattered in an
+            # annulus around the camera track (street-scene shape).
+            phi = 2.0 * np.pi * np.arange(num_cameras) / num_cameras
+            ring_r = 3.0
+            anchors = ring_r * np.stack([np.cos(phi), np.sin(phi)], axis=1)
+            psi = r.uniform(0.0, 2.0 * np.pi, size=num_points)
+            rad = ring_r + r.uniform(-0.8, 0.8, size=num_points)
+            pts_xy = np.stack([rad * np.cos(psi), rad * np.sin(psi)], axis=1)
+        else:  # grid (aerial-survey shape)
+            g = int(np.ceil(np.sqrt(num_cameras)))
+            extent = 6.0
+            ii = np.arange(num_cameras)
+            anchors = ((np.stack([ii % g, ii // g], axis=1) + 0.5)
+                       * (extent / g) - extent / 2.0)
+            pts_xy = r.uniform(-extent / 2.0, extent / 2.0,
+                               size=(num_points, 2))
+        points_gt = np.concatenate(
+            [pts_xy, r.uniform(-0.5, 0.5, size=(num_points, 1))], axis=1)
+        # Cameras keep their base-drawn tilt/intrinsics/z offset; only
+        # the xy translation is re-anchored over the layout (t ~ -center
+        # under the small drawn tilts), so each camera looks down at its
+        # own neighbourhood of the track.
+        cameras_gt = cameras_gt.copy()
+        cameras_gt[:, 3:5] -= anchors
+        cam_idx, pt_idx = _locality_assign(anchors, pts_xy, kf, kc, n_hi)
     # Chunk the projection: at BAL-Final scale (~29M edges) one shot would
     # materialise ~10 float64 [nE,3] temporaries (~7 GB host RAM).
     n_edge_total = cam_idx.shape[0]

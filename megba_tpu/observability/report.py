@@ -125,19 +125,39 @@ class SolveReport:
         return cls(**{k: v for k, v in d.items() if k in known})
 
 
-def _decode_fallback_totals(trace, iterations: int) -> Optional[Dict[str, int]]:
+def _decode_fallback_totals(trace, iterations: int) -> Optional[Dict[str, Any]]:
     """Sum the enum-coded per-iteration precond_fallback codes into
-    per-level totals ({'block': n, 'coarse': n}); None without a trace."""
+    per-level totals; None without a trace.
+
+    'block' = total SCHUR_DIAG camera blocks fallen back to Hpp;
+    'coarse' = iterations where ANY hierarchy coarse level degraded
+    (for two-level traces this is exactly the historical 0/1-per-iter
+    sum); 'coarse_levels' (present only when a coarse degrade
+    occurred) = per-hierarchy-level iteration counts, index l-1 =
+    coarse level l — the multilevel bit-field, unpacked."""
     if trace is None or getattr(trace, "precond_fallback", None) is None:
         return None
-    from megba_tpu.solver.precond import decode_precond_fallback
+    from megba_tpu.solver.precond import (
+        decode_precond_fallback,
+        decode_precond_fallback_levels,
+    )
 
     block = coarse = 0
+    per_level: list = []
     for code in np.asarray(trace.precond_fallback)[:iterations]:
-        level = decode_precond_fallback(int(code))
-        block += level["block"]
-        coarse += level["coarse"]
-    return {"block": int(block), "coarse": int(coarse)}
+        code = int(code)
+        block += decode_precond_fallback(code)["block"]
+        levels = decode_precond_fallback_levels(code)
+        if any(levels):
+            coarse += 1
+        for i, flag in enumerate(levels):
+            while len(per_level) <= i:
+                per_level.append(0)
+            per_level[i] += int(flag)
+    out: Dict[str, Any] = {"block": int(block), "coarse": int(coarse)}
+    if any(per_level):
+        out["coarse_levels"] = per_level
+    return out
 
 
 def build_report(option, result, phases: Dict[str, Any],

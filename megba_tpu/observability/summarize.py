@@ -67,6 +67,18 @@ def format_report(rep: SolveReport, index: int = 0) -> str:
         f"{r.get('final_cost', float('nan')):.6e} in "
         f"{r.get('iterations')} LM iters ({r.get('accepted')} accepted, "
         f"{r.get('pcg_iterations')} PCG), stopped={r.get('stopped')}")
+    fb = r.get("precond_fallback") or {}
+    if fb.get("block") or fb.get("coarse"):
+        # Per-level preconditioner fallback totals (solver/precond.py
+        # enum codes, decoded at report build): block = SCHUR_DIAG
+        # blocks fallen back to Hpp, coarse = iterations with a
+        # degraded hierarchy level, per-level counts when multilevel.
+        per = "".join(
+            f" L{i + 1}:{n}" for i, n in
+            enumerate(fb.get("coarse_levels") or []) if n)
+        lines.append(
+            f"   precond fallback: {fb.get('block', 0)} block / "
+            f"{fb.get('coarse', 0)} coarse iters{per}")
 
     if rep.trace and rep.trace.get("cost"):
         t = rep.trace

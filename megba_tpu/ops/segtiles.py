@@ -1385,26 +1385,195 @@ def cached_cluster_plan(
     target: int = 0,
     mask: Optional[np.ndarray] = None,
     world_size: int = 1,
+    smooth_omega: float = 0.0,
 ):
     """`build_cluster_plan` behind the host plan cache.
 
     Returns ((ClusterPlan, DeviceClusterPlan), cache_hit) — keyed by a
-    blake2b content fingerprint of the index arrays + mask + target +
-    world_size, exactly like the tile plans, so repeated solves of one
-    problem (bench reruns, chunked drivers, the auditor's canonical
-    lowerings) build the cluster graph once."""
+    blake2b content fingerprint of the index arrays + mask + EVERY
+    aggregation parameter (target, world_size, smoothing omega),
+    exactly like the tile plans, so repeated solves of one problem
+    (bench reruns, chunked drivers, the auditor's canonical lowerings)
+    build the cluster graph once.  `smooth_omega` does not change the
+    plan CONTENT today (smoothing is a device-side build step over the
+    planned indices), but it is part of the key by contract: a
+    SolverOption knob flip must never be able to serve a stale plan
+    from the LRU, including under future plans that do consume it."""
     key = ("cluster", _array_digest(np.asarray(cam_idx)),
            _array_digest(np.asarray(pt_idx)),
            (None if mask is None
             else _array_digest(np.asarray(mask) > 0)),
            int(num_cameras), int(num_points), int(target),
-           int(world_size))
+           int(world_size), float(smooth_omega))
     hit = _plan_cache_get(key)
     if hit is not None:
         return hit, True
     plan = build_cluster_plan(cam_idx, pt_idx, num_cameras, num_points,
                               target, mask, world_size=world_size)
     value = (plan, device_cluster_plan(plan))
+    _plan_cache_put(key, value)
+    return value, False
+
+
+# ---------------------------------------------------------------------------
+# Recursive camera-graph hierarchy (MULTILEVEL Schur preconditioner)
+# ---------------------------------------------------------------------------
+#
+# The L-level preconditioner (solver/precond.py) re-aggregates the
+# level-1 cluster graph recursively: level l+1's "cameras" are level
+# l's clusters, and the co-observation weights between them are exactly
+# the camera co-observation weights with cameras relabelled by their
+# cluster — so every level reuses build_camera_clusters over the SAME
+# edge stream with relabelled camera ids.  All of it is host graph
+# work, planned once and cached; on device the extra levels are just
+# tiny replicated [C_l] assignment gathers (dense Galerkin contractions
+# in solver/precond.py), so the hierarchy adds no per-edge state and no
+# collectives anywhere.
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiLevelPlan:
+    """Host half of the recursive camera-cluster hierarchy.
+
+    `base` is the level-1 plan (cameras -> C_1 clusters, with the
+    pc/ec streams the device Galerkin build consumes);
+    `level_sizes[i]` is the cluster count of coarse level i+1
+    (level_sizes[0] == base.num_clusters), and `assign[i]` maps level
+    i+1's blocks onto level i+2's clusters ([level_sizes[i]] int32).
+    Total hierarchy depth = 1 (fine) + len(level_sizes)."""
+
+    base: ClusterPlan
+    level_sizes: Tuple[int, ...]
+    assign: Tuple[np.ndarray, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceMultiLevelPlan:
+    """Device half: the level-1 DeviceClusterPlan + per-level
+    assignment arrays, registered as a pytree so the whole hierarchy
+    rides jit/shard_map as ONE operand (like DualPlans)."""
+
+    base: DeviceClusterPlan
+    level_sizes: Tuple[int, ...]
+    assign: Tuple[jax.Array, ...]
+
+
+jax.tree_util.register_dataclass(
+    DeviceMultiLevelPlan,
+    data_fields=["base", "assign"],
+    meta_fields=["level_sizes"],
+)
+
+
+def device_multilevel_plan(plan: MultiLevelPlan) -> DeviceMultiLevelPlan:
+    return DeviceMultiLevelPlan(
+        base=device_cluster_plan(plan.base),
+        level_sizes=plan.level_sizes,
+        assign=tuple(jnp.asarray(a) for a in plan.assign),
+    )
+
+
+def multilevel_partition_specs(mplan: DeviceMultiLevelPlan):
+    """shard_map in_specs tree for a DeviceMultiLevelPlan operand: the
+    level-1 plan follows `cluster_partition_specs`; the coarse
+    assignment tables ride replicated (every level >= 2 is identical
+    tiny dense work per shard)."""
+    from jax.sharding import PartitionSpec as P
+
+    return DeviceMultiLevelPlan(
+        base=cluster_partition_specs(mplan.base),
+        level_sizes=mplan.level_sizes,
+        assign=tuple(P() for _ in mplan.assign),
+    )
+
+
+def coarse_plan_partition_specs(plan):
+    """Partition specs for either coarse-space plan operand kind."""
+    if isinstance(plan, DeviceMultiLevelPlan):
+        return multilevel_partition_specs(plan)
+    return cluster_partition_specs(plan)
+
+
+def build_multilevel_plan(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    target: int = 0,
+    mask: Optional[np.ndarray] = None,
+    world_size: int = 1,
+    coarsen_factor: float = 4.0,
+    max_levels: int = 3,
+) -> MultiLevelPlan:
+    """Plan the recursive hierarchy over one (padded) edge stream.
+
+    Level 1 is `build_cluster_plan` (same contract); each further level
+    aggregates the previous level's cluster graph toward
+    `ceil(C / coarsen_factor)` clusters, stopping at `max_levels` total
+    levels (fine included), when the graph stops shrinking, or when the
+    coarsest space is already trivial (<= 2 blocks — a dense solve of 2
+    blocks is cheaper than another level's bookkeeping)."""
+    if not coarsen_factor > 1.0:
+        raise ValueError(
+            f"coarsen_factor must be > 1, got {coarsen_factor}")
+    if max_levels < 2:
+        raise ValueError(f"max_levels must be >= 2, got {max_levels}")
+    base = build_cluster_plan(cam_idx, pt_idx, num_cameras, num_points,
+                              target, mask, world_size=world_size)
+    sizes = [base.num_clusters]
+    assign: list = []
+    edge_cl = base.cluster[np.asarray(cam_idx, np.int64)]
+    while len(sizes) + 1 < max_levels and sizes[-1] > 2:
+        cur = sizes[-1]
+        tgt = max(1, int(np.ceil(cur / coarsen_factor)))
+        if tgt >= cur:
+            break
+        nxt = build_camera_clusters(edge_cl, pt_idx, cur, tgt, mask)
+        C = int(nxt.max()) + 1
+        if C >= cur:
+            break  # aggregation found nothing to merge
+        assign.append(nxt.astype(np.int32))
+        sizes.append(C)
+        edge_cl = nxt[edge_cl]
+    return MultiLevelPlan(base=base, level_sizes=tuple(sizes),
+                          assign=tuple(assign))
+
+
+def cached_multilevel_plan(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    target: int = 0,
+    mask: Optional[np.ndarray] = None,
+    world_size: int = 1,
+    coarsen_factor: float = 4.0,
+    max_levels: int = 3,
+    smooth_omega: float = 0.0,
+):
+    """`build_multilevel_plan` behind the host plan cache.
+
+    Returns ((MultiLevelPlan, DeviceMultiLevelPlan), cache_hit).  The
+    fingerprint includes EVERY aggregation parameter — target,
+    world_size, coarsen_factor, max_levels AND the smoothing omega —
+    so flipping any SolverOption preconditioner knob can never serve a
+    stale hierarchy from the LRU (the coarse level count and cluster
+    shapes are baked into the compiled program's operand shapes)."""
+    key = ("multilevel", _array_digest(np.asarray(cam_idx)),
+           _array_digest(np.asarray(pt_idx)),
+           (None if mask is None
+            else _array_digest(np.asarray(mask) > 0)),
+           int(num_cameras), int(num_points), int(target),
+           int(world_size), float(coarsen_factor), int(max_levels),
+           float(smooth_omega))
+    hit = _plan_cache_get(key)
+    if hit is not None:
+        return hit, True
+    plan = build_multilevel_plan(
+        cam_idx, pt_idx, num_cameras, num_points, target, mask,
+        world_size=world_size, coarsen_factor=coarsen_factor,
+        max_levels=max_levels)
+    value = (plan, device_multilevel_plan(plan))
     _plan_cache_put(key, value)
     return value, False
 

@@ -249,14 +249,15 @@ def distributed_lm_solve(
 
         optional.append(("fault_plan", fault_plan, fault_partition_specs()))
     if cluster_plan is not None:
-        # Two-level preconditioner coarse-space plan (ops/segtiles.py):
+        # Coarse-space plan (ops/segtiles.py; two-level OR multilevel):
         # the per-edge pc_slot stream follows the edge shards, the
-        # cluster/incidence/pair tables ride replicated (the coarse
-        # assembly after the V psum is identical tiny work per shard).
-        from megba_tpu.ops.segtiles import cluster_partition_specs
+        # cluster/incidence/pair/assignment tables ride replicated (the
+        # coarse assembly after the V psum — and every dense hierarchy
+        # level above it — is identical tiny work per shard).
+        from megba_tpu.ops.segtiles import coarse_plan_partition_specs
 
         optional.append(("cluster_plan", cluster_plan,
-                         cluster_partition_specs(cluster_plan)))
+                         coarse_plan_partition_specs(cluster_plan)))
     keys = tuple(k for k, v, _ in optional if v is not None)
     args += [v for _, v, _ in optional if v is not None]
     in_specs += [spec for _, v, spec in optional if v is not None]

@@ -393,7 +393,28 @@ def flat_solve(
                 np.asarray(cam_idx), np.asarray(pt_idx),
                 int(cameras.shape[0]), int(points.shape[0]),
                 option.solver_option.coarse_clusters,
-                mask=np.asarray(mask), world_size=ws)
+                mask=np.asarray(mask), world_size=ws,
+                smooth_omega=option.solver_option.smooth_omega)
+            if cl_hit:
+                timer.count_event("cluster_plan_cache_hit")
+    elif (option.use_schur
+          and option.solver_option.precond == PrecondKind.MULTILEVEL):
+        # Recursive hierarchy: same contract as the two-level plan (one
+        # host plan over the final padded edge stream, cached), plus the
+        # per-level aggregation chain; EVERY aggregation knob is in the
+        # cache fingerprint so a SolverOption flip can never serve a
+        # stale hierarchy.
+        from megba_tpu.ops.segtiles import cached_multilevel_plan
+
+        with timer.phase("plan"):
+            (_, cluster_plan_j), cl_hit = cached_multilevel_plan(
+                np.asarray(cam_idx), np.asarray(pt_idx),
+                int(cameras.shape[0]), int(points.shape[0]),
+                option.solver_option.coarse_clusters,
+                mask=np.asarray(mask), world_size=ws,
+                coarsen_factor=option.solver_option.coarsen_factor,
+                max_levels=option.solver_option.max_levels,
+                smooth_omega=option.solver_option.smooth_omega)
             if cl_hit:
                 timer.count_event("cluster_plan_cache_hit")
 
@@ -531,6 +552,12 @@ def _maybe_emit_report(telemetry, option, result, timer, problem,
             timer.count_event("precond_fallback", level["block"])
         if level.get("coarse"):
             timer.count_event("precond_fallback_coarse", level["coarse"])
+        # Multilevel hierarchies: one event per DEGRADED coarse level
+        # (bit l-1 of the code's high half), so a mid-hierarchy
+        # truncation is visible as its own telemetry stream.
+        for li, n in enumerate(level.get("coarse_levels") or []):
+            if n:
+                timer.count_event(f"precond_fallback_coarse_l{li + 1}", n)
         recov = getattr(result, "recoveries", None)
         if recov is not None and int(recov):
             timer.count_event("fault_recovery", int(recov))

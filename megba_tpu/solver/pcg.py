@@ -501,6 +501,7 @@ def plain_pcg_solve(
     neumann_order: int = 2,
     cluster_plan=None,
     cam_fixed=None,
+    smooth_omega: float = 0.0,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -589,6 +590,7 @@ def schur_pcg_solve(
     neumann_order: int = 2,
     cluster_plan=None,
     cam_fixed=None,
+    smooth_omega: float = 0.0,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -607,16 +609,21 @@ def schur_pcg_solve(
     `precond` selects the preconditioner operator family
     (solver/precond.py): JACOBI (the block diagonal picked by
     `preconditioner`, bitwise the historical solver), NEUMANN
-    (`neumann_order` extra S applications per apply), or TWO_LEVEL
+    (`neumann_order` extra S applications per apply), TWO_LEVEL
     (needs the host-planned `cluster_plan` operand —
     ops/segtiles.cached_cluster_plan; `cam_fixed` keeps the coarse
-    correction off pinned cameras).
+    correction off pinned cameras), or MULTILEVEL (the recursive
+    L-level hierarchy; `cluster_plan` is then a DeviceMultiLevelPlan —
+    ops/segtiles.cached_multilevel_plan).  `smooth_omega` > 0 smooths
+    the level-1 prolongator (smoothed aggregation) for both
+    coarse-space kinds.
     """
     # Retrace sentinel hook (analysis/retrace.py): counts only under an
     # active jax trace — eager calls are not compilations.
     note_trace("solver.schur_pcg", system.g_cam, system.g_pt, Jc, Jp,
                static=static_key(compute_kind, axis_name, mixed_precision,
-                                 preconditioner, precond, neumann_order))
+                                 preconditioner, precond, neumann_order,
+                                 smooth_omega))
     num_cameras = system.Hpp.shape[0]
     num_points = system.Hll.shape[1]
     pd = int(round(system.Hll.shape[0] ** 0.5))
@@ -696,7 +703,7 @@ def schur_pcg_solve(
         cam_idx, pt_idx, num_cameras, compute_kind, axis_name,
         cam_sorted, neumann_order=neumann_order, plans=plans,
         cluster_plan=cluster_plan, cam_fixed=cam_fixed,
-        s_matvec=s_matvec)
+        s_matvec=s_matvec, smooth_omega=smooth_omega)
 
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
     v = g_cam - hpl(block_matvec_fm(Hll_inv, g_pt))

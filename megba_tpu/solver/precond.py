@@ -64,13 +64,35 @@ TWO_LEVEL — a BA-shaped two-level (multigrid-flavoured) scheme:
   M⁻¹ is SPD: both terms are PSD and their kernels are disjoint
   (P r = r on ker(R), where D⁻¹ is PD).
 
+MULTILEVEL — TWO_LEVEL generalized to a recursive L-level hierarchy:
+  the level-1 coarse space is the same co-observation aggregation, and
+  every coarser level re-aggregates the previous level's cluster graph
+  (host-planned once — ops/segtiles.build_multilevel_plan).  Level 1's
+  Galerkin operator/coupling are assembled exactly as TWO_LEVEL's;
+  every deeper level's A_{l+1} = R_l A_l R_lᵀ is a tiny replicated
+  dense contraction.  The coarse solve is a recursive SYMMETRIC V(1,1)
+  cycle (damped block-Jacobi pre-smooth, coarse correction on the true
+  residual, post-smooth; smoother weight 1/λmax(D⁻¹A) by power
+  iteration so the cycle is SPD on any spectrum), with the dense
+  filtered pseudo-inverse ONLY at the coarsest level.  Zero in-body
+  collectives, pinned by `ba_multilevel_w2_f32`.
+
+Both coarse-space kinds accept SMOOTHED-AGGREGATION prolongators
+(`smooth_omega` > 0): Π = Rᵀ − ω D⁻¹ S_d Rᵀ — the expander-robust
+variant.  The already-materialised G₀ = S_d Rᵀ makes the smoothing
+correction Y = D⁻¹G₀ one blockwise product; the exact smoothed
+Galerkin costs one extra column-blocked S_d·Y pass per build
+(_smooth_correction), still outside the PCG while body.
+
 Fallback ladder (extends PR 5's Cholesky-NaN semantics one level up):
-a non-finite coarse spectrum degrades TWO_LEVEL to plain block-Jacobi
-(the cycle becomes EXACTLY the base apply), and — independently, per
-camera block — an indefinite SCHUR_DIAG block falls back to the Hpp
-preconditioner.  Both levels are COUNTED, not silent:
-`PCGResult.precond_fallback` carries an enum-coded per-level count
-(encode/decode below) into `SolveTrace`/`SolveReport`.
+a non-finite coarse operator TRUNCATES the cycle at its level —
+level 1 degrades to plain block-Jacobi (the cycle becomes EXACTLY the
+base apply), a deeper level only drops the sub-hierarchy below it —
+and, independently, per camera block, an indefinite SCHUR_DIAG block
+falls back to the Hpp preconditioner.  Every level is COUNTED, not
+silent: `PCGResult.precond_fallback` carries an enum-coded int32
+(low 16 bits block count, high bits a per-level bit-field —
+encode/decode below) into `SolveTrace`/`SolveReport`.
 
 Measured (venice-10% synthetic bench, CPU lane, inexact-LM config):
 NEUMANN k=1 cuts total PCG iterations 40% (70 -> 42) at 9e-8 relative
@@ -125,28 +147,56 @@ _COARSE_EIG_FLOOR = 1e-5
 # --------------------------------------------------------------------------
 #
 # `precond_fallback` is ONE int32 so the trace layout is unchanged; the
-# two ladder levels ride fixed radixes:
+# ladder levels ride fixed radixes:
 #   low  16 bits — BLOCK level: camera blocks whose SCHUR_DIAG Cholesky
 #                  went NaN and fell back to the Hpp preconditioner;
-#   high bits    — COARSE level: 1 when the two-level coarse factor was
-#                  non-finite and the apply degraded to block-Jacobi.
+#   high bits    — COARSE levels, a BIT-FIELD: bit (16 + l - 1) set
+#                  when hierarchy coarse level l (1-based; TWO_LEVEL
+#                  has exactly level 1) was non-finite and the cycle
+#                  truncated there.  TWO_LEVEL's historical encoding —
+#                  high half 0/1 — is exactly the 1-coarse-level case
+#                  of this scheme, so old traces decode unchanged.
 
 FALLBACK_BLOCK_RADIX = 1 << 16
+# int32 sign bit keeps the bit-field at <= 15 coarse levels
+# (common.validate_options caps SolverOption.max_levels accordingly).
+FALLBACK_MAX_COARSE_LEVELS = 15
 
 
-def encode_precond_fallback(block_count, coarse_count=0):
-    """Pack per-level fallback counts into one int32 trace code."""
+def encode_precond_fallback(block_count, coarse_bits=0):
+    """Pack the block count + coarse-level bit-field into one int32.
+
+    `coarse_bits` is the per-level bit-field (bit l-1 = coarse level l
+    degraded); for a two-level scheme it is simply 0/1."""
     block = jnp.minimum(jnp.asarray(block_count, jnp.int32),
                         FALLBACK_BLOCK_RADIX - 1)
-    return (jnp.asarray(coarse_count, jnp.int32)
+    return (jnp.asarray(coarse_bits, jnp.int32)
             * FALLBACK_BLOCK_RADIX + block)
 
 
 def decode_precond_fallback(code) -> dict:
-    """Unpack a trace code into {'block': n, 'coarse': n} (host ints)."""
+    """Unpack a trace code into {'block': n, 'coarse': bits} (host ints).
+
+    `coarse` is the raw per-level bit-field; for two-level traces it is
+    0/1 (the historical meaning, unchanged).  Use
+    `decode_precond_fallback_levels` for the per-level view."""
     c = int(code)
     return {"block": c % FALLBACK_BLOCK_RADIX,
             "coarse": c // FALLBACK_BLOCK_RADIX}
+
+
+def decode_precond_fallback_levels(code) -> list:
+    """Per-coarse-level degrade flags [level 1, level 2, ...] of one
+    trace code — trailing healthy levels are trimmed, so a two-level
+    code decodes to [] (healthy) or [True]."""
+    bits = int(code) // FALLBACK_BLOCK_RADIX
+    out = []
+    level = 0
+    while bits and level < FALLBACK_MAX_COARSE_LEVELS:
+        out.append(bool(bits & 1))
+        bits >>= 1
+        level += 1
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -256,7 +306,92 @@ class TwoLevelCoarse:
     ok: jax.Array  # traced bool: coarse factor finite
     restrict_sel: jax.Array  # [C, Nc]
     cluster: jax.Array  # [Nc] int32
-    G: jax.Array  # [cd, Nc, C, cd] = S_d Rᵀ
+    G: jax.Array  # [cd, Nc, C, cd] = S_d Π (Π = prolongator; plain Rᵀ)
+    # Smoothed aggregation (smooth_omega > 0): the prolongator becomes
+    # Π = Rᵀ − ω Y with Y = D⁻¹ S_d Rᵀ the damped-Jacobi smoothing
+    # correction ([cd, Nc, C, cd], fine dof by coarse dof); G and
+    # coarse_matrix above are then the SMOOTHED coupling S_d Π and
+    # Galerkin Πᵀ S_d Π.  omega == 0 leaves Y None and every field
+    # bitwise the PR 7 plain-aggregation state.
+    omega: float = 0.0
+    Y: Optional[jax.Array] = None
+
+
+def _smooth_correction(
+    Hpp_d, Hll_inv, rows_of, cam_idx, pt_idx, Y, axis_name,
+):
+    """Z = S_d · Y for the prolongator-correction block columns.
+
+    `Y` [cd, Nc, C, cd] spans the smoothed prolongator's correction
+    range; the smoothed Galerkin/coupling need S_d applied to every
+    one of its C·cd columns.  Hpp_d·Y is blockwise; the coupling half
+    −Hpl Hll⁻¹ Hlp Y runs the two edge-scale passes chunked over BOTH
+    edges (bounded per-chunk rows, like every other build) and coarse
+    columns (the [pd, Np, mc] incidence transient is the big one — mc
+    is capped so it stays ~128 MB f32 at venice scale).  Sharded: one
+    psum per column block for the point-incidence sums + one final
+    psum for the camera rows — all once per PCG solve, OUTSIDE the PCG
+    while body, the collective kind the solver already emits.
+    """
+    cd = Hpp_d.shape[-1]
+    pd = int(round(Hll_inv.shape[0] ** 0.5))
+    num_cameras = Hpp_d.shape[0]
+    Np = Hll_inv.shape[1]
+    dtype = Hpp_d.dtype
+    nE = cam_idx.shape[0]
+    C = Y.shape[2]
+    m = C * cd
+    Ym = Y.reshape(cd, num_cameras, m)
+    hinv = Hll_inv.reshape(pd, pd, Np)
+    mc_cap = max(cd, int(32_000_000 // max(pd * Np, 1)))
+    edge_target = max(4096, _PAIR_CHUNK // max(1, min(m, mc_cap) // cd))
+    z_cols = []
+    for m0 in range(0, m, mc_cap):
+        m1 = min(m0 + mc_cap, m)
+        mc = m1 - m0
+        Yc = jax.lax.slice_in_dim(Ym, m0, m1, axis=2)  # [cd, Nc, mc]
+
+        def ubody(start, size, accs):
+            (u_a,) = accs
+            ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
+            pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
+            w = rows_of(start, size)  # [cd*pd, size]
+            yg = jnp.take(Yc, ci, axis=1, mode="clip")  # [cd, size, mc]
+            rows = jnp.stack([
+                sum(w[a * pd + q][:, None] * yg[a] for a in range(cd))
+                for q in range(pd)
+            ])  # [pd, size, mc] = W_eᵀ Y[cam(e)]
+            return (u_a.at[:, pi, :].add(rows, mode="drop"),)
+
+        (U,) = chunked_edge_reduce(
+            nE, (jnp.zeros((pd, Np, mc), dtype),), ubody,
+            target=edge_target)
+        if axis_name is not None:
+            U = jax.lax.psum(U, axis_name)
+        T = jnp.einsum("qsp,spm->qpm", hinv, U,
+                       precision=HI)  # Hll⁻¹ · (Hlp Y)
+
+        def zbody(start, size, accs):
+            (z_a,) = accs
+            ci = jax.lax.dynamic_slice_in_dim(cam_idx, start, size)
+            pi = jax.lax.dynamic_slice_in_dim(pt_idx, start, size)
+            w = rows_of(start, size)
+            tg = jnp.take(T, pi, axis=1, mode="clip")  # [pd, size, mc]
+            rows = jnp.stack([
+                sum(w[a * pd + q][:, None] * tg[q] for q in range(pd))
+                for a in range(cd)
+            ])  # [cd, size, mc] = W_e · (Hll⁻¹ Hlp Y)[pt(e)]
+            return (z_a.at[:, ci, :].add(rows, mode="drop"),)
+
+        (Zb,) = chunked_edge_reduce(
+            nE, (jnp.zeros((cd, num_cameras, mc), dtype),), zbody,
+            target=edge_target)
+        z_cols.append(Zb)
+    Zcoup = jnp.concatenate(z_cols, axis=2) if len(z_cols) > 1 else z_cols[0]
+    if axis_name is not None:
+        Zcoup = jax.lax.psum(Zcoup, axis_name)
+    Z1 = jnp.einsum("nac,cnJb->anJb", Hpp_d, Y, precision=HI)
+    return Z1 - Zcoup.reshape(cd, num_cameras, C, cd)
 
 
 @jax.named_scope("megba.precond_coarse_build")
@@ -271,17 +406,36 @@ def build_two_level_coarse(
     axis_name: Optional[str] = None,
     cam_fixed: Optional[jax.Array] = None,
     plans=None,
+    smooth_omega: float = 0.0,
+    Minv: Optional[jax.Array] = None,
+    cam_idx: Optional[jax.Array] = None,
+    pt_idx: Optional[jax.Array] = None,
+    factor: bool = True,
 ) -> TwoLevelCoarse:
-    """Assemble + factor G = S_d Rᵀ and A_c = R G = R S_d Rᵀ.
+    """Assemble + factor G = S_d Π and A_c = Πᵀ S_d Π.
+
+    Π is the prolongator: the piecewise-constant aggregation Rᵀ when
+    `smooth_omega == 0` (the PR 7 operator, bitwise), or the
+    SMOOTHED-AGGREGATION Π = Rᵀ − ω D⁻¹ S_d Rᵀ = Rᵀ − ω Y otherwise —
+    the already-materialised plain coupling G₀ = S_d Rᵀ makes Y one
+    blockwise product, and the exact smoothed Galerkin/coupling
+        G = S_d Π = G₀ − ω Z,      A_c = Πᵀ G = R G − ω Yᵀ G,
+    cost one extra column-blocked S_d·Y pass (`_smooth_correction`)
+    per build.  `Minv` is the smoothing block diagonal D⁻¹ (defaults
+    to block_inv(Hpp_d)); `cam_idx`/`pt_idx` (this call's edge streams)
+    are required only when smoothing.
 
     Pure gathers/scatter-adds over the host-planned index arrays
-    (ops/segtiles.ClusterPlan) + one small dense Cholesky; when the
+    (ops/segtiles.ClusterPlan) + one small dense eigen-factor; when the
     edge axis is sharded the per-shard V rows are psum-combined BEFORE
     the ec-pair contraction (cross-shard edges of one point are why —
     W_e Hll⁻¹ (ΣV)ᵀ needs the globally-summed V) and the per-shard G
     contributions are psum-combined after it.  Two all-reduces per
-    BUILD (once per PCG solve), both outside the PCG while body, both
-    the collective kind the solver already emits.
+    BUILD (once per PCG solve) unsmoothed — plus two per smoothing
+    column block — ALL outside the PCG while body, all the collective
+    kind the solver already emits.  `factor=False` skips the coarse
+    eigendecomposition (the MULTILEVEL hierarchy factors only its
+    coarsest level); `ok` then reports finiteness of A_c alone.
     """
     cd = Hpp_d.shape[-1]
     pd = int(round(Hll_inv.shape[0] ** 0.5))
@@ -360,10 +514,41 @@ def build_two_level_coarse(
     fine = jnp.einsum("nab,Jn->anJb", Hpp_d, sel, precision=HI)
     G = fine - corrg  # [cd, Nc, C, cd] = S_d Rᵀ
 
-    # A_c = R G (Galerkin): tiny replicated contraction.
-    A = jnp.einsum("In,anJb->IaJb", sel, G,
-                   precision=HI).reshape(C * cd, C * cd)
+    Y = None
+    if smooth_omega:
+        if cam_idx is None or pt_idx is None:
+            raise ValueError(
+                "smooth_omega > 0 needs this call's cam_idx/pt_idx edge "
+                "streams so S_d can be applied to the smoothing "
+                "correction (make_schur_preconditioner passes them)")
+        if Minv is None:
+            Minv = block_inv(Hpp_d)
+        om = jnp.asarray(smooth_omega, dtype)
+        # Y = D⁻¹ G₀: the damped-Jacobi smoothing correction.  Its S_d
+        # image Z gives the EXACT smoothed coupling and Galerkin:
+        #   G = S_d Π = G₀ − ω Z,   A_c = Πᵀ G = R G − ω Yᵀ G.
+        Y = jnp.einsum("nac,cnJb->anJb", Minv, G, precision=HI)
+        Z = _smooth_correction(Hpp_d, Hll_inv, rows_of, cam_idx, pt_idx,
+                               Y, axis_name)
+        G = G - om * Z
+        A = (jnp.einsum("In,anJb->IaJb", sel, G, precision=HI)
+             - om * jnp.einsum("anIc,anJb->IcJb", Y, G, precision=HI)
+             ).reshape(C * cd, C * cd)
+    else:
+        # A_c = R G (Galerkin): tiny replicated contraction.
+        A = jnp.einsum("In,anJb->IaJb", sel, G,
+                       precision=HI).reshape(C * cd, C * cd)
     A = 0.5 * (A + A.T)  # symmetrise away accumulation-order roundoff
+    if not factor:
+        # MULTILEVEL consumes A_c as a mid-hierarchy operator — only
+        # the coarsest level is factored; `ok` reports assembly health.
+        zq = jnp.zeros_like(A)
+        return TwoLevelCoarse(
+            coarse_matrix=A, eig_q=zq,
+            eig_inv=jnp.zeros(A.shape[0], A.dtype),
+            ok=jnp.all(jnp.isfinite(A)), restrict_sel=sel,
+            cluster=cluster_plan.cluster, G=G,
+            omega=smooth_omega, Y=Y)
     # Filtered pseudo-inverse instead of a Cholesky: all-fixed /
     # edge-less clusters (exactly-zero rows) and gauge-like near-null
     # modes both land UNDER the eigenvalue floor and simply receive no
@@ -372,21 +557,67 @@ def build_two_level_coarse(
     (Q, inv), ok = dense_filtered_factor(A, _COARSE_EIG_FLOOR)
     return TwoLevelCoarse(coarse_matrix=A, eig_q=Q, eig_inv=inv, ok=ok,
                           restrict_sel=sel, cluster=cluster_plan.cluster,
-                          G=G)
+                          G=G, omega=smooth_omega, Y=Y)
 
 
-def _coarse_solve_inject(coarse: TwoLevelCoarse, rc: jax.Array):
-    """A_c⁺ on a [C, cd] coarse residual, plus its Rᵀ injection.
+def _restrict(coarse: TwoLevelCoarse, r: jax.Array) -> jax.Array:
+    """Πᵀ r: [cd, Nc] fine rows -> [C, cd] coarse residual (Π = Rᵀ
+    plain, Rᵀ − ω Y smoothed)."""
+    rc = jnp.einsum("In,an->Ia", coarse.restrict_sel, r,
+                    precision=HI)  # R r  [C, cd]
+    if coarse.Y is not None:
+        rc = rc - coarse.omega * jnp.einsum(
+            "anJb,an->Jb", coarse.Y, r, precision=HI)
+    return rc
 
-    Returns (y [C, cd], z [cd, Nc]) — the injection gathers each
-    camera's cluster value and re-applies the fixed-camera mask (selᵀ y
-    == gather + mask, without materialising selᵀ)."""
-    C, cd = rc.shape
-    y = dense_filtered_solve((coarse.eig_q, coarse.eig_inv),
-                             rc.reshape(C * cd)).reshape(C, cd)
+
+def _inject(coarse: TwoLevelCoarse, y: jax.Array) -> jax.Array:
+    """Π y: [C, cd] coarse value -> [cd, Nc] fine rows.
+
+    The plain-aggregation part gathers each camera's cluster value and
+    re-applies the fixed-camera mask (selᵀ y == gather + mask, without
+    materialising selᵀ); the smoothed prolongator subtracts ω Y y."""
     z = jnp.swapaxes(jnp.take(y, coarse.cluster, axis=0), 0, 1)
     z = z * jnp.max(coarse.restrict_sel, axis=0)[None, :]
-    return y, z
+    if coarse.Y is not None:
+        z = z - coarse.omega * jnp.einsum(
+            "anJb,Jb->an", coarse.Y, y, precision=HI)
+    return z
+
+
+def _level1_cycle(
+    coarse: TwoLevelCoarse,
+    coarse_solve: Callable[[jax.Array], jax.Array],
+    ok: jax.Array,
+    base_apply: Callable[[jax.Array], jax.Array],
+    r: jax.Array,
+) -> jax.Array:
+    """One symmetrized multiplicative cycle at the fine level.
+
+        M⁻¹ r = Π B Πᵀ r + Pᵀ D⁻¹ P r,   P = I − G B Πᵀ
+
+    with G = S_d Π materialised at build time and B = `coarse_solve`
+    any SYMMETRIC coarse approximate inverse — the exact filtered A_c⁺
+    for the two-level scheme, the recursive level-2 cycle for the
+    multilevel hierarchy.  Both "S applies" are [cd·Nc, C·cd]
+    replicated contractions: no edge-scale ops, ZERO collectives.
+    Degrades bitwise to the plain base apply when `ok` is False (the
+    fallback ladder's coarse level); fixed cameras receive exactly the
+    base apply by the masked selector.
+    """
+    rc = _restrict(coarse, r)
+    y = coarse_solve(rc)
+    z_c = _inject(coarse, y)
+    gy = jnp.einsum("anJb,Jb->an", coarse.G, y, precision=HI)  # G y
+    # Pre-smoothing residual P r = r − G B Πᵀ r; gated so the ok=False
+    # ladder level is EXACTLY base_apply(r), not a perturbed smooth of
+    # garbage.
+    u = jnp.where(ok, r - gy, r)
+    w = base_apply(u)
+    # Post-correction: Π B (Gᵀ w)   (Gᵀ w = Πᵀ S_d w).
+    v = jnp.einsum("anJb,an->Jb", coarse.G, w, precision=HI)
+    z2 = _inject(coarse, coarse_solve(v))
+    return jnp.where(ok, z_c + w - z2, w)
 
 
 def two_level_cycle(
@@ -394,31 +625,228 @@ def two_level_cycle(
     base_apply: Callable[[jax.Array], jax.Array],
     r: jax.Array,
 ) -> jax.Array:
-    """One symmetrized multiplicative two-level cycle ([cd, Nc] rows).
+    """One symmetrized multiplicative two-level cycle ([cd, Nc] rows):
+    the `_level1_cycle` with B = the exact spectrally-filtered A_c⁺."""
+    C = coarse.restrict_sel.shape[0]
+    cd = r.shape[0]
 
-        M⁻¹ r = Rᵀ A_c⁻¹ R r + Pᵀ D⁻¹ P r,   P = I − G A_c⁻¹ R
+    def solve(rc):
+        return dense_filtered_solve(
+            (coarse.eig_q, coarse.eig_inv),
+            rc.reshape(C * cd)).reshape(C, cd)
 
-    with G = S_d Rᵀ materialised at build time, so both "S applies"
-    are [cd·Nc, C·cd] replicated contractions: per-apply work is two
-    tiny triangular solves + two G contractions + one block-diagonal
-    smooth — no edge-scale ops, ZERO collectives.  Degrades bitwise to
-    the plain base apply when the coarse factor was non-finite (the
-    fallback ladder's coarse level); fixed cameras receive exactly the
-    base apply by the masked selector.
-    """
-    rc = jnp.einsum("In,an->Ia", coarse.restrict_sel, r,
-                    precision=HI)  # R r  [C, cd]
-    y, z_c = _coarse_solve_inject(coarse, rc)
-    gy = jnp.einsum("anJb,Jb->an", coarse.G, y, precision=HI)  # G y
-    # Pre-smoothing residual P r = r − G A_c⁻¹ R r; gated so the
-    # ok=False ladder level is EXACTLY base_apply(r), not a perturbed
-    # smooth of garbage.
-    u = jnp.where(coarse.ok, r - gy, r)
-    w = base_apply(u)
-    # Post-correction: Rᵀ A_c⁻¹ (Gᵀ w)   (Gᵀ w = R S_d w).
-    v = jnp.einsum("anJb,an->Jb", coarse.G, w, precision=HI)
-    _, z2 = _coarse_solve_inject(coarse, v)
-    return jnp.where(coarse.ok, z_c + w - z2, w)
+    return _level1_cycle(coarse, solve, coarse.ok, base_apply, r)
+
+
+# --------------------------------------------------------------------------
+# Recursive camera-graph hierarchy (MULTILEVEL)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CoarseLevel:
+    """One coarse level of the multilevel hierarchy (levels >= 2).
+
+    Mid-hierarchy levels carry the dense level operator `A`
+    ([C_l·cd, C_l·cd]), its spectrally-damped block-Jacobi smoother
+    (`D_inv` [C_l, cd, cd] + the damping weight `omega_s` — a traced
+    scalar, 1/lambda_max(D⁻¹A) from a fixed-length power iteration at
+    build, so the smoothing iteration is contraction-safe on any
+    spectrum) and the aggregation `assign` ([C_l]); the COARSEST level
+    carries the filtered eigen-factor instead (`eig_q`/`eig_inv`,
+    assign None).  `ok` is the level's health flag (operator finite;
+    at the coarsest, factor ok too)."""
+
+    A: jax.Array
+    ok: jax.Array
+    D_inv: Optional[jax.Array] = None
+    omega_s: Optional[jax.Array] = None
+    assign: Optional[jax.Array] = None
+    num_next: int = 0
+    eig_q: Optional[jax.Array] = None
+    eig_inv: Optional[jax.Array] = None
+
+
+@dataclasses.dataclass
+class MultiLevelCoarse:
+    """Assembled state of one L-level preconditioner: the level-1
+    Galerkin assembly (edge-scale build, `TwoLevelCoarse` without its
+    factor) + the dense coarse chain.  `level_ok[l-1]` gates coarse
+    level l's correction — the per-level fallback bit-field rides
+    these flags into the trace code."""
+
+    level1: TwoLevelCoarse
+    chain: Tuple[CoarseLevel, ...]
+    level_ok: Tuple[jax.Array, ...]
+
+
+def _block_diag_inv(A: jax.Array, C: int, cd: int) -> jax.Array:
+    """[C, cd, cd] inverse of the cd-block diagonal of a dense level
+    operator; dead blocks (all-fixed / edge-less aggregates — exactly
+    zero rows) fall back to identity so the smoother stays finite (the
+    residual there is zero anyway, so they contribute nothing)."""
+    idx = jnp.arange(C, dtype=jnp.int32)
+    blocks = A.reshape(C, cd, C, cd)[idx, :, idx, :]
+    inv = block_inv(blocks)
+    eye = jnp.broadcast_to(jnp.eye(cd, dtype=A.dtype), inv.shape)
+    bad = ~jnp.all(jnp.isfinite(inv), axis=(-2, -1), keepdims=True)
+    return jnp.where(bad, eye, inv)
+
+
+def _smoother_weight(A4: jax.Array, D_inv: jax.Array) -> jax.Array:
+    """omega_s = 1 / lambda_max(D⁻¹A) by a fixed 12-step power
+    iteration (dense, tiny, once per build): the damped block-Jacobi
+    smoothing iteration x += omega_s D⁻¹ r then has spectral radius
+    ~<= 1 < 2 on ANY level spectrum, which is exactly the SPD condition
+    of the symmetric V(1,1) cycle it smooths inside."""
+    C, cd = D_inv.shape[0], D_inv.shape[1]
+    v = jnp.ones((C, cd), A4.dtype)
+    nrm = jnp.asarray(1.0, A4.dtype)
+    for _ in range(12):
+        w = jnp.einsum("iab,ib->ia", D_inv,
+                       jnp.einsum("iajb,jb->ia", A4, v, precision=HI),
+                       precision=HI)
+        nrm = jnp.sqrt(jnp.sum(w * w))
+        v = w / jnp.maximum(nrm, jnp.asarray(1e-30, A4.dtype))
+    om = 1.0 / jnp.maximum(nrm, jnp.asarray(1.0, A4.dtype))
+    return jnp.where(jnp.isfinite(om), om, jnp.asarray(1.0, A4.dtype))
+
+
+@jax.named_scope("megba.precond_coarse_build")
+def build_multilevel_coarse(
+    Hpp_d: jax.Array,
+    Hll_inv: jax.Array,
+    W: Optional[jax.Array],
+    Jc: Optional[jax.Array],
+    Jp: Optional[jax.Array],
+    multilevel_plan,
+    compute_kind: ComputeKind,
+    axis_name: Optional[str] = None,
+    cam_fixed: Optional[jax.Array] = None,
+    plans=None,
+    smooth_omega: float = 0.0,
+    Minv: Optional[jax.Array] = None,
+    cam_idx: Optional[jax.Array] = None,
+    pt_idx: Optional[jax.Array] = None,
+) -> MultiLevelCoarse:
+    """Assemble the full hierarchy from one host-planned
+    ops/segtiles.DeviceMultiLevelPlan.
+
+    Level 1 is the (optionally smoothed) edge-scale Galerkin build
+    (`build_two_level_coarse`, unfactored when deeper levels exist);
+    every further level l+1 is the PLAIN-aggregation dense Galerkin
+        A_{l+1} = R_l A_l R_lᵀ
+    over the host-planned assignment — a tiny replicated contraction
+    (the cluster counts shrink geometrically), so the hierarchy adds
+    ZERO collectives beyond level 1's build psums and nothing at all
+    inside the PCG while body.  Only the coarsest level pays the dense
+    filtered pseudo-inverse (`dense_filtered_factor`); mid-hierarchy
+    levels smooth with their own block-Jacobi diagonal."""
+    cplan = multilevel_plan.base
+    depth_assign = len(multilevel_plan.assign)
+    level1 = build_two_level_coarse(
+        Hpp_d, Hll_inv, W, Jc, Jp, cplan, compute_kind,
+        axis_name=axis_name, cam_fixed=cam_fixed, plans=plans,
+        smooth_omega=smooth_omega, Minv=Minv, cam_idx=cam_idx,
+        pt_idx=pt_idx, factor=(depth_assign == 0))
+    cd = Hpp_d.shape[-1]
+    dtype = Hpp_d.dtype
+    A = level1.coarse_matrix
+    if depth_assign == 0:
+        # Two levels deep: level 1 IS the coarsest — its factor was
+        # built above (factor=True), don't factor twice.
+        chain = [CoarseLevel(A=A, ok=level1.ok, eig_q=level1.eig_q,
+                             eig_inv=level1.eig_inv)]
+    else:
+        chain = []
+        sizes = multilevel_plan.level_sizes
+        for i, assign in enumerate(multilevel_plan.assign):
+            Cl, Cn = int(sizes[i]), int(sizes[i + 1])
+            sel = (assign[None, :] == jnp.arange(
+                Cn, dtype=jnp.int32)[:, None]).astype(dtype)
+            A4 = A.reshape(Cl, cd, Cl, cd)
+            D_inv = _block_diag_inv(A, Cl, cd)
+            chain.append(CoarseLevel(
+                A=A, ok=jnp.all(jnp.isfinite(A)), D_inv=D_inv,
+                omega_s=_smoother_weight(A4, D_inv),
+                assign=assign, num_next=Cn))
+            G4 = jnp.einsum("iakb,Jk->iaJb", A4, sel,
+                            precision=HI)  # A R_lᵀ
+            A_next = jnp.einsum("Ii,iaJb->IaJb", sel, G4,
+                                precision=HI).reshape(Cn * cd, Cn * cd)
+            A = 0.5 * (A_next + A_next.T)
+        # Coarsest level: the only dense factor in the hierarchy.
+        (Q, inv), okc = dense_filtered_factor(A, _COARSE_EIG_FLOOR)
+        chain.append(CoarseLevel(A=A, ok=okc, eig_q=Q, eig_inv=inv))
+    # level_ok[l-1] gates coarse level l's correction: a level is
+    # usable when its OWN operator assembled finite (the coarsest
+    # additionally needs its factor) AND every ancestor is — a bad
+    # level makes all deeper levels unreachable, so the bit-field
+    # reads as "the cycle truncated here".
+    gated = []
+    alive = jnp.bool_(True)
+    for lvl in chain:
+        alive = alive & lvl.ok
+        gated.append(alive)
+    return MultiLevelCoarse(level1=level1, chain=tuple(chain),
+                            level_ok=tuple(gated))
+
+
+def _chain_solve(chain: Tuple[CoarseLevel, ...], level_ok, i: int,
+                 rc: jax.Array) -> jax.Array:
+    """Approximate A_{i+1}⁻¹ rc ([C, cd]) by a recursive SYMMETRIC
+    V(1,1) cycle over the dense chain: damped block-Jacobi pre-smooth,
+    recursive coarse correction on the true residual, damped post-
+    smooth.  Static recursion depth (the hierarchy is host-planned),
+    all replicated dense work, SPD whenever the smoothing iteration
+    contracts — which `omega_s` = 1/lambda_max(D⁻¹A) guarantees.  The
+    residual-based form matters: unlike the fine level (where the
+    materialised G avoids edge-scale S applies and the coarse solve is
+    exact-or-recursive), a MID-hierarchy correction is inexact, and
+    re-smoothing its residual is what keeps the cycle's quality close
+    to the exact two-level solve instead of degrading with depth."""
+    lvl = chain[i]
+    C, cd = rc.shape
+    if lvl.assign is None:  # coarsest: exact filtered solve
+        return dense_filtered_solve(
+            (lvl.eig_q, lvl.eig_inv), rc.reshape(C * cd)).reshape(C, cd)
+    ok_next = level_ok[i + 1]
+    A4 = lvl.A.reshape(C, cd, C, cd)
+
+    def smooth(x):
+        return lvl.omega_s * jnp.einsum("iab,ib->ia", lvl.D_inv, x,
+                                        precision=HI)
+
+    def amat(x):
+        return jnp.einsum("iajb,jb->ia", A4, x, precision=HI)
+
+    z1 = smooth(rc)
+    r1 = rc - amat(z1)
+    rn = jnp.zeros((lvl.num_next, cd), rc.dtype).at[lvl.assign].add(r1)
+    zc = jnp.take(_chain_solve(chain, level_ok, i + 1, rn), lvl.assign,
+                  axis=0)  # R_lᵀ B (R_l r1)
+    z2 = z1 + jnp.where(ok_next, zc, jnp.zeros_like(zc))
+    r2 = rc - amat(z2)
+    return z2 + smooth(r2)
+
+
+def multilevel_cycle(
+    mlc: MultiLevelCoarse,
+    base_apply: Callable[[jax.Array], jax.Array],
+    r: jax.Array,
+) -> jax.Array:
+    """One recursive L-level V-cycle ([cd, Nc] rows): the fine-level
+    symmetrized multiplicative cycle with B = the level-2 recursive
+    cycle (or the exact coarse solve when the hierarchy is 2 deep).
+    SPD by induction: every level composes Π B Πᵀ + Pᵀ D⁻¹ P from an
+    SPD B and a PD smoother, exactly like the two-level proof."""
+    cd = r.shape[0]
+
+    def solve(rc):
+        return _chain_solve(mlc.chain, mlc.level_ok, 0,
+                            rc.reshape(-1, cd))
+
+    return _level1_cycle(mlc.level1, solve, mlc.level_ok[0], base_apply, r)
 
 
 # --------------------------------------------------------------------------
@@ -445,6 +873,7 @@ def make_schur_preconditioner(
     cluster_plan=None,
     cam_fixed=None,
     s_matvec: Optional[Callable[[jax.Array], jax.Array]] = None,
+    smooth_omega: float = 0.0,
 ) -> Tuple[Callable[[jax.Array], jax.Array], jax.Array]:
     """Build the reduced-system preconditioner apply for one solve.
 
@@ -455,6 +884,9 @@ def make_schur_preconditioner(
     base block diagonal every family smooths with (PreconditionerKind).
     All operands are the damped, already-materialised solve quantities;
     `s_matvec` (the CG's own S·p closure) is required by NEUMANN only.
+    `cluster_plan` is a DeviceClusterPlan for TWO_LEVEL, a
+    DeviceMultiLevelPlan for MULTILEVEL; `smooth_omega` > 0 turns on
+    the smoothed-aggregation prolongator for both coarse-space kinds.
     """
     if block_kind == PreconditionerKind.SCHUR_DIAG:
         Minv, n_bad = _schur_diag_precond(
@@ -488,36 +920,64 @@ def make_schur_preconditioner(
 
         return neumann_apply, encode_precond_fallback(n_bad)
 
-    if kind != PrecondKind.TWO_LEVEL:  # pragma: no cover - enum closed
+    if kind not in (PrecondKind.TWO_LEVEL,
+                    PrecondKind.MULTILEVEL):  # pragma: no cover - closed
         raise ValueError(f"unknown precond kind {kind}")
     if cluster_plan is None:
         raise ValueError(
-            "precond=TWO_LEVEL needs a camera-cluster plan operand; the "
-            "flat_solve lowering builds one automatically "
-            "(ops/segtiles.cached_cluster_plan) — direct schur_pcg_solve "
-            "callers must pass cluster_plan=")
-    coarse = build_two_level_coarse(
+            f"precond={kind.name} needs a camera-cluster plan operand; "
+            "the flat_solve lowering builds one automatically "
+            "(ops/segtiles.cached_cluster_plan / cached_multilevel_plan)"
+            " — direct schur_pcg_solve callers must pass cluster_plan=")
+
+    if kind == PrecondKind.TWO_LEVEL:
+        coarse = build_two_level_coarse(
+            Hpp_d, Hll_inv, W, Jc, Jp, cluster_plan, compute_kind,
+            axis_name=axis_name, cam_fixed=cam_fixed, plans=plans,
+            smooth_omega=smooth_omega, Minv=Minv, cam_idx=cam_idx,
+            pt_idx=pt_idx)
+
+        @jax.named_scope("megba.precond_two_level")
+        def two_level_apply(r):
+            return two_level_cycle(coarse, base_apply, r)
+
+        fallback = encode_precond_fallback(
+            n_bad, jnp.where(coarse.ok, jnp.int32(0), jnp.int32(1)))
+        return two_level_apply, fallback
+
+    mlc = build_multilevel_coarse(
         Hpp_d, Hll_inv, W, Jc, Jp, cluster_plan, compute_kind,
-        axis_name=axis_name, cam_fixed=cam_fixed, plans=plans)
+        axis_name=axis_name, cam_fixed=cam_fixed, plans=plans,
+        smooth_omega=smooth_omega, Minv=Minv, cam_idx=cam_idx,
+        pt_idx=pt_idx)
 
-    @jax.named_scope("megba.precond_two_level")
-    def two_level_apply(r):
-        return two_level_cycle(coarse, base_apply, r)
+    @jax.named_scope("megba.precond_multilevel")
+    def multilevel_apply(r):
+        return multilevel_cycle(mlc, base_apply, r)
 
-    fallback = encode_precond_fallback(
-        n_bad, jnp.where(coarse.ok, jnp.int32(0), jnp.int32(1)))
-    return two_level_apply, fallback
+    # Per-level bit-field: bit l-1 set when coarse level l's correction
+    # is out of the cycle (its operator — or an ancestor's — degraded).
+    bits = jnp.int32(0)
+    for i, ok_l in enumerate(mlc.level_ok):
+        bits = bits + jnp.where(ok_l, jnp.int32(0), jnp.int32(1 << i))
+    return multilevel_apply, encode_precond_fallback(n_bad, bits)
 
 
 __all__ = [
     "FALLBACK_BLOCK_RADIX",
+    "FALLBACK_MAX_COARSE_LEVELS",
+    "CoarseLevel",
+    "MultiLevelCoarse",
     "TwoLevelCoarse",
     "block_inv",
+    "build_multilevel_coarse",
     "build_two_level_coarse",
     "cam_block_matvec",
     "decode_precond_fallback",
+    "decode_precond_fallback_levels",
     "encode_precond_fallback",
     "make_schur_preconditioner",
+    "multilevel_cycle",
     "two_level_cycle",
     "_schur_diag_precond",
 ]

@@ -116,6 +116,48 @@ assert fl["problems_per_sec_batched"] > fl["problems_per_sec_serial"], (
 PY
 echo "inexact-LM + fleet smoke OK"
 
+# Locality-scene multilevel smoke (ISSUE 11): the venice-10% bench on
+# a RING-locality scene (banded camera co-observation — the structure
+# real BAL graphs have; MEGBA_BENCH_LOCALITY=ring) with the MULTILEVEL
+# camera-graph hierarchy as candidate.  Unlike the expander scene
+# (where the coarse space is structurally inert — PR 7's honest
+# negative result, and why the Neumann smoke above stays as-is), the
+# locality scene has the cluster-constant slow modes the coarse space
+# exists to remove: the hierarchy must cut total PCG iterations >= 30%
+# vs block-Jacobi at <= 1e-2 relative final-cost gap, and the JSON
+# line must carry the hierarchy depth + per-level fallback decode.
+LOCALITY_OUT=$(mktemp /tmp/megba_locality_smoke.XXXXXX.json)
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT"' EXIT
+JAX_PLATFORMS=cpu MEGBA_BENCH_CONFIG=venice MEGBA_BENCH_SCALE=0.1 \
+MEGBA_BENCH_CONVERGENCE=0 MEGBA_BENCH_LOCALITY=ring \
+MEGBA_BENCH_PRECOND=multilevel \
+  python bench.py > "$LOCALITY_OUT"
+python - "$LOCALITY_OUT" <<'PY'
+import json
+import sys
+
+line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
+d = json.loads(line)
+assert d["extra"]["locality"] == "ring", d["extra"].get("locality")
+pc = d["extra"]["precond"]
+print("locality multilevel smoke:", json.dumps(pc))
+assert pc["kind"] == "multilevel", pc
+assert pc["locality"] == "ring", pc
+# The hierarchy actually went past two levels on this scene.
+assert pc["hierarchy_levels"] >= 3, pc
+assert pc["pcg_reduction"] >= 0.30, (
+    f"multilevel cut only {100 * pc['pcg_reduction']:.1f}% of PCG "
+    "iterations vs block-Jacobi on the locality scene (need >= 30%)")
+assert pc["cost_rel_gap"] <= 1e-2, (
+    f"multilevel moved the final cost by {pc['cost_rel_gap']:.2e} "
+    "(> 1e-2 curve gap_tol)")
+# Healthy hierarchy: the win must come from the full cycle, not a
+# degraded one (fallback rides the JSON line either way).
+fb = pc["fallback"] or {}
+assert not fb.get("coarse"), f"hierarchy degraded during the smoke: {fb}"
+PY
+echo "locality multilevel smoke OK"
+
 # Fault-injection smoke: venice-10% with a NaN burst seeded at GLOBAL
 # LM iteration 3 — i.e. at the checkpointed driver's chunk-resume
 # relinearisation, the preemption-recovery worst case.  With
@@ -205,7 +247,7 @@ echo "fault-injection smoke OK"
 # survive all of it; and `summarize --aggregate` must render the
 # retry/shed/deadline-miss/breaker counters from the report stream.
 CHAOS_SINK=$(mktemp /tmp/megba_chaos_smoke.XXXXXX.jsonl)
-trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK"' EXIT
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK"' EXIT
 JAX_PLATFORMS=cpu MEGBA_CHAOS_SINK="$CHAOS_SINK" python - <<'PY'
 import dataclasses
 import os
@@ -380,7 +422,7 @@ echo "serving chaos smoke OK"
 # the same composition.  `summarize --aggregate` renders the triage
 # counters from the report stream.
 TRIAGE_SINK=$(mktemp /tmp/megba_triage_smoke.XXXXXX.jsonl)
-trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"' EXIT
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"' EXIT
 JAX_PLATFORMS=cpu python - <<'PY'
 import time
 
@@ -597,7 +639,7 @@ if JAX_PLATFORMS=cpu python -c "import sys
 from megba_tpu.parallel.multihost import cpu_cross_process_collectives_available
 sys.exit(0 if cpu_cross_process_collectives_available() else 3)"; then
 ELASTIC_DIR=$(mktemp -d /tmp/megba_elastic_smoke.XXXXXX)
-trap 'rm -f "$SMOKE" "$FORCING_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$ELASTIC_DIR"' EXIT
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$ELASTIC_DIR"' EXIT
 JAX_PLATFORMS=cpu MEGBA_ELASTIC_DIR="$ELASTIC_DIR" python - <<'PY'
 import importlib.util
 import os

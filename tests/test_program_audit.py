@@ -85,6 +85,10 @@ def test_collective_census_matches_analytic_expectation(audits):
     # in-body collectives: the cycle runs on the materialised G/A_c
     # (its V and G build psums live outside the while body).
     assert len(audits["ba_twolevel_w2_f32"].pcg_body_collectives()) == 2
+    # Same for the RECURSIVE multilevel hierarchy: every level beyond
+    # the first is a replicated dense Galerkin (no collectives at all),
+    # so the while-body census is still exactly the two S·p psums.
+    assert len(audits["ba_multilevel_w2_f32"].pcg_body_collectives()) == 2
     assert len(audits["pgo_sharded_w2_f64"].pcg_body_collectives()) == 1
     for name in ("ba_single_f32", "ba_tiled_f32", "pgo_single_f64"):
         assert audits[name].collectives == [], name
@@ -92,7 +96,7 @@ def test_collective_census_matches_analytic_expectation(audits):
     # programs emit is an all-reduce.
     for name in ("ba_sharded_w2_f32", "ba_forcing_w2_f32",
                  "ba_guarded_w2_f32", "ba_twolevel_w2_f32",
-                 "pgo_sharded_w2_f64"):
+                 "ba_multilevel_w2_f32", "pgo_sharded_w2_f64"):
         kinds = {op.kind for op in audits[name].collectives}
         assert kinds == {"all_reduce"}, (name, kinds)
 
@@ -100,13 +104,18 @@ def test_collective_census_matches_analytic_expectation(audits):
 def test_twolevel_build_psums_live_outside_the_pcg_body(audits):
     # The coarse build is allowed exactly its V and G all-reduces, once
     # per PCG solve, scoped megba.precond_coarse_build — NOT inside
-    # megba.pcg_core's while body.
-    aud = audits["ba_twolevel_w2_f32"]
-    build_ops = [op for op in aud.collectives
-                 if "precond_coarse_build" in (op.op_name or "")]
-    assert len(build_ops) == 2, [op.op_name for op in build_ops]
-    for op in build_ops:
-        assert "pcg_core/while" not in op.op_name, op.op_name
+    # megba.pcg_core's while body.  The MULTILEVEL hierarchy adds no
+    # build psums beyond those two: all deeper Galerkin levels are
+    # replicated dense contractions (asserted structurally here, not
+    # just by the total census).
+    for prog in ("ba_twolevel_w2_f32", "ba_multilevel_w2_f32"):
+        aud = audits[prog]
+        build_ops = [op for op in aud.collectives
+                     if "precond_coarse_build" in (op.op_name or "")]
+        assert len(build_ops) == 2, (
+            prog, [op.op_name for op in build_ops])
+        for op in build_ops:
+            assert "pcg_core/while" not in op.op_name, (prog, op.op_name)
 
 
 def test_guarded_program_adds_no_collectives_vs_unguarded(audits):
