@@ -215,6 +215,226 @@ def fleet_head_to_head(n_problems: int, dtype, timer) -> dict:
     }
 
 
+def federation_head_to_head(n_workers: int, dtype, timer) -> dict:
+    """Single-host FleetQueue vs an N-worker FleetRouter on one fleet,
+    plus the replica cold-start split (artifact-load vs compile).
+
+    THROUGHPUT: all sides solve the same `make_fleet` problems (the
+    fleet_head_to_head generator, scaled up), fully warmed, each
+    configuration timed best-of-2 (this sandbox is a shared 2-core
+    container with a cgroup CPU quota — single measurements swing 2-3x
+    under noisy neighbours, and two simultaneous pinned processes
+    measure only ~1.15x ONE process's throughput, i.e. the quota caps
+    aggregate compute below 2 honest cores).  Because of that cap the
+    whole-machine comparison cannot show real scale-out here; the
+    curve that CAN be certified on this lane is EQUAL-RESOURCE
+    scaling: fed_1 and fed_N workers pinned to the SAME per-worker
+    core slice (cores // N each), so the 1→N ratio measures what the
+    router/stealing/IPC layer costs and gains per added host —
+    `scaling_equal_resources` is the ROADMAP "~linear 1→N" observable,
+    `scaling_vs_single_queue` is recorded for honesty with the machine
+    context attached.
+
+    COLD START: one fresh worker warmed from serialized artifacts vs
+    one compiling from scratch, both measured config→fleet-solved over
+    the same manifest buckets, full fleet submitted atomically
+    (submit_many) so batch composition reproduces the exporter's and
+    the artifact worker dispatches it with ZERO traces (worker-side
+    retrace-sentinel certification, reported in the JSON).  Set
+    MEGBA_BENCH_FEDERATION_COLD=0 to skip the (compile-heavy) cold
+    half.  Results land in BENCH_federation.json next to the JSON line.
+    """
+    import tempfile
+
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.io.synthetic import make_fleet
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving import (
+        CompilePool,
+        FleetProblem,
+        FleetQueue,
+        FleetRouter,
+        FleetStats,
+        solve_many,
+    )
+
+    n_problems = int(os.environ.get(
+        "MEGBA_BENCH_FEDERATION_PROBLEMS", "32") or "32")
+    opt = ProblemOption(
+        dtype=dtype,
+        algo_option=AlgoOption(max_iter=8),
+        solver_option=SolverOption(max_iter=12, tol=1e-8))
+    fleet = make_fleet(n_problems, size_range=(16, 64), seed=0, dtype=dtype)
+    probs = [FleetProblem.from_synthetic(s, name=f"fed{i}")
+             for i, s in enumerate(fleet)]
+    engine = make_residual_jacobian_fn(mode=opt.jacobian_mode)
+
+    root = tempfile.mkdtemp(prefix="megba_bench_fed_")
+    manifest = os.path.join(root, "manifest.json")
+
+    # -- exporter: deterministic bucket discovery through solve_many
+    # (one batch per bucket — exactly what submit_many through the
+    # router reproduces), then the portable-executable export ----------
+    export_pool = CompilePool(stats=FleetStats(), artifacts=root)
+    with timer.phase("federation_warm_export_pool"):
+        solve_many(probs, opt, pool=export_pool)
+    export_pool.save_manifest(manifest, option=opt)
+    with timer.phase("federation_export"):
+        exported = export_pool.export_artifacts(engine, opt)
+
+    # -- single-host baseline: a warmed FleetQueue (own pool, jit path;
+    # max_wait large so flush() drives one deterministic batch per
+    # bucket) ----------------------------------------------------------
+    qpool = CompilePool(stats=FleetStats())
+
+    def queue_pass():
+        stats = FleetStats()
+        with FleetQueue(opt, max_batch=n_problems, max_wait_s=30.0,
+                        pool=qpool, stats=stats) as q:
+            futs = [q.submit(p) for p in probs]
+            q.flush()
+            out = [f.result(timeout=600) for f in futs]
+        return out, stats
+
+    with timer.phase("federation_warm_single"):
+        queue_pass()
+    single_s = float("inf")
+    for _ in range(2):  # best-of-2: noisy-neighbour suppression
+        t0 = time.perf_counter()
+        with timer.phase("federation_single"):
+            queue_pass()
+        single_s = min(single_s, time.perf_counter() - t0)
+
+    # -- cold start: artifact replica vs compile replica -----------------
+    # Both replicas dispatch the FULL fleet, submitted atomically
+    # (submit_many) with max_batch >= any bucket's population: batch
+    # composition then reproduces the exporter's solve_many batches
+    # exactly, so the artifact replica's first fleet rides the store
+    # end to end — zero traces, the sentinel-certified contract.
+    def replica_cold_start(artifacts):
+        router = FleetRouter(opt, n_workers=1, artifacts=artifacts,
+                             manifest=manifest, max_batch=n_problems)
+        try:
+            t0 = time.perf_counter()
+            futs = router.submit_many(probs)
+            router.flush()
+            [f.result(timeout=600) for f in futs]
+            first_solve_s = time.perf_counter() - t0
+            d = router.stats.as_dict()
+            cs = d["cold_start"]["w0"]
+            fs = d["first_solve"]["w0"]
+            return {
+                "mode": cs["mode"],
+                "warm_s": round(cs["warm_s"], 3),
+                "first_solve_s": round(first_solve_s, 3),
+                "cold_start_to_first_solve_s": round(
+                    cs["warm_s"] + first_solve_s, 3),
+                "buckets": cs["buckets"],
+                "artifact_loads": cs["artifact_loads"],
+                "artifact_compiles": cs["artifact_compiles"],
+                "first_solve_traces": fs["traces"],
+            }
+        finally:
+            router.close()
+
+    cold = None
+    if os.environ.get("MEGBA_BENCH_FEDERATION_COLD", "1") != "0":
+        with timer.phase("federation_cold_artifact"):
+            from_artifacts = replica_cold_start(root)
+        with timer.phase("federation_cold_compile"):
+            from_compile = replica_cold_start(None)
+        cold = {
+            "from_artifacts": from_artifacts,
+            "from_compile": from_compile,
+            "speedup": round(
+                from_compile["cold_start_to_first_solve_s"]
+                / max(from_artifacts["cold_start_to_first_solve_s"], 1e-9),
+                2),
+        }
+
+    # -- federated throughput: equal-resource 1→N scaling ----------------
+    # Every worker — in BOTH sweeps — is pinned to the same-size core
+    # slice (cores // n_workers), so fed_1 is "one host" and fed_n is
+    # "n hosts" of identical resources; the ratio is the scale-out
+    # curve, isolated from this container's aggregate CPU quota.
+    try:
+        n_cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n_cores = os.cpu_count() or 1
+    per_worker_cores = max(1, n_cores // n_workers)
+
+    def router_pass(workers):
+        router = FleetRouter(opt, n_workers=workers, artifacts=root,
+                             manifest=manifest, strict_manifest=True,
+                             max_batch=n_problems,
+                             pin_cpus=per_worker_cores)
+        try:
+            with timer.phase(f"federation_warm_x{workers}"):
+                futs = router.submit_many(probs)
+                router.flush()
+                [f.result(timeout=600) for f in futs]
+            wall = float("inf")
+            out = None
+            for _ in range(2):  # best-of-2
+                t0 = time.perf_counter()
+                with timer.phase(f"federation_x{workers}"):
+                    futs = router.submit_many(probs)
+                    router.flush()
+                    res = [f.result(timeout=600) for f in futs]
+                dt = time.perf_counter() - t0
+                if dt < wall:
+                    wall, out = dt, res
+            return out, wall, router.stats.as_dict(), router.pinned
+        finally:
+            router.close()
+
+    _, fed1_s, fed1_stats, fed1_pinned = router_pass(1)
+    fed_out, fedn_s, fed_stats, fedn_pinned = router_pass(n_workers)
+
+    result = {
+        "workers": n_workers,
+        "problems": n_problems,
+        "exported_artifacts": exported,
+        "machine": {
+            "cores": n_cores,
+            "per_worker_cores": per_worker_cores,
+            # Equal-resource scaling is only CERTIFIED when pinning
+            # actually applied in BOTH sweeps (n_workers > cores
+            # leaves workers unpinned, with a warning — the ratio is
+            # then asymmetric and must not be read as the curve).
+            "pinned": bool(fed1_pinned and fedn_pinned),
+            "note": ("shared container with a cgroup CPU quota: two "
+                     "simultaneous pinned processes measure ~1.15x ONE "
+                     "process (aggregate compute capped), so "
+                     "scaling_vs_single_queue understates real "
+                     "multi-host scale-out; scaling_equal_resources is "
+                     "the certified curve"),
+        },
+        "problems_per_sec_single_queue": round(n_problems / single_s, 2),
+        "problems_per_sec_federated_1": round(n_problems / fed1_s, 2),
+        "problems_per_sec_federated_n": round(n_problems / fedn_s, 2),
+        "scaling_vs_single_queue": round(single_s / fedn_s, 3),
+        "scaling_equal_resources": round(fed1_s / fedn_s, 3),
+        "single_queue_s": round(single_s, 3),
+        "federated_1_s": round(fed1_s, 3),
+        "federated_n_s": round(fedn_s, 3),
+        "steals": fed_stats["steals"],
+        "problems_by_worker": fed_stats["problems_by_worker"],
+        "first_solve_traces": {
+            w: fs.get("traces")
+            for w, fs in fed_stats.get("first_solve", {}).items()},
+        "statuses": sorted({r.status_name for r in fed_out}),
+        "cold_start": cold,
+    }
+    artifact_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_federation.json")
+    with open(artifact_path, "w") as fh:
+        json.dump(result, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return result
+
+
 def main() -> None:
     import sys
 
@@ -544,6 +764,16 @@ def main() -> None:
     n_fleet = int(os.environ.get("MEGBA_BENCH_FLEET", "0") or "0")
     if n_fleet:
         fleet_cmp = fleet_head_to_head(n_fleet, dtype, timer)
+    # Federation head-to-head (MEGBA_BENCH_FEDERATION=<n_workers>): the
+    # scale-OUT complement — an n-worker FleetRouter (worker processes
+    # warmed from serialized artifacts) vs the single-host FleetQueue on
+    # the same fleet, plus the replica cold-start split (artifact-load
+    # vs compile-from-scratch, zero-trace certified).  Also written to
+    # BENCH_federation.json as a standalone artifact.
+    federation_cmp = None
+    n_fed = int(os.environ.get("MEGBA_BENCH_FEDERATION", "0") or "0")
+    if n_fed:
+        federation_cmp = federation_head_to_head(n_fed, dtype, timer)
     # Charge the reference model the S·p products this run actually
     # executed (the PCG can exit below the 30-iteration cap), so both
     # sides of vs_baseline do the same algorithmic work.  The fused
@@ -657,6 +887,11 @@ def main() -> None:
                     # Fleet head-to-head (MEGBA_BENCH_FLEET=<n>):
                     # batched solve_many vs serial flat_solve loop.
                     "fleet": fleet_cmp,
+                    # Federation head-to-head
+                    # (MEGBA_BENCH_FEDERATION=<n_workers>): n-worker
+                    # router vs single-host FleetQueue + cold-start
+                    # split; also lands in BENCH_federation.json.
+                    "federation": federation_cmp,
                     # Per-phase wall clocks (compile vs solve, per pass)
                     # so BENCH_*.json artifacts carry phase timings.
                     "phases": {

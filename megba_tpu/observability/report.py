@@ -105,6 +105,13 @@ class SolveReport:
     # snapshot per monitor and sum across monitors without double
     # counting (chunked solves emit one snapshot per chunk).
     elastic: Optional[Dict[str, Any]] = None
+    # Optional federation context (serving/federation.py): a
+    # FederationStats snapshot — per-worker problem counts, steals,
+    # reroutes, worker-lost events and cold-start (artifact-load vs
+    # compile) timings — keyed by a `router` id so the aggregate view
+    # can take the LAST snapshot per router without double counting.
+    # Emitted once per router lifetime by `append_federation_report`.
+    federation: Optional[Dict[str, Any]] = None
     # Optional pre-flight triage context (robustness/triage.py): the
     # HealthReport dict of this solve's problem — findings by kind,
     # component count, the action taken and (after REPAIR) the repair

@@ -16,7 +16,11 @@ and circuit-breaker transitions) — so a multi-problem run's JSONL is
 readable without ad-hoc scripts.  Reports carrying a pre-flight triage
 `health` block (robustness/triage.py) add a triage line — rejected /
 repaired counts, repair totals (points fixed, edges masked, cams
-anchored, edges downweighted) and findings by kind.  Reports carrying the elastic-
+anchored, edges downweighted) and findings by kind.  A federation
+router's lifetime report (serving/federation.py) adds the federation
+block: per-worker problem counts, steals, reroutes, worker-lost events
+and per-worker cold-start mode/timing (artifact-load vs compile) with
+the first-solve trace count.  Reports carrying the elastic-
 distribution context (`SolveReport.elastic`, robustness/elastic.py)
 add an elastic line: workers lost, collective timeouts, reshards,
 resumes, and time-to-detection p50/max (last snapshot per monitor,
@@ -255,6 +259,52 @@ def aggregate_reports(reports: List[SolveReport]) -> str:
         if by_kind:
             lines.append("   findings: " + ", ".join(
                 f"{k}={by_kind[k]}" for k in sorted(by_kind)))
+
+    # Federation view (PR 12): one FederationStats snapshot per router
+    # lifetime (serving/federation.append_federation_report) — keep the
+    # LAST per router id and sum across routers, same shape as the
+    # elastic ledger below.  Worker attribution also rides each fleet
+    # report (`fleet.worker`), so the per-worker solve counts can be
+    # cross-checked against the router's own routing ledger.
+    latest_by_router: dict = {}
+    for i, rep in enumerate(reports):
+        if not rep.federation:
+            continue
+        key = rep.federation.get("router") or f"anon{i}"
+        prev = latest_by_router.get(key)
+        if prev is None or (rep.created_unix or 0.0) >= (
+                prev.created_unix or 0.0):
+            latest_by_router[key] = rep
+    if latest_by_router:
+        blocks = [r.federation for r in latest_by_router.values()]
+        probs = sum(b.get("problems", 0) for b in blocks)
+        steals = sum(b.get("steals", 0) for b in blocks)
+        stolen = sum(b.get("stolen_problems", 0) for b in blocks)
+        reroutes = sum(b.get("reroutes", 0) for b in blocks)
+        lost = sum(b.get("workers_lost", 0) for b in blocks)
+        by_worker: dict = {}
+        for b in blocks:
+            for w, n in (b.get("problems_by_worker") or {}).items():
+                by_worker[w] = by_worker.get(w, 0) + n
+        per = " / ".join(f"{w}:{by_worker[w]}" for w in sorted(by_worker))
+        lines.append(
+            f"   federation: {probs} problems across "
+            f"{len(by_worker)} workers ({per or 'none'}), "
+            f"{steals} steals ({stolen} problems), {reroutes} rerouted, "
+            f"{lost} workers lost")
+        for b in blocks:
+            for w in sorted(b.get("cold_start") or {}):
+                cs = b["cold_start"][w]
+                fs = (b.get("first_solve") or {}).get(w) or {}
+                extra = ""
+                if fs.get("traces") is not None:
+                    extra = f", first solve {fs['traces']} traces"
+                lines.append(
+                    f"   cold start {w}: {cs.get('mode', '?')} "
+                    f"{float(cs.get('warm_s', float('nan'))):.3f}s "
+                    f"({cs.get('artifact_loads', 0)} loaded / "
+                    f"{cs.get('artifact_compiles', 0)} compiled)"
+                    + extra)
 
     # Elastic view (PR 9): each elastic block is a CUMULATIVE snapshot
     # of one rank's ElasticMonitor (chunked solves emit one per chunk),

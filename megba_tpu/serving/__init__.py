@@ -23,10 +23,30 @@ problems do it:
 - stats.py — `FleetStats`: problems/sec at fixed convergence, bucket
   occupancy, padding waste, compile-pool hit rate, plus the resilience
   counters (sheds, retries, rejections, breaker transitions).
+- artifacts.py — `ArtifactStore`: bucket EXECUTABLES serialized to
+  disk (jax AOT export), so a fresh replica warms its working set in
+  milliseconds of I/O instead of minutes of compile; stale/corrupt
+  artifacts fall back to compile-and-refresh with typed warnings.
+- federation.py — `FleetRouter`: the scale-OUT tier — N worker
+  processes each running this whole stack, submissions sharded by
+  shape class (occupancy-aware), idle workers stealing hot buckets
+  they have warm, dead workers detected (PR 9 heartbeats + pipe EOF)
+  and their problems rerouted to survivors, typed and counted.
 """
 
+from megba_tpu.serving.artifacts import ArtifactKey, ArtifactStore
 from megba_tpu.serving.batcher import FleetProblem, FleetResult, solve_many
-from megba_tpu.serving.compile_pool import CompilePool, lower_bucket
+from megba_tpu.serving.compile_pool import (
+    CompilePool,
+    ManifestMismatch,
+    lower_bucket,
+)
+from megba_tpu.serving.federation import (
+    FederationStats,
+    FleetRouter,
+    RoutingTable,
+    WorkerLostError,
+)
 from megba_tpu.serving.queue import FleetQueue
 from megba_tpu.serving.resilience import (
     BreakerPolicy,
@@ -48,6 +68,8 @@ from megba_tpu.serving.shape_class import (
 from megba_tpu.serving.stats import FleetStats
 
 __all__ = [
+    "ArtifactKey",
+    "ArtifactStore",
     "BreakerPolicy",
     "BreakerState",
     "BucketLadder",
@@ -56,14 +78,19 @@ __all__ = [
     "CompilePool",
     "DeadlineExceeded",
     "EscalationPolicy",
+    "FederationStats",
     "FleetProblem",
     "FleetQueue",
     "FleetResult",
+    "FleetRouter",
     "FleetStats",
+    "ManifestMismatch",
     "PaddedProblem",
     "QueueRejected",
     "RejectPolicy",
+    "RoutingTable",
     "ShapeClass",
+    "WorkerLostError",
     "classify",
     "lower_bucket",
     "pad_to_class",

@@ -1,0 +1,297 @@
+"""Artifact store: serialized bucket EXECUTABLES for millisecond cold start.
+
+The compile pool (serving/compile_pool.py) makes first-request latency
+dispatch-only — but only after someone paid the compiles.  A fresh
+replica paying minutes of XLA compile before its first solve is the
+cold-start problem this module removes: the whole-program-bundling
+move of the Julia→TPU full-AOT line (PAPERS.md, arXiv 1810.09868)
+applied to the fleet's bucket programs.
+
+The seam (probed on this jaxlib): `jax.experimental.serialize_executable`
+round-trips a `jax.stages.Compiled` through bytes — `serialize` emits
+the XLA executable plus the call's pytree defs, `deserialize_and_load`
+rebuilds a `Compiled` with ZERO Python tracing and ZERO XLA compile.
+A replica warming from artifacts therefore reaches its first solve
+without ever invoking the program builders (retrace-sentinel-certified
+by the federation worker and tests/test_federation.py), and dispatches
+BITWISE the same executable the exporter ran (same XLA bytes).
+
+Store layout: one file per (bucket program, option fingerprint) under a
+root directory, named by a content-independent KEY digest so a warming
+replica can look artifacts up without an index:
+
+    <root>/<shape>_l<lanes>_<digest16>.megbaexe
+
+File format (the PR 5 checkpoint hardening pattern): an 8-byte magic,
+a 16-byte blake2b digest of the body, then the pickled document —
+{"schema", "meta", "payload", "in_tree", "out_tree"}.  `load` verifies
+magic + digest before unpickling, then checks the recorded environment
+(jax / jaxlib versions, backend platform) against the running process.
+EVERY failure mode — missing file, truncated/corrupt body, schema or
+version mismatch, a deserialize the runtime refuses — degrades to
+`None` with a typed warning: the caller falls back to compile (and
+refreshes the artifact), never to a wrong or stale answer.
+
+The environment check is deliberately NOT part of the filename key:
+a stale artifact must be FOUND and diagnosed (warned, recompiled,
+refreshed in place), not silently shadowed by a cache miss.
+
+Two probed jaxlib hazards shaped the bring-up (jax 0.4.37 / jaxlib
+0.4.36, XLA:CPU): (1) an executable SATISFIED FROM the persistent
+compile cache re-serializes into a blob missing its object code
+("Symbols not found" on load in a fresh process) — so every compile
+destined for serialization bypasses that cache
+(compile_pool._portable_compile_scope); (2) a deserialized executable
+with LAPACK custom calls segfaults in a process that never dispatched
+those kernels natively — so `load` primes them first
+(`_prime_native_kernels`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import pickle
+import warnings
+from typing import Any, Dict, List, Optional
+
+_MAGIC = b"MEGBAEXE"
+ARTIFACT_SCHEMA = "megba_tpu.fleet_artifact/v1"
+_DIGEST_SIZE = 16
+
+
+class ArtifactWarning(UserWarning):
+    """An artifact could not be used; the caller falls back to compile."""
+
+
+_PRIMED = False
+_PRIME_LOCK = __import__("threading").Lock()
+
+
+def _prime_native_kernels() -> None:
+    """Dispatch one tiny Cholesky + triangular solve before the first
+    deserialized executable runs.
+
+    Probed jaxlib hazard (jax 0.4.37 / jaxlib 0.4.36, XLA:CPU): a
+    deserialized executable whose program contains LAPACK custom calls
+    (Cholesky / triangular solve — the Schur block inversions) SEGFAULTS
+    in a process that has never dispatched those kernels natively; the
+    lazy registration/initialization the first real dispatch performs
+    is what the deserialized code path needs and skips.  Importing the
+    registration module is NOT enough (probed) — one real dispatch is.
+    Toy programs without LAPACK calls round-trip fine unprimed.
+    """
+    global _PRIMED
+    with _PRIME_LOCK:
+        if _PRIMED:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        eye = jnp.eye(3, dtype=jnp.float32)
+        jax.block_until_ready(jnp.linalg.cholesky(eye))
+        jax.block_until_ready(jax.scipy.linalg.solve_triangular(
+            eye, jnp.ones(3, dtype=jnp.float32), lower=True))
+        _PRIMED = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ArtifactKey:
+    """Identity of one serialized bucket program.
+
+    `option_fingerprint` is the retrace sentinel's `static_key(engine,
+    option)` — the same string that makes two configs share a jit
+    program makes them share an artifact.  The rest mirrors
+    `compile_pool.pool_key`'s shape half.
+    """
+
+    option_fingerprint: str
+    shape: str  # ShapeClass str form (c#_p#_e#_dtype)
+    lanes: int
+    cd: int
+    pd: int
+    od: int
+    faulted: bool = False
+
+    def digest(self) -> str:
+        h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+        h.update(repr(dataclasses.astuple(self)).encode())
+        return h.hexdigest()
+
+    def filename(self) -> str:
+        return f"{self.shape}_l{self.lanes}_{self.digest()}.megbaexe"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def current_environment() -> Dict[str, str]:
+    """The version/backend triple an executable is only valid under.
+
+    XLA executables are not stable across jaxlib releases or backend
+    platforms; `load` refuses (with a warning) when any of these
+    differ from the recorded values.
+    """
+    import jax
+    import jaxlib
+
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+    }
+
+
+class ArtifactStore:
+    """On-disk store of serialized bucket executables.
+
+    Thread-safety: `save` writes are atomic (temp + rename) so
+    concurrent exporters converge on a complete file; `load` reads a
+    completed file or nothing.  The store keeps no in-memory state, so
+    one directory can be shared by an exporting service and any number
+    of warming replicas (NFS/GCS-fuse style shared storage in a real
+    deployment, a tmpdir in the tests).
+    """
+
+    def __init__(self, root: str) -> None:
+        self.root = str(root)
+
+    def path_for(self, key: ArtifactKey) -> str:
+        return os.path.join(self.root, key.filename())
+
+    # -- export ----------------------------------------------------------
+    def save(self, key: ArtifactKey, compiled) -> str:
+        """Serialize one `jax.stages.Compiled` under `key` (atomic)."""
+        from jax.experimental import serialize_executable
+
+        payload, in_tree, out_tree = serialize_executable.serialize(compiled)
+        doc = {
+            "schema": ARTIFACT_SCHEMA,
+            "meta": {"key": key.to_dict(), "env": current_environment()},
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        body = pickle.dumps(doc)
+        digest = hashlib.blake2b(body, digest_size=_DIGEST_SIZE).digest()
+        os.makedirs(self.root, exist_ok=True)
+        path = self.path_for(key)
+        # Unique tmp per saver (mkstemp, not path+'.tmp'): two replicas
+        # compile-and-refreshing the same missing bucket concurrently
+        # must not truncate each other's half-written file — each
+        # writes its own tmp and the atomic replace races are
+        # whole-file, so the published artifact is always complete.
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(_MAGIC)
+                fh.write(digest)
+                fh.write(body)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return path
+
+    # -- import ----------------------------------------------------------
+    def _read_doc(self, path: str) -> Optional[Dict[str, Any]]:
+        """Verified document, or None (warned) on any corruption."""
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            return None  # not present: plain miss, no warning
+        head = len(_MAGIC) + _DIGEST_SIZE
+        if len(blob) <= head or blob[: len(_MAGIC)] != _MAGIC:
+            warnings.warn(
+                f"{path}: not a fleet artifact (bad magic or truncated "
+                "header); falling back to compile", ArtifactWarning,
+                stacklevel=3)
+            return None
+        digest = blob[len(_MAGIC):head]
+        body = blob[head:]
+        if hashlib.blake2b(body, digest_size=_DIGEST_SIZE).digest() != digest:
+            warnings.warn(
+                f"{path}: artifact checksum mismatch (corrupt or "
+                "truncated); falling back to compile", ArtifactWarning,
+                stacklevel=3)
+            return None
+        try:
+            doc = pickle.loads(body)
+        except Exception as exc:
+            warnings.warn(
+                f"{path}: artifact body failed to unpickle ({exc!r}); "
+                "falling back to compile", ArtifactWarning, stacklevel=3)
+            return None
+        if not isinstance(doc, dict) or doc.get("schema") != ARTIFACT_SCHEMA:
+            warnings.warn(
+                f"{path}: unknown artifact schema "
+                f"{doc.get('schema') if isinstance(doc, dict) else doc!r}; "
+                "falling back to compile", ArtifactWarning, stacklevel=3)
+            return None
+        return doc
+
+    def load(self, key: ArtifactKey):
+        """`jax.stages.Compiled` for `key`, or None with a typed warning
+        naming why (corruption, version/backend mismatch, runtime
+        refusal) — the caller compiles instead, and a later `save`
+        refreshes the stale file in place."""
+        path = self.path_for(key)
+        doc = self._read_doc(path)
+        if doc is None:
+            return None
+        recorded = (doc.get("meta") or {}).get("env") or {}
+        env = current_environment()
+        mismatched = [
+            f"{name}={recorded.get(name)!r} (running {env[name]!r})"
+            for name in ("jax", "jaxlib", "backend")
+            if recorded.get(name) != env[name]
+        ]
+        if mismatched:
+            warnings.warn(
+                f"{path}: artifact was exported under a different "
+                f"environment — {', '.join(mismatched)}; falling back to "
+                "compile-and-refresh", ArtifactWarning, stacklevel=2)
+            return None
+        from jax.experimental import serialize_executable
+
+        try:
+            _prime_native_kernels()
+            return serialize_executable.deserialize_and_load(
+                doc["payload"], doc["in_tree"], doc["out_tree"])
+        except Exception as exc:
+            warnings.warn(
+                f"{path}: runtime refused the serialized executable "
+                f"({exc!r}); falling back to compile", ArtifactWarning,
+                stacklevel=2)
+            return None
+
+    # -- introspection ---------------------------------------------------
+    def entries(self) -> List[str]:
+        """Artifact filenames currently in the store (sorted)."""
+        try:
+            return sorted(n for n in os.listdir(self.root)
+                          if n.endswith(".megbaexe"))
+        except OSError:
+            return []
+
+    def content_digest(self, key: ArtifactKey) -> Optional[str]:
+        """blake2b hexdigest of the verified artifact BODY (the pinned
+        round-trip identity tests compare — a re-export of the same
+        executable under the same environment is byte-identical)."""
+        doc_path = self.path_for(key)
+        try:
+            with open(doc_path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            return None
+        head = len(_MAGIC) + _DIGEST_SIZE
+        if len(blob) <= head:
+            return None
+        return hashlib.blake2b(
+            blob[head:], digest_size=_DIGEST_SIZE).hexdigest()

@@ -356,6 +356,12 @@ def _solve_bucket(
                 "attempts": attempts,
                 "stats": stats.as_dict(),
             }
+            # Federation workers (serving/federation.py) tag every fleet
+            # report with their worker id, so a merged multi-worker
+            # telemetry stream stays attributable per host.
+            fed_worker = os.environ.get("MEGBA_FEDERATION_WORKER")
+            if fed_worker:
+                fleet["worker"] = fed_worker
             append_report(
                 build_report(report_option, lane_res,
                              _phase_delta(phases_before, timer.as_dict()),

@@ -22,6 +22,13 @@ One vmapped, jitted LM solve serves every problem of a shape bucket
 - Warmup manifests (`save_manifest` / `warm_from_manifest`) persist the
   observed buckets as JSON so a restarted service precompiles its whole
   working set before taking traffic.
+- An optional `ArtifactStore` (serving/artifacts.py) removes even the
+  restart compiles: `export_artifacts` serializes every AOT executable
+  this pool holds, and a pool constructed over the same store loads
+  them back — `warm`/`warm_from_manifest` then reach ready WITHOUT
+  tracing or compiling anything (millisecond cold start; a
+  version/fingerprint-mismatched or corrupt artifact falls back to
+  compile-and-refresh with a warning, never a wrong program).
 
 The AOT store is MODULE-level (shared by every pool instance in the
 process): two pools warming/dispatching the same bucket must reuse one
@@ -30,6 +37,7 @@ trace, or the retrace sentinel would rightly flag the duplicate.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import os
@@ -42,9 +50,67 @@ import numpy as np
 
 from megba_tpu.algo.lm import lm_solve
 from megba_tpu.analysis.retrace import static_key, traced
+from megba_tpu.serving.artifacts import ArtifactKey, ArtifactStore
 from megba_tpu.serving.shape_class import ShapeClass
 
 MANIFEST_SCHEMA = "megba_tpu.fleet_manifest/v1"
+
+
+class ManifestMismatch(ValueError):
+    """A warmup manifest's recorded option configuration does not match
+    the one the service is warming for, and the caller asked for
+    `strict=` refusal instead of the warn-and-recompile default.
+
+    `fields` names the mismatched option fields (dotted paths into the
+    ProblemOption tree) so an operator can see WHICH knob drifted
+    between the manifest's recording service and this replica.
+    """
+
+    def __init__(self, path: str, fields: List[str]) -> None:
+        self.path = path
+        self.fields = list(fields)
+        super().__init__(
+            f"{path}: manifest was recorded under a different option "
+            f"configuration (mismatched: {', '.join(self.fields)}); "
+            "refusing to warm under strict=True — re-export the manifest "
+            "for this configuration or drop strict to recompile")
+
+
+def _flatten_config(d: Any, prefix: str = "") -> Dict[str, Any]:
+    """Dotted-path flattening of a config_to_dict tree, for naming
+    exactly which option fields a stale manifest disagrees on."""
+    out: Dict[str, Any] = {}
+    if isinstance(d, dict):
+        for k, v in d.items():
+            out.update(_flatten_config(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = d
+    return out
+
+
+def _sans_telemetry(option):
+    """Strip the telemetry sink: programs (and therefore pool keys,
+    artifact fingerprints and manifests) are telemetry-agnostic by the
+    serving layer's contract — the dispatch path strips it before every
+    cache (batcher._strip_telemetry), so the warm/export paths must
+    key the same way or a sink-carrying option would warm programs
+    dispatch can never hit."""
+    if getattr(option, "telemetry", None) is not None:
+        import dataclasses as _dc
+
+        return _dc.replace(option, telemetry=None)
+    return option
+
+
+def _config_mismatches(recorded: Dict[str, Any],
+                       current: Dict[str, Any]) -> List[str]:
+    a, b = _flatten_config(recorded), _flatten_config(current)
+    # The telemetry sink never reaches a program (the serving layer
+    # strips it before every cache/build — batcher._strip_telemetry),
+    # so two services differing only in where they log warmed the SAME
+    # programs: not a mismatch.
+    return sorted(k for k in set(a) | set(b)
+                  if k != "telemetry" and a.get(k) != b.get(k))
 
 # (engine, option, shape, lanes, cd, pd, od) -> jax.stages.Compiled
 _AOT: Dict[Tuple, Any] = {}
@@ -53,7 +119,59 @@ _DISPATCHED: set = set()
 # keys a warm() is compiling right now (reservation against duplicate
 # AOT compiles when warms race each other)
 _WARMING: set = set()
+# keys whose _AOT entry was DESERIALIZED from an artifact: re-serializing
+# such an executable reproduces the persistent-cache hazard below, so
+# export skips them (the store already holds their good artifact).
+_FROM_ARTIFACT: set = set()
+# keys whose _AOT entry was compiled INSIDE _portable_compile_scope —
+# the only handles export may serialize as-is; anything else (possibly
+# satisfied from the persistent cache) is re-compiled portably first.
+_PORTABLE: set = set()
 _LOCK = threading.Lock()
+_COMPILE_SCOPE_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def _portable_compile_scope():
+    """Compile with the XLA persistent compile cache BYPASSED.
+
+    Probed jaxlib hazard (jax 0.4.37 / jaxlib 0.4.36, XLA:CPU): an
+    executable satisfied FROM the persistent compile cache re-serializes
+    into a blob missing its jitted object code — a fresh process
+    deserializing it fails with `INTERNAL: Symbols not found: [...]`.
+    A freshly compiled executable round-trips fine.  So every compile
+    whose result will be SERIALIZED into the artifact store runs inside
+    this scope: the compile is honestly fresh (full object code in the
+    blob) at the price of ignoring a possible disk hit — paid once per
+    export, saved on every replica that warms from the artifact.
+
+    The config flip is process-global, hence the scope lock: concurrent
+    warms serialize through here rather than racing the restore.  The
+    flip alone is NOT enough on this jax: the cache object and its
+    "is the cache used" decision are memoised at first use
+    (`compilation_cache._cache_checked`), so the scope also resets the
+    cache state on entry and exit — entry makes the disabled dir take
+    effect, exit lets the restored dir re-initialize lazily.
+    """
+    import jax
+
+    def _reset() -> None:
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # private API drifted: config flip still holds
+            pass
+
+    with _COMPILE_SCOPE_LOCK:
+        old = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset()
+        try:
+            yield
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
+            _reset()
 
 
 def _build_batched_solve(residual_jac_fn, option, faulted=False):
@@ -174,20 +292,79 @@ class CompilePool:
     and dies by.
     """
 
-    def __init__(self, stats=None) -> None:
+    def __init__(self, stats=None, artifacts=None, timer=None) -> None:
         self._stats = stats
         self._seen: Dict[Tuple, Dict[str, Any]] = {}  # key -> manifest entry
         self._lock = threading.Lock()
+        # `artifacts` — an ArtifactStore (or its root path) of serialized
+        # executables (serving/artifacts.py): warm()/program() try the
+        # store before compiling, and `export_artifacts` fills it.
+        if isinstance(artifacts, str):
+            artifacts = ArtifactStore(artifacts)
+        self.artifacts: Optional[ArtifactStore] = artifacts
+        # `timer` (utils.timing.PhaseTimer) — cold-start observability:
+        # artifact loads vs compiles land as `artifact_load` /
+        # `warm_compile` phases with real wall clock.
+        self._timer = timer
+
+    def _artifact_key(self, engine, option, shape: ShapeClass, lanes: int,
+                      cd: int, pd: int, od: int,
+                      faulted: bool) -> ArtifactKey:
+        return ArtifactKey(
+            option_fingerprint=static_key(engine, option),
+            shape=str(shape), lanes=int(lanes), cd=int(cd), pd=int(pd),
+            od=int(od), faulted=bool(faulted))
+
+    def _try_artifact(self, key: Tuple, akey: ArtifactKey):
+        """Install `akey`'s serialized executable under `key` if the
+        store holds a valid one; returns it (or None).  Reserves the key
+        against concurrent warms exactly like the compile path."""
+        if self.artifacts is None:
+            return None
+        with _LOCK:
+            existing = _AOT.get(key)
+            if existing is not None:
+                return existing
+            if key in _WARMING:
+                return None  # a compile is already racing; let it win
+            _WARMING.add(key)
+        compiled = None
+        try:
+            ctx = (self._timer.phase("artifact_load")
+                   if self._timer is not None else contextlib.nullcontext())
+            with ctx:
+                compiled = self.artifacts.load(akey)
+            if compiled is not None:
+                with _LOCK:
+                    _AOT[key] = compiled
+                    _FROM_ARTIFACT.add(key)
+        finally:
+            with _LOCK:
+                _WARMING.discard(key)
+        if compiled is not None and self._stats is not None:
+            self._stats.record_artifact(True)
+        return compiled
 
     # -- dispatch path ---------------------------------------------------
     def program(self, engine, option, shape: ShapeClass, lanes: int,
                 cd: int, pd: int, od: int, faulted: bool = False):
         """Callable for one bucket; prefers the AOT executable."""
+        option = _sans_telemetry(option)
         key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
         self._note(key, shape, lanes, cd, pd, od, faulted)
         with _LOCK:
             compiled = _AOT.get(key)
             hit = compiled is not None or key in _DISPATCHED
+        if compiled is None and not hit and self.artifacts is not None:
+            # Dispatch-path artifact fallback: a bucket this pool never
+            # warmed may still exist serialized (another replica's
+            # export, a previous life of this one) — loading it here is
+            # still compile-free and counts as a pool hit: the request
+            # rides an already-built executable.
+            compiled = self._try_artifact(
+                key, self._artifact_key(engine, option, shape, lanes,
+                                        cd, pd, od, faulted))
+            hit = compiled is not None
         if self._stats is not None:
             self._stats.record_pool(hit)
         if compiled is not None:
@@ -212,7 +389,12 @@ class CompilePool:
 
         `entries` are manifest-entry dicts ({"shape": {...}, "lanes": n,
         "cd": .., "pd": .., "od": ..}).  Buckets already in the AOT
-        store are skipped (idempotent warmup)."""
+        store are skipped (idempotent warmup).  With an `ArtifactStore`
+        attached, each bucket first tries a serialized-executable load —
+        compile-free, I/O-bound — and only a miss (or a stale/corrupt
+        artifact, which warns) pays the trace + XLA compile; freshly
+        compiled programs are saved back so the store heals itself."""
+        option = _sans_telemetry(option)
         built = 0
         for e in entries:
             shape = ShapeClass.from_dict(e["shape"])
@@ -222,20 +404,119 @@ class CompilePool:
             faulted = bool(e.get("faulted", False))
             key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
             self._note(key, shape, lanes, cd, pd, od, faulted)
+            akey = self._artifact_key(engine, option, shape, lanes, cd,
+                                      pd, od, faulted)
+            with _LOCK:
+                already = key in _AOT or key in _DISPATCHED
+            if already:
+                continue
+            if self._try_artifact(key, akey) is not None:
+                built += 1
+                continue
             with _LOCK:
                 if key in _AOT or key in _DISPATCHED or key in _WARMING:
                     continue
                 _WARMING.add(key)
             try:
-                compiled = lower_bucket(engine, option, shape, lanes,
-                                        cd, pd, od, faulted).compile()
+                # With a store attached this compile's executable will
+                # be serialized — bypass the persistent compile cache so
+                # the blob is portable (see _portable_compile_scope).
+                scope = (_portable_compile_scope() if self.artifacts
+                         is not None else contextlib.nullcontext())
+                timing = (self._timer.phase("warm_compile")
+                          if self._timer is not None
+                          else contextlib.nullcontext())
+                with scope, timing:
+                    compiled = lower_bucket(
+                        engine, option, shape, lanes, cd, pd, od,
+                        faulted).compile()
                 with _LOCK:
                     _AOT[key] = compiled
+                    if self.artifacts is not None:
+                        _PORTABLE.add(key)
             finally:
                 with _LOCK:
                     _WARMING.discard(key)
+            if self.artifacts is not None:
+                # The artifact counters describe the STORE's cold-start
+                # split; a store-less warm is plain AOT compilation and
+                # must not report misses against a store that does not
+                # exist.
+                if self._stats is not None:
+                    self._stats.record_artifact(False)
+                # Compile-and-refresh: the miss (or stale file) is now a
+                # valid artifact for the next replica — best-effort,
+                # because the compiled program in hand must win over a
+                # read-only/full shared store (the degrade contract:
+                # fall back to compile, never fail the warm).
+                try:
+                    self.artifacts.save(akey, compiled)
+                except Exception as exc:  # serializer refusal, I/O, ...
+                    from megba_tpu.serving.artifacts import ArtifactWarning
+
+                    warnings.warn(
+                        f"could not refresh artifact for {shape} "
+                        f"(lanes={lanes}): {exc!r}; the compiled program "
+                        "is warm in-process, the store keeps its stale "
+                        "entry", ArtifactWarning, stacklevel=2)
             built += 1
         return built
+
+    def export_artifacts(self, engine, option,
+                         compile_missing: bool = True) -> int:
+        """Serialize every bucket this pool has seen for (engine,
+        option) into the attached store; returns how many were written.
+        The exporting service pairs this with `save_manifest`: the
+        manifest names the working set, the artifacts make warming it
+        compile-free.
+
+        Buckets that went jit-cache hot through DISPATCH hold no
+        `Compiled` handle to serialize; with `compile_missing` (the
+        default) they are AOT-compiled here — one extra trace per such
+        bucket, identical signature.  ALL export compiles bypass the
+        persistent compile cache (`_portable_compile_scope`: a
+        cache-satisfied executable serializes into a blob a fresh
+        process cannot load — the probed "Symbols not found" jaxlib
+        hazard), and for the same reason EVERY seen bucket is
+        re-compiled here unless its `_AOT` handle is known
+        fresh-compiled: warm()-built handles with a store attached
+        qualify, artifact-LOADED handles are skipped (the store already
+        holds their good blob).  Export is an OFFLINE operation (a
+        service checkpointing its working set), so the compile cost and
+        re-traces are paid off the request path; a retrace-sentinel
+        window around an export should `allow()` the duplicates
+        explicitly."""
+        if self.artifacts is None:
+            raise ValueError("CompilePool has no ArtifactStore attached")
+        option = _sans_telemetry(option)
+        written = 0
+        for e in self.entries():
+            shape = ShapeClass.from_dict(e["shape"])
+            lanes = int(e["lanes"])
+            cd, pd, od = int(e.get("cd", 9)), int(e.get("pd", 3)), \
+                int(e.get("od", 2))
+            faulted = bool(e.get("faulted", False))
+            key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
+            with _LOCK:
+                compiled = _AOT.get(key)
+                from_artifact = key in _FROM_ARTIFACT
+                portable = key in _PORTABLE
+            if from_artifact:
+                continue  # its portable blob is already in the store
+            if compiled is None or not portable:
+                if not compile_missing:
+                    continue
+                with _portable_compile_scope():
+                    compiled = lower_bucket(engine, option, shape, lanes,
+                                            cd, pd, od, faulted).compile()
+                with _LOCK:
+                    _AOT[key] = compiled
+                    _PORTABLE.add(key)
+            self.artifacts.save(
+                self._artifact_key(engine, option, shape, lanes, cd, pd,
+                                   od, faulted), compiled)
+            written += 1
+        return written
 
     # -- manifests -------------------------------------------------------
     def _note(self, key: Tuple, shape: ShapeClass, lanes: int, cd: int,
@@ -254,10 +535,22 @@ class CompilePool:
             return [dict(v) for v in self._seen.values()]
 
     def save_manifest(self, path: str, option=None) -> None:
-        """Persist every bucket this pool has seen (atomic write)."""
+        """Persist every bucket this pool has seen (atomic write).
+
+        Alongside the opaque option fingerprint, the manifest records a
+        STRUCTURED `option_config` (observability.report.config_to_dict)
+        so a mismatch on load can name the exact fields that drifted —
+        the `strict=` refusal path needs names, not just inequality."""
+        option_config = None
+        if option is not None:
+            option = _sans_telemetry(option)
+            from megba_tpu.observability.report import config_to_dict
+
+            option_config = config_to_dict(option)
         doc = {
             "schema": MANIFEST_SCHEMA,
             "option": None if option is None else static_key(option),
+            "option_config": option_config,
             "entries": self.entries(),
         }
         parent = os.path.dirname(os.path.abspath(path))
@@ -267,23 +560,62 @@ class CompilePool:
             json.dump(doc, fh, indent=1, sort_keys=True)
         os.replace(tmp, path)
 
-    def warm_from_manifest(self, path: str, engine, option) -> int:
-        """Load a manifest and AOT-compile its buckets for `option`.
+    def warm_from_manifest(self, path: str, engine, option,
+                           strict: bool = False) -> int:
+        """Load a manifest and warm its buckets for `option` (artifact
+        load when a store is attached, AOT compile otherwise).
 
         A manifest recorded under a different option fingerprint still
         names valid SHAPES, but the programs it warmed are not the ones
-        this service will run — warn and compile for the given option
-        anyway (the shapes are the expensive knowledge)."""
+        this service will run — by default, warn and compile for the
+        given option anyway (the shapes are the expensive knowledge).
+        `strict=True` REFUSES instead with a typed `ManifestMismatch`
+        naming the drifted fields: a federation worker warming from a
+        shared artifact store must not silently recompile every bucket
+        (its cold-start contract is I/O-bound) just because the exporter
+        ran one knob off."""
         with open(path) as fh:
             doc = json.load(fh)
         if doc.get("schema") != MANIFEST_SCHEMA:
             raise ValueError(
                 f"{path}: not a fleet warmup manifest "
                 f"(schema={doc.get('schema')!r})")
+        # Compare telemetry-stripped: the sink path is not part of any
+        # program (see _config_mismatches) and would otherwise make two
+        # identical services look mismatched.
+        compare_option = _sans_telemetry(option)
         recorded = doc.get("option")
-        if recorded is not None and recorded != static_key(option):
+        if recorded is not None and recorded != static_key(compare_option):
+            recorded_config = doc.get("option_config")
+            if recorded_config is not None:
+                from megba_tpu.observability.report import config_to_dict
+
+                fields = _config_mismatches(recorded_config,
+                                            config_to_dict(option))
+            else:
+                # Pre-strict manifests carry only the opaque fingerprint.
+                fields = ["<option fingerprint; manifest predates "
+                          "structured option_config>"]
+            if strict:
+                raise ManifestMismatch(path, fields)
             warnings.warn(
                 f"{path}: manifest was recorded under a different option "
-                "configuration; warming its shape classes for the current "
-                "options", stacklevel=2)
+                f"configuration (mismatched: {', '.join(fields)}); "
+                "warming its shape classes for the current options",
+                stacklevel=2)
         return self.warm(engine, option, doc.get("entries", ()))
+
+
+def reset_process_cache() -> None:
+    """Drop every process-level compiled-program handle (_AOT store,
+    dispatched-key set, in-flight warms).  This does NOT clear jax's own
+    jit caches — it simulates a FRESH REPLICA's compile-pool state so a
+    single process can certify the artifact path (load → dispatch with
+    zero traces) that normally spans an export process and an import
+    process.  Test/benchmark helper; a real service never needs it."""
+    with _LOCK:
+        _AOT.clear()
+        _DISPATCHED.clear()
+        _WARMING.clear()
+        _FROM_ARTIFACT.clear()
+        _PORTABLE.clear()

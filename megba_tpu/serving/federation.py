@@ -1,0 +1,1242 @@
+"""Federation tier: one router, N worker processes, a fleet of fleets.
+
+`solve_many` / `FleetQueue` saturate ONE host; this module is the
+scale-out story (ROADMAP item 2): a `FleetRouter` fronting N worker
+PROCESSES, each running the whole single-host serving stack (compile
+pool + batched mega-solves) behind a small length-prefixed pickle RPC
+over its stdin/stdout pipes — the same subprocess discipline as the
+kill-resume harness (robustness/harness.py): workers are real
+processes that really die, stderr is the log channel, and the RPC
+channel carries nothing but frames.
+
+Three connected mechanisms:
+
+- **Shape-class routing, occupancy-aware.**  Problems shard across
+  workers BY SHAPE CLASS, not round-robin: all problems of one bucket
+  flow to one worker until stolen or rerouted, so per-host bucket
+  occupancy stays high (padding waste — which `FleetStats` measures —
+  is paid per DISPATCH; splitting a bucket across hosts would pay it
+  twice at half the lane fill).  A new class lands on the worker that
+  already has it WARM (artifact-loaded executables first), then the
+  least-loaded worker (`RoutingTable`, a pure host policy class).
+
+- **Work-stealing for hot buckets.**  An idle worker pulls queued
+  problems for buckets IT HAS WARM from the deepest backlog of a busy
+  peer — before it would compile anything new.  Stealing moves work,
+  never assignments: the hot bucket keeps its home, the thief drains
+  overflow with a program it already holds (typically loaded from the
+  shared `ArtifactStore` in milliseconds).
+
+- **Host-loss rerouting.**  A dead worker is a dispatch exception plus
+  a requeue, exactly the PR 8 retry-ladder stance: liveness is PR 9's
+  `HeartbeatBoard` (workers beat heartbeat files; the router observes
+  counter changes on its own clock) plus pipe-EOF/process-exit
+  detection, a loss is a typed `WorkerLostError`, the lost worker's
+  in-flight and queued problems re-route to survivors with bounded
+  `max_reroutes` and `worker_lost`/`rerouted` counters — never
+  silently, never wedging `flush()`.
+
+Cold start is the third leg (serving/artifacts.py): workers warm from
+a manifest + serialized-executable store, so a fresh replica's
+cold-start-to-first-solve is I/O-bound — its first fleet dispatches
+with ZERO traces (the worker certifies this against the retrace
+sentinel and reports the count in its hello).
+
+Everything host-side here is plain threads, pipes and pickle — no new
+collectives, no device code; the workers' solve programs are byte-wise
+the single-host ones, so a federated fleet's results are BITWISE the
+`solve_many` results at the same shape classes (padding exactness,
+PR 6) no matter how routing, stealing or rerouting scattered them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import select
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from concurrent.futures import Future, InvalidStateError
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from megba_tpu.serving.resilience import DeadlineExceeded
+
+_LEN = struct.Struct(">Q")
+_MAX_FRAME = 1 << 34  # 16 GiB: a corrupted length header fails fast
+
+
+class FrameError(ConnectionError):
+    """The RPC stream ended or produced a malformed frame."""
+
+
+class WorkerLostError(RuntimeError):
+    """A federation worker died (or stopped beating) with work on it.
+
+    `worker_id` names the worker, `reason` what was observed (pipe EOF,
+    process exit code, heartbeat staleness).  Problems that exhaust
+    `max_reroutes` across successive losses fail with this error — the
+    caller sees WHY, never a hang.
+    """
+
+    def __init__(self, worker_id: str, reason: str) -> None:
+        self.worker_id = worker_id
+        self.reason = reason
+        super().__init__(f"federation worker {worker_id!r} lost: {reason}")
+
+
+# ---------------------------------------------------------------------------
+# Length-prefixed pickle frames over pipes
+# ---------------------------------------------------------------------------
+
+
+class FrameChannel:
+    """One duplex frame stream over a (read fd, write file) pair.
+
+    Frames are `>Q` length + pickle.  `recv` reads the UNDERLYING fd
+    directly (private buffer, never a BufferedReader) so the
+    select-based timeout/poll path can never stall on bytes hidden in a
+    Python-level buffer.  `poll` is called between read slices and may
+    raise to abort the wait (the router's liveness hook)."""
+
+    def __init__(self, rfile, wfile) -> None:
+        self._rfd = rfile.fileno()
+        self._rfile = rfile  # owned: kept for close()
+        self._wfile = wfile
+        self._buf = bytearray()
+        self._slice_s = 0.05
+
+    def send(self, obj: Any) -> None:
+        body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self._wfile.write(_LEN.pack(len(body)) + body)
+        self._wfile.flush()
+
+    def _fill(self, need: int, deadline: Optional[float],
+              poll: Optional[Callable[[], None]]) -> None:
+        while len(self._buf) < need:
+            if poll is not None:
+                poll()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("no complete frame within the budget")
+            ready, _, _ = select.select([self._rfd], [], [], self._slice_s)
+            if not ready:
+                continue
+            chunk = os.read(self._rfd, 1 << 20)
+            if not chunk:
+                raise FrameError("stream closed mid-frame"
+                                 if self._buf else "stream closed")
+            self._buf.extend(chunk)
+
+    def recv(self, timeout_s: Optional[float] = None,
+             poll: Optional[Callable[[], None]] = None) -> Any:
+        # ONE deadline spans header + body: a worker stalling between
+        # the two must not double the effective watchdog budget.
+        deadline = None if timeout_s is None else (
+            time.monotonic() + timeout_s)
+        self._fill(_LEN.size, deadline, poll)
+        (length,) = _LEN.unpack(bytes(self._buf[:_LEN.size]))
+        if length > _MAX_FRAME:
+            raise FrameError(f"frame length {length} exceeds sanity cap")
+        del self._buf[:_LEN.size]
+        self._fill(length, deadline, poll)
+        body = bytes(self._buf[:length])
+        del self._buf[:length]
+        return pickle.loads(body)
+
+    def close(self) -> None:
+        for f in (self._rfile, self._wfile):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Routing policy (pure host state, unit-testable without processes)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WorkerView:
+    """What the routing policy may know about one worker."""
+
+    worker_id: str
+    warm: set  # bucket strs with a ready (artifact/compiled) program
+    alive: bool = True
+    assigned: set = dataclasses.field(default_factory=set)  # bucket strs
+    routed: int = 0  # problems ever routed here (load tiebreak)
+
+
+class RoutingTable:
+    """Shape-class → worker assignment with warm-first affinity.
+
+    Policy, in order: (1) sticky — a bucket keeps its worker while that
+    worker lives (occupancy: one home per bucket fills lanes instead of
+    splitting them); (2) warm-first — a NEW bucket goes to a live
+    worker that already holds its program (artifact-loaded executables
+    make this common after one export cycle); (3) least-loaded — fewest
+    assigned buckets, then fewest routed problems, then worker id (a
+    deterministic tiebreak so tests and reruns route identically).
+
+    `steal_candidate` picks what an idle worker should pull: the
+    DEEPEST backlog among buckets homed on other live workers that the
+    thief has WARM — it never volunteers a bucket it would have to
+    compile for (that would trade queueing delay for compile delay).
+
+    Pure host state over caller-supplied views; the router drives it
+    under its own lock.
+    """
+
+    def __init__(self) -> None:
+        self.assignment: Dict[str, str] = {}  # bucket str -> worker id
+
+    def route(self, bucket: str,
+              workers: Dict[str, WorkerView]) -> Optional[str]:
+        homed = self.assignment.get(bucket)
+        if homed is not None and workers[homed].alive:
+            return homed
+        alive = [w for w in workers.values() if w.alive]
+        if not alive:
+            return None
+        warm = [w for w in alive if bucket in w.warm]
+        pool = warm or alive
+        best = min(pool, key=lambda w: (len(w.assigned), w.routed,
+                                        w.worker_id))
+        self.assignment[bucket] = best.worker_id
+        best.assigned.add(bucket)
+        return best.worker_id
+
+    def steal_candidate(self, thief: str, workers: Dict[str, WorkerView],
+                        depths: Dict[str, int]) -> Optional[str]:
+        """Bucket the idle `thief` should pull work from, or None."""
+        view = workers[thief]
+        candidates = [
+            (depth, bucket) for bucket, depth in depths.items()
+            if depth > 0 and bucket in view.warm
+            and self.assignment.get(bucket) not in (None, thief)
+            and workers[self.assignment[bucket]].alive
+        ]
+        if not candidates:
+            return None
+        _, bucket = max(candidates, key=lambda c: (c[0], c[1]))
+        return bucket
+
+    def reassign_lost(self, lost: str,
+                      workers: Dict[str, WorkerView]) -> List[str]:
+        """Forget every bucket homed on `lost`; they re-route on next
+        pick.  Returns the orphaned bucket names."""
+        orphaned = [b for b, w in self.assignment.items() if w == lost]
+        for b in orphaned:
+            del self.assignment[b]
+        if lost in workers:
+            workers[lost].assigned.clear()
+        return orphaned
+
+
+# ---------------------------------------------------------------------------
+# Federation stats
+# ---------------------------------------------------------------------------
+
+
+class FederationStats:
+    """Router-level counters: where problems ran, what moved, what died.
+
+    The per-worker `FleetStats` still live inside each worker (their
+    dispatch telemetry embeds them); this object is the ROUTER's view —
+    the one `summarize --aggregate`'s federation block renders."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.router = uuid.uuid4().hex[:12]
+        self.problems = 0  # problems resolved through the router
+        self.problems_by_worker: Dict[str, int] = {}
+        self.steals = 0  # steal events (one per pulled batch)
+        self.stolen_problems = 0  # problems moved by steals
+        self.reroutes = 0  # problems requeued off a lost worker
+        self.reroute_failures = 0  # problems that exhausted max_reroutes
+        self.workers_lost = 0
+        self.sheds = 0  # deadline-expired problems shed before dispatch
+        self.deadline_misses = 0  # results delivered AFTER their deadline
+        self.cold_start: Dict[str, Dict[str, Any]] = {}  # worker -> hello
+        self.first_solve: Dict[str, Dict[str, Any]] = {}
+        self.lost_workers: List[str] = []
+
+    def record_batch(self, worker_id: str, n: int, stolen: bool) -> None:
+        with self._lock:
+            self.problems += n
+            self.problems_by_worker[worker_id] = (
+                self.problems_by_worker.get(worker_id, 0) + n)
+            if stolen:
+                self.steals += 1
+                self.stolen_problems += n
+
+    def record_reroute(self, n: int) -> None:
+        with self._lock:
+            self.reroutes += n
+
+    def record_reroute_failure(self, n: int = 1) -> None:
+        with self._lock:
+            self.reroute_failures += n
+
+    def record_worker_lost(self, worker_id: str) -> None:
+        with self._lock:
+            self.workers_lost += 1
+            self.lost_workers.append(worker_id)
+
+    def record_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.sheds += n
+
+    def record_deadline_miss(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_misses += n
+
+    def record_cold_start(self, worker_id: str,
+                          info: Dict[str, Any]) -> None:
+        with self._lock:
+            self.cold_start[worker_id] = dict(info)
+
+    def record_first_solve(self, worker_id: str,
+                           info: Dict[str, Any]) -> None:
+        with self._lock:
+            self.first_solve[worker_id] = dict(info)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "router": self.router,
+                "problems": self.problems,
+                "problems_by_worker": dict(self.problems_by_worker),
+                "steals": self.steals,
+                "stolen_problems": self.stolen_problems,
+                "reroutes": self.reroutes,
+                "reroute_failures": self.reroute_failures,
+                "workers_lost": self.workers_lost,
+                "lost_workers": list(self.lost_workers),
+                "sheds": self.sheds,
+                "deadline_misses": self.deadline_misses,
+                "cold_start": {k: dict(v)
+                               for k, v in self.cold_start.items()},
+                "first_solve": {k: dict(v)
+                                for k, v in self.first_solve.items()},
+            }
+
+    def report(self) -> str:
+        d = self.as_dict()
+        per = " / ".join(
+            f"{w}:{n}" for w, n in sorted(d["problems_by_worker"].items()))
+        lines = [
+            f"federation: {d['problems']} problems ({per or 'none'}), "
+            f"{d['steals']} steals ({d['stolen_problems']} problems), "
+            f"{d['reroutes']} rerouted, {d['workers_lost']} workers lost"]
+        for w, cs in sorted(d["cold_start"].items()):
+            fs = d["first_solve"].get(w) or {}
+            lines.append(
+                f"  {w}: cold start {cs.get('mode', '?')} "
+                f"{cs.get('warm_s', float('nan')):.3f}s "
+                f"({cs.get('artifact_loads', 0)} loaded / "
+                f"{cs.get('artifact_compiles', 0)} compiled)"
+                + (f", first solve {fs.get('traces')} traces"
+                   if fs else ""))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Worker process (the --worker entry point)
+# ---------------------------------------------------------------------------
+
+
+def _worker_main() -> int:
+    """Run one federation worker: frames in on fd 0, frames out on the
+    ORIGINAL fd 1; fd 1 is then pointed at stderr so any stray print
+    from a library can never corrupt the frame stream."""
+    rpc_in = os.fdopen(os.dup(0), "rb", buffering=0)
+    rpc_out = os.fdopen(os.dup(1), "wb", buffering=0)
+    os.dup2(2, 1)
+    chan = FrameChannel(rpc_in, rpc_out)
+
+    cfg = chan.recv()
+    if cfg.get("op") != "config":
+        chan.send({"ok": False, "error": f"expected config, got {cfg!r}"})
+        return 2
+    worker_id = cfg["worker_id"]
+    # Tag this process's fleet telemetry with the worker id BEFORE any
+    # serving import reads it (batcher reads it per report).
+    os.environ["MEGBA_FEDERATION_WORKER"] = worker_id
+    # CPU pinning (router `pin_cpus=`): restrict this worker to its core
+    # slice BEFORE the first dispatch, so the lazily-built XLA:CPU
+    # thread pool's threads inherit the affinity — N workers then run
+    # true data-parallel instead of thrashing one shared pool.
+    affinity = cfg.get("cpu_affinity")
+    if affinity:
+        try:
+            os.sched_setaffinity(0, set(int(c) for c in affinity))
+        except (AttributeError, OSError):  # non-Linux / restricted
+            pass
+
+    from megba_tpu.analysis import retrace
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving.batcher import solve_many
+    from megba_tpu.serving.compile_pool import CompilePool
+    from megba_tpu.serving.stats import FleetStats
+    from megba_tpu.utils.timing import PhaseTimer
+
+    # `option` (telemetry-STRIPPED) feeds warmup and fingerprints — the
+    # program caches are telemetry-agnostic by contract; `solve_option`
+    # carries this worker's sink into solve_many, which strips it again
+    # before touching any cache, so warm and dispatch agree on keys.
+    option = dataclasses.replace(cfg["option"], telemetry=None)
+    ladder = cfg.get("ladder")
+    stats = FleetStats()
+    timer = PhaseTimer()
+    pool = CompilePool(stats=stats, artifacts=cfg.get("artifacts"),
+                       timer=timer)
+    engine = make_residual_jacobian_fn(mode=option.jacobian_mode)
+    telemetry = cfg.get("telemetry")
+    solve_option = (dataclasses.replace(option, telemetry=telemetry)
+                    if telemetry else option)
+
+    # Heartbeat: PR 9's liveness board, beaten from a daemon thread.
+    hb = cfg.get("heartbeat")
+    if hb:
+        from megba_tpu.robustness.elastic import HeartbeatBoard
+
+        board = HeartbeatBoard(hb["dir"], int(hb["rank"]),
+                               int(hb["world"]))
+        interval = float(hb.get("interval_s", 0.25))
+
+        def _beat() -> None:
+            while True:
+                board.beat()
+                time.sleep(interval)
+
+        threading.Thread(target=_beat, daemon=True,
+                         name="megba-fed-heartbeat").start()
+
+    # Cold start: warm the manifest's buckets (artifact-load when the
+    # store holds them, compile otherwise) and report the split.
+    t0 = time.perf_counter()
+    warmed = 0
+    try:
+        if cfg.get("manifest"):
+            warmed = pool.warm_from_manifest(
+                cfg["manifest"], engine, option,
+                strict=bool(cfg.get("strict_manifest", False)))
+    except Exception as exc:
+        chan.send({"ok": False, "error": repr(exc),
+                   "worker_id": worker_id})
+        return 3
+    warm_s = time.perf_counter() - t0
+    loads = stats.artifact_loads
+    # Store-less warms compile without touching the artifact counters
+    # (they describe a store that must exist) — the timer's phase count
+    # is the mode signal either way.
+    compiles = timer.counts.get("warm_compile", 0)
+    mode = ("artifact" if loads and not compiles
+            else "compile" if compiles else "cold")
+    warm_set = sorted({str(_shape_of(e)) for e in pool.entries()})
+    chan.send({
+        "ok": True, "op": "hello", "worker_id": worker_id,
+        "pid": os.getpid(), "warm": warm_set, "warmed": warmed,
+        "cold_start": {
+            "mode": mode, "warm_s": warm_s, "buckets": warmed,
+            "artifact_loads": loads, "artifact_compiles": compiles,
+            "phases": timer.as_dict(),
+        },
+    })
+
+    first_solve: Optional[Dict[str, Any]] = None
+    while True:
+        try:
+            req = chan.recv()
+        except FrameError:
+            return 0  # router went away: a worker has no work without it
+        op = req.get("op")
+        if op == "shutdown":
+            chan.send({"ok": True})
+            return 0
+        if op == "stats":
+            chan.send({"ok": True, "stats": stats.as_dict(),
+                       "phases": timer.as_dict()})
+            continue
+        if op != "solve":
+            chan.send({"ok": False, "error": f"unknown op {op!r}"})
+            continue
+        problems = req["problems"]
+        try:
+            base = retrace.snapshot()
+            t0 = time.perf_counter()
+            results = solve_many(problems, solve_option, ladder=ladder,
+                                 pool=pool, stats=stats, timer=timer)
+            wall = time.perf_counter() - t0
+            if first_solve is None:
+                traces = sum(
+                    v - base.get(k, 0)
+                    for k, v in retrace.snapshot().items()
+                    if k[0].startswith("serving.batched")
+                    and v > base.get(k, 0))
+                first_solve = {"traces": int(traces), "wall_s": wall,
+                               "problems": len(problems)}
+            # Traces are per-iteration device history — large, and the
+            # router's callers read costs/params/status; telemetry (the
+            # per-problem SolveReports written ABOVE, worker-side)
+            # already persisted them for whoever wants forensics.
+            slim = [dataclasses.replace(r, trace=None) for r in results]
+            chan.send({
+                "ok": True, "results": slim,
+                "warm": sorted({str(_shape_of(e))
+                                for e in pool.entries()}),
+                "first_solve": first_solve,
+            })
+        except Exception as exc:  # solve failed: typed reply, keep serving
+            import traceback
+
+            chan.send({"ok": False, "error": repr(exc),
+                       "traceback": traceback.format_exc()})
+
+
+def _shape_of(entry: Dict[str, Any]):
+    from megba_tpu.serving.shape_class import ShapeClass
+
+    return ShapeClass.from_dict(entry["shape"])
+
+
+# ---------------------------------------------------------------------------
+# Router-side worker handle
+# ---------------------------------------------------------------------------
+
+
+class WorkerHandle:
+    """One spawned worker: process + channel + router-side bookkeeping.
+
+    `request` is strictly lockstep (one outstanding request per worker;
+    each worker is driven by exactly one router thread) and converts
+    every death signal — pipe EOF, process exit, heartbeat DEAD — into
+    a typed `WorkerLostError`."""
+
+    def __init__(self, worker_id: str, proc: subprocess.Popen,
+                 chan: FrameChannel, log_path: str,
+                 liveness: Optional[Callable[[], Optional[str]]] = None,
+                 ) -> None:
+        self.worker_id = worker_id
+        self.proc = proc
+        self.chan = chan
+        self.log_path = log_path
+        self.liveness = liveness
+        self.warm: set = set()
+        self.alive = True
+        self.pid = proc.pid
+        self.rank = 0  # heartbeat-board rank, set by the router at spawn
+
+    def _poll(self) -> None:
+        rc = self.proc.poll()
+        if rc is not None:
+            raise WorkerLostError(self.worker_id,
+                                  f"process exited rc={rc}")
+        if self.liveness is not None:
+            reason = self.liveness()
+            if reason:
+                raise WorkerLostError(self.worker_id, reason)
+
+    def request(self, msg: Dict[str, Any],
+                timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        try:
+            self.chan.send(msg)
+            return self.chan.recv(timeout_s=timeout_s, poll=self._poll)
+        except (FrameError, BrokenPipeError, OSError) as exc:
+            rc = self.proc.poll()
+            raise WorkerLostError(
+                self.worker_id,
+                f"rpc stream broke ({type(exc).__name__}: {exc}); "
+                f"process rc={rc}") from exc
+
+    def log_tail(self, max_bytes: int = 8192) -> str:
+        try:
+            with open(self.log_path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(size - max_bytes, 0))
+                return fh.read().decode(errors="replace")
+        except OSError:
+            return "<no worker log>"
+
+    def terminate(self) -> None:
+        self.alive = False
+        self.chan.close()
+        if self.proc.poll() is None:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# The router
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(eq=False)
+class _Routed:
+    problem: Any  # FleetProblem
+    future: Future
+    bucket: str  # shape-class str (routing granularity)
+    key: Tuple  # (ShapeClass, dims) — batching granularity
+    enqueued: float
+    deadline: Optional[float] = None
+    reroutes: int = 0
+
+
+class FleetRouter:
+    """Front door of the federation tier: submit → Future, N workers.
+
+    Mirrors `FleetQueue`'s surface (submit/flush/close/context-manager,
+    Future-per-problem) one level up: submissions shard across worker
+    PROCESSES by shape class, idle workers steal hot buckets they have
+    warm, and a dead worker's problems re-route to survivors (bounded
+    by `max_reroutes`) with typed counters.  `artifacts` + `manifest`
+    give workers the millisecond cold start (serving/artifacts.py);
+    without them workers compile on first warm like any fresh service.
+
+    `workers=` injects pre-built worker handles (anything with
+    `worker_id`/`warm`/`alive`/`request`/`terminate`) — the unit tests
+    drive the full routing/steal/reroute machinery through in-process
+    stubs with zero subprocesses and zero compiles.
+    """
+
+    def __init__(
+        self,
+        option=None,
+        *,
+        n_workers: int = 2,
+        max_batch: int = 16,
+        ladder=None,
+        artifacts: Optional[str] = None,
+        manifest: Optional[str] = None,
+        strict_manifest: bool = False,
+        stats: Optional[FederationStats] = None,
+        timer=None,
+        steal: bool = True,
+        max_reroutes: int = 2,
+        heartbeat_dir: Optional[str] = None,
+        dead_after_s: float = 5.0,
+        warm_timeout_s: float = 1800.0,
+        watchdog_s: float = 1800.0,
+        telemetry: Optional[str] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+        pin_cpus: bool = False,
+        workers: Optional[Sequence[Any]] = None,
+    ) -> None:
+        from megba_tpu.common import ProblemOption
+        from megba_tpu.serving.batcher import _check_option
+        from megba_tpu.serving.shape_class import BucketLadder
+        from megba_tpu.utils.timing import PhaseTimer
+
+        option = option or ProblemOption()
+        _check_option(option)
+        if n_workers < 1 and workers is None:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        if max_reroutes < 0:
+            raise ValueError(
+                f"max_reroutes must be >= 0, got {max_reroutes}")
+        self.option = option
+        self.ladder = ladder or BucketLadder()
+        self.max_batch = int(max_batch)
+        self.steal = bool(steal)
+        self.max_reroutes = int(max_reroutes)
+        self.watchdog_s = float(watchdog_s)
+        self.stats = stats or FederationStats()
+        self.timer = PhaseTimer() if timer is None else timer
+        self.telemetry = telemetry
+
+        self._lock = threading.Condition()
+        self._pending: Dict[Tuple, List[_Routed]] = {}
+        self._npending = 0
+        self._closed = False
+        self.pinned = False  # did worker CPU pinning actually apply?
+        self._own_hb_dir: Optional[str] = None
+        # Deadline-carrying items currently pending: the shed scan is
+        # O(pending) under the router lock on every serve-thread wakeup,
+        # so it only runs while this is nonzero (deadline-free fleets —
+        # the common case — pay nothing).
+        self._ndeadline = 0
+        self._inflight = 0
+        self._closing = False
+        self._table = RoutingTable()
+        self._views: Dict[str, WorkerView] = {}
+        self._hb_lock = threading.Lock()
+        self._board = None
+
+        if workers is not None:
+            self.workers: Dict[str, Any] = {w.worker_id: w for w in workers}
+        else:
+            self.workers = self._spawn_workers(
+                n_workers, artifacts, manifest, strict_manifest,
+                heartbeat_dir, dead_after_s, warm_timeout_s,
+                worker_env or {}, pin_cpus)
+        for w in self.workers.values():
+            self._views[w.worker_id] = WorkerView(
+                worker_id=w.worker_id, warm=set(w.warm),
+                alive=w.alive)
+        self._threads = [
+            threading.Thread(target=self._serve, args=(w,),
+                             name=f"megba-fed-{w.worker_id}", daemon=True)
+            for w in self.workers.values()
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- spawning --------------------------------------------------------
+    def _spawn_workers(self, n, artifacts, manifest, strict_manifest,
+                       heartbeat_dir, dead_after_s, warm_timeout_s,
+                       worker_env, pin_cpus=False) -> Dict[str, WorkerHandle]:
+        import jax
+
+        from megba_tpu.robustness.elastic import HeartbeatBoard, RankState
+
+        env = dict(os.environ)
+        # Workers must land on the parent's backend/precision: the
+        # conftest-style in-process config flips don't propagate to
+        # children, the env vars do.
+        env.setdefault("JAX_PLATFORMS", jax.default_backend())
+        if jax.config.jax_enable_x64:
+            env["JAX_ENABLE_X64"] = "1"
+        env.update(worker_env)
+
+        # `pin_cpus`: split the host's cores into contiguous slices, one
+        # per worker — each XLA:CPU thread pool then owns its slice
+        # instead of all workers thrashing one shared set (the
+        # data-parallel deployment shape, one host's cores = one
+        # worker's world).  True = cores // n each; an int = exactly
+        # that many cores per worker (the bench's equal-resource
+        # scaling sweeps pin fed_1 and fed_n to the SAME per-worker
+        # slice so the 1→N curve compares like with like).
+        slices: List[Optional[List[int]]] = [None] * n
+        if pin_cpus:
+            try:
+                cores = sorted(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = []
+            per = (int(pin_cpus) if pin_cpus is not True
+                   else (len(cores) // n if cores else 0))
+            if per >= 1 and len(cores) >= per * n:
+                slices = [cores[i * per:(i + 1) * per] for i in range(n)]
+            else:
+                import warnings as _warnings
+
+                _warnings.warn(
+                    f"pin_cpus={pin_cpus!r} needs {per or 1} core(s) x "
+                    f"{n} workers but only {len(cores)} are available; "
+                    "workers run UNPINNED (a benchmark reading "
+                    "equal-resource scaling from this run would be "
+                    "comparing asymmetric configurations)", stacklevel=3)
+        self.pinned = slices[0] is not None if slices else False
+
+        if heartbeat_dir is None:
+            heartbeat_dir = tempfile.mkdtemp(prefix="megba_fed_hb_")
+            self._own_hb_dir = heartbeat_dir  # removed on close()
+        world = n + 1  # rank 0 = the router (observer only)
+        self._board = HeartbeatBoard(
+            heartbeat_dir, 0, world, dead_after_s=dead_after_s)
+        self._dead_state = RankState.DEAD
+
+        handles: Dict[str, WorkerHandle] = {}
+        pending: List[Tuple[WorkerHandle, Any]] = []
+        try:
+            for i in range(n):
+                wid = f"w{i}"
+                log = tempfile.NamedTemporaryFile(
+                    prefix=f"megba_fed_{wid}_", suffix=".log",
+                    delete=False)
+                # -c entry rather than -m: runpy would re-execute the
+                # module it had already imported via the package
+                # __init__, a known double-module footgun.
+                proc = subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import sys; "
+                     "from megba_tpu.serving.federation import "
+                     "_worker_main; sys.exit(_worker_main())"],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    stderr=log, env=env)
+                log.close()
+                chan = FrameChannel(proc.stdout, proc.stdin)
+                rank = i + 1
+                # Heartbeat liveness is armed AFTER the hello: a worker
+                # spends its first seconds importing jax before it can
+                # beat, and the board's join grace (dead_after_s) is
+                # sized for steady-state loss detection, not interpreter
+                # startup on a loaded host.  Until then, pipe EOF and
+                # process exit (checked every recv slice) cover real
+                # startup deaths.
+                handle = WorkerHandle(wid, proc, chan, log.name,
+                                      liveness=None)
+                handle.rank = rank
+                chan.send({
+                    "op": "config", "worker_id": wid,
+                    "option": self.option, "ladder": self.ladder,
+                    "artifacts": artifacts, "manifest": manifest,
+                    "strict_manifest": strict_manifest,
+                    "heartbeat": {"dir": heartbeat_dir, "rank": rank,
+                                  "world": world},
+                    "cpu_affinity": slices[i],
+                    "telemetry": (None if self.telemetry is None
+                                  else f"{self.telemetry}.{wid}"),
+                })
+                pending.append((handle, None))
+                handles[wid] = handle
+            for handle, _ in pending:
+                try:
+                    hello = handle.chan.recv(timeout_s=warm_timeout_s,
+                                             poll=handle._poll)
+                except (FrameError, WorkerLostError, TimeoutError) as exc:
+                    raise RuntimeError(
+                        f"federation worker {handle.worker_id} failed to "
+                        f"come up: {exc}\n--- worker log ---\n"
+                        f"{handle.log_tail()}") from exc
+                if not hello.get("ok"):
+                    raise RuntimeError(
+                        f"federation worker {handle.worker_id} refused "
+                        f"config: {hello.get('error')}\n--- worker log "
+                        f"---\n{handle.log_tail()}")
+                handle.warm = set(hello.get("warm", ()))
+                handle.liveness = self._liveness_for(handle.rank,
+                                                    handle.worker_id)
+                self.stats.record_cold_start(
+                    handle.worker_id, hello.get("cold_start", {}))
+        except Exception:
+            for handle in handles.values():
+                handle.terminate()
+            raise
+        return handles
+
+    def _liveness_for(self, rank: int, wid: str):
+        def check() -> Optional[str]:
+            if self._board is None:
+                return None
+            with self._hb_lock:
+                states = self._board.observe()
+                stale = self._board.staleness(rank)
+            if states.get(rank) is self._dead_state:
+                return (f"heartbeat dead (rank {rank} silent "
+                        f"{stale:.2f}s)")
+            return None
+
+        return check
+
+    # -- submission ------------------------------------------------------
+    def _key_for(self, problem) -> Tuple:
+        from megba_tpu.serving.shape_class import classify
+
+        n_cam, n_pt, n_edge = problem.dims()
+        sc = classify(n_cam, n_pt, n_edge, self.option.dtype, self.ladder)
+        dims = (int(problem.cameras.shape[1]),
+                int(problem.points.shape[1]), int(problem.obs.shape[1]))
+        return (sc, dims)
+
+    def submit(self, problem, deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one problem; the Future resolves to its FleetResult
+        (or raises `WorkerLostError` after `max_reroutes` losses /
+        `DeadlineExceeded` when shed / whatever its worker's solve
+        raised)."""
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        key = self._key_for(problem)
+        now = time.monotonic()
+        item = _Routed(
+            problem=problem, future=Future(), bucket=str(key[0]), key=key,
+            enqueued=now,
+            deadline=None if deadline_s is None else now + deadline_s)
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("FleetRouter is closed")
+            if not any(v.alive for v in self._views.values()):
+                raise WorkerLostError("*", "no surviving workers")
+            self._pending.setdefault(key, []).append(item)
+            self._npending += 1
+            if item.deadline is not None:
+                self._ndeadline += 1
+            self._lock.notify_all()
+        return item.future
+
+    def submit_many(self, problems: Sequence[Any],
+                    deadline_s: Optional[float] = None) -> List[Future]:
+        """Enqueue a whole fleet ATOMICALLY (one lock acquisition): no
+        worker can pick a partial bucket mid-submission, so batch
+        composition — and therefore the (bucket, lanes) programs hit —
+        is deterministic for a given fleet.  A replica whose artifacts
+        were exported from a `solve_many` pass over the same fleet then
+        dispatches it entirely from the store (the zero-trace cold-start
+        contract); per-problem `submit` keeps the latency-shaped
+        streaming semantics instead."""
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
+        now = time.monotonic()
+        items = []
+        for problem in problems:
+            key = self._key_for(problem)
+            items.append(_Routed(
+                problem=problem, future=Future(), bucket=str(key[0]),
+                key=key, enqueued=now,
+                deadline=None if deadline_s is None else now + deadline_s))
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("FleetRouter is closed")
+            if not any(v.alive for v in self._views.values()):
+                raise WorkerLostError("*", "no surviving workers")
+            for item in items:
+                self._pending.setdefault(item.key, []).append(item)
+            self._npending += len(items)
+            self._ndeadline += sum(
+                1 for item in items if item.deadline is not None)
+            self._lock.notify_all()
+        return [item.future for item in items]
+
+    def flush(self) -> None:
+        """Block until every submitted problem has RESOLVED (result,
+        reroute-exhaustion error, shed, or solve error).  Worker losses
+        during the wait re-route work and keep the flush honest: it
+        returns only when nothing is pending OR in flight."""
+        with self._lock:
+            while self._npending > 0 or self._inflight > 0:
+                self._lock.wait()
+
+    def close(self) -> None:
+        """Drain, stop serve threads, shut workers down, emit the
+        federation telemetry report.  Idempotent: a second close (e.g.
+        context-manager exit after an explicit close) is a no-op — in
+        particular it must not append a duplicate federation report
+        line to the telemetry sink."""
+        with self._lock:
+            already = self._closed
+            self._closing = True
+            self._closed = True
+            self._lock.notify_all()
+        if already:
+            return
+        for t in self._threads:
+            t.join()
+        for w in self.workers.values():
+            if w.alive:
+                try:
+                    w.request({"op": "shutdown"}, timeout_s=30.0)
+                    proc = getattr(w, "proc", None)
+                    if proc is not None:  # let the clean exit land
+                        proc.wait(timeout=10)
+                except (WorkerLostError, TimeoutError,
+                        subprocess.TimeoutExpired):
+                    pass
+            w.terminate()
+            # Clean-exit worker logs are noise; keep a log only when
+            # the worker died abnormally (its tail is the forensics
+            # WorkerLostError already quoted).
+            rc = getattr(getattr(w, "proc", None), "returncode", None)
+            log_path = getattr(w, "log_path", None)
+            if log_path and rc == 0:
+                try:
+                    os.unlink(log_path)
+                except OSError:
+                    pass
+        if self._own_hb_dir is not None:
+            import shutil
+
+            shutil.rmtree(self._own_hb_dir, ignore_errors=True)
+        if self.telemetry:
+            append_federation_report(self.option, self.stats, self.timer,
+                                     self.telemetry)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # -- dispatch --------------------------------------------------------
+    @staticmethod
+    def _resolve(future: Future, result=None, exc=None) -> None:
+        try:
+            if future.cancelled():
+                return
+            if exc is not None:
+                future.set_exception(exc)
+            else:
+                future.set_result(result)
+        except InvalidStateError:
+            pass
+
+    def _shed_expired_locked(self, now: float) -> List[_Routed]:
+        if self._ndeadline <= 0:
+            return []
+        shed: List[_Routed] = []
+        removed = 0
+        for key in list(self._pending):
+            items = self._pending[key]
+            keep: List[_Routed] = []
+            for it in items:  # one O(n) partition pass per bucket
+                if it.future.cancelled():
+                    removed += 1
+                    if it.deadline is not None:
+                        self._ndeadline -= 1
+                elif it.deadline is not None and now >= it.deadline:
+                    removed += 1
+                    self._ndeadline -= 1
+                    shed.append(it)
+                else:
+                    keep.append(it)
+            if len(keep) != len(items):
+                if keep:
+                    self._pending[key] = keep
+                else:
+                    del self._pending[key]
+        if removed:
+            self._npending = sum(len(v) for v in self._pending.values())
+        return shed
+
+    def _depths_locked(self) -> Dict[str, int]:
+        depths: Dict[str, int] = {}
+        for (sc, _dims), items in self._pending.items():
+            if items:
+                depths[str(sc)] = depths.get(str(sc), 0) + len(items)
+        return depths
+
+    def _pick_locked(self, wid: str) -> Tuple[Optional[List[_Routed]], bool]:
+        """(batch, stolen) for worker `wid`, or (None, False)."""
+        view = self._views[wid]
+        # 1) buckets homed here (or routable here), oldest first
+        candidates = []
+        for key, items in self._pending.items():
+            if not items:
+                continue
+            bucket = str(key[0])
+            homed = self._table.assignment.get(bucket)
+            if homed is None:
+                homed = self._table.route(bucket, self._views)
+            if homed == wid:
+                candidates.append((min(it.enqueued for it in items), key))
+        if candidates:
+            # Tiebreak on the bucket string: submit_many stamps a whole
+            # fleet with ONE enqueue time, and (ShapeClass, dims) keys
+            # do not order.
+            _, key = min(candidates, key=lambda c: (c[0], str(c[1][0]),
+                                                    c[1][1]))
+            return self._take_locked(key, view), False
+        # 2) steal: deepest warm backlog homed on a live peer
+        if self.steal:
+            bucket = self._table.steal_candidate(
+                wid, self._views, self._depths_locked())
+            if bucket is not None:
+                for key, items in self._pending.items():
+                    if str(key[0]) == bucket and items:
+                        return self._take_locked(key, view), True
+        return None, False
+
+    def _take_locked(self, key: Tuple, view: WorkerView) -> List[_Routed]:
+        items = self._pending[key]
+        take = items[:self.max_batch]
+        rest = items[self.max_batch:]
+        if rest:
+            self._pending[key] = rest
+        else:
+            del self._pending[key]
+        self._npending -= len(take)
+        self._ndeadline -= sum(
+            1 for it in take if it.deadline is not None)
+        view.routed += len(take)
+        return take
+
+    def _serve(self, worker) -> None:
+        wid = worker.worker_id
+        while True:
+            batch: Optional[List[_Routed]] = None
+            stolen = False
+            shed_out: Optional[List[_Routed]] = None
+            with self._lock:
+                while True:
+                    if not self._views[wid].alive:
+                        return
+                    now = time.monotonic()
+                    shed = self._shed_expired_locked(now)
+                    if shed:
+                        # Shed futures resolve OUTSIDE the lock (a
+                        # done-callback re-entering the router must not
+                        # self-deadlock on the non-reentrant Condition);
+                        # they count as in-flight until resolved so
+                        # flush() cannot observe "drained" early — the
+                        # FleetQueue shed discipline.
+                        self._inflight += len(shed)
+                        shed_out = shed
+                        break
+                    batch, stolen = self._pick_locked(wid)
+                    if batch is not None:
+                        break
+                    if (self._closing and self._npending == 0
+                            and self._inflight == 0):
+                        return
+                    # Wake on submit/reroute/close; the timed slice also
+                    # re-checks deadlines so sheds stay prompt.
+                    self._lock.wait(timeout=0.05)
+                if batch is not None:
+                    self._inflight += len(batch)
+            if shed_out is not None:
+                self.stats.record_shed(len(shed_out))
+                self.timer.count_event("federation_shed", len(shed_out))
+                for it in shed_out:
+                    self._resolve(it.future, exc=DeadlineExceeded(
+                        f"problem {it.problem.name!r} shed before "
+                        "dispatch (deadline expired)"))
+                with self._lock:
+                    self._inflight -= len(shed_out)
+                    self._lock.notify_all()
+                continue
+            try:
+                try:
+                    reply = worker.request(
+                        {"op": "solve",
+                         "problems": [it.problem for it in batch]},
+                        timeout_s=self.watchdog_s)
+                except (WorkerLostError, TimeoutError) as exc:
+                    if isinstance(exc, TimeoutError):
+                        exc = WorkerLostError(
+                            wid, "solve exceeded the "
+                            f"{self.watchdog_s:.0f}s watchdog budget")
+                    self._on_worker_lost(worker, batch, exc)
+                    return
+                now = time.monotonic()
+                if reply.get("ok") and len(reply.get("results", ())) != len(
+                        batch):
+                    # A short/long ok-reply must fail the batch TYPED —
+                    # zip truncation would strand the tail futures
+                    # unresolved past flush() forever ("never silently").
+                    reply = {"ok": False, "error": (
+                        f"worker returned {len(reply.get('results', ()))} "
+                        f"results for a {len(batch)}-problem batch")}
+                if reply.get("ok"):
+                    results = reply["results"]
+                    worker.warm = set(reply.get("warm", worker.warm))
+                    with self._lock:
+                        self._views[wid].warm = set(worker.warm)
+                    if reply.get("first_solve") is not None:
+                        self.stats.record_first_solve(
+                            wid, reply["first_solve"])
+                    self.stats.record_batch(wid, len(batch), stolen)
+                    if stolen:
+                        self.timer.count_event("federation_steal")
+                        self.timer.count_event(
+                            "federation_stolen_problems", len(batch))
+                    for it, fr in zip(batch, results):
+                        fr.latency_s = now - it.enqueued
+                        if (it.deadline is not None
+                                and now >= it.deadline):
+                            # The FleetQueue contract: a late result is
+                            # DELIVERED, flagged, counted — never
+                            # silently on time.
+                            fr.deadline_missed = True
+                            self.stats.record_deadline_miss()
+                            self.timer.count_event(
+                                "federation_deadline_miss")
+                        self._resolve(it.future, result=fr)
+                else:
+                    err = RuntimeError(
+                        f"worker {wid} solve failed: "
+                        f"{reply.get('error')}")
+                    for it in batch:
+                        self._resolve(it.future, exc=err)
+            except Exception as exc:  # never die silently mid-batch
+                # A router-side bug must fail THIS batch typed and keep
+                # the thread serving — a dead serve thread would wedge
+                # flush() forever (the FleetQueue dispatcher contract).
+                for it in batch:
+                    self._resolve(it.future, exc=exc)
+            finally:
+                with self._lock:
+                    self._inflight -= len(batch)
+                    self._lock.notify_all()
+
+    def _on_worker_lost(self, worker, batch: List[_Routed],
+                        exc: WorkerLostError) -> None:
+        """Typed loss handling: count it, reroute the in-flight batch
+        (bounded), re-home the dead worker's buckets, keep serving."""
+        wid = worker.worker_id
+        worker.alive = False
+        worker.terminate()
+        self.stats.record_worker_lost(wid)
+        self.timer.count_event("federation_worker_lost")
+        # Failures are COLLECTED under the lock and resolved outside it:
+        # a future's done-callback may re-enter the router, and the
+        # Condition's lock is not reentrant.  The failed items count as
+        # in-flight until resolved (the caller's finally decrements the
+        # batch; _inflight covers it throughout).
+        to_fail: List[Tuple[Future, WorkerLostError]] = []
+        with self._lock:
+            self._views[wid].alive = False
+            self._table.reassign_lost(wid, self._views)
+            survivors = any(v.alive for v in self._views.values())
+            rerouted = 0
+            for it in batch:
+                it.reroutes += 1
+                if not survivors:
+                    to_fail.append((it.future, WorkerLostError(
+                        wid, f"{exc.reason}; no surviving workers")))
+                elif it.reroutes > self.max_reroutes:
+                    self.stats.record_reroute_failure()
+                    to_fail.append((it.future, WorkerLostError(
+                        wid, f"{exc.reason}; rerouted {it.reroutes - 1} "
+                        f"times (max_reroutes={self.max_reroutes})")))
+                else:
+                    self._pending.setdefault(it.key, []).append(it)
+                    self._npending += 1
+                    if it.deadline is not None:
+                        self._ndeadline += 1
+                    rerouted += 1
+            if rerouted:
+                self.stats.record_reroute(rerouted)
+                self.timer.count_event("federation_reroute", rerouted)
+            if not survivors:
+                # Nothing can serve the queue: fail it all, typed.
+                for key in list(self._pending):
+                    for it in self._pending.pop(key):
+                        to_fail.append((it.future, WorkerLostError(
+                            wid, f"{exc.reason}; no surviving workers")))
+                self._npending = 0
+                self._ndeadline = 0
+            # in-flight accounting: the serve loop's finally owns the
+            # decrement (this handler runs inside its try)
+            self._lock.notify_all()
+        for future, err in to_fail:
+            self._resolve(future, exc=err)
+        with self._lock:
+            self._lock.notify_all()  # flush waiters re-check after fails
+
+
+def append_federation_report(option, stats: FederationStats, timer,
+                             path: str) -> None:
+    """One router-lifetime SolveReport line carrying the federation
+    block — what `summarize --aggregate`'s federation view renders."""
+    from megba_tpu.observability.report import (
+        SolveReport,
+        append_report,
+        backend_topology,
+        config_to_dict,
+    )
+
+    rep = SolveReport(
+        problem={"kind": "federation_router"},
+        config=config_to_dict(option),
+        backend=backend_topology(),
+        phases=timer.as_dict(),
+        result={},
+        federation=stats.as_dict(),
+        created_unix=time.time(),
+    )
+    append_report(rep, path)
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv[1:]:
+        sys.exit(_worker_main())
+    print(__doc__)
+    sys.exit(2)

@@ -33,6 +33,9 @@ class FleetStats:
         self.edges_real = 0  # raw (unpadded) edges across real problems
         self.pool_hits = 0  # dispatches served by an already-built program
         self.pool_misses = 0  # dispatches that had to build/compile
+        # -- artifact store (serving/artifacts.py): the cold-start split —
+        self.artifact_loads = 0  # buckets warmed from serialized executables
+        self.artifact_compiles = 0  # buckets that paid a real compile
         self.per_bucket: Dict[str, Dict[str, int]] = {}
         # -- resilience counters (serving/resilience.py mechanisms) ------
         self.sheds = 0  # problems shed before dispatch (deadline expired)
@@ -77,6 +80,15 @@ class FleetStats:
                 self.pool_hits += 1
             else:
                 self.pool_misses += 1
+
+    def record_artifact(self, loaded: bool) -> None:
+        """One bucket warmed: `loaded`=True rode a serialized executable
+        (I/O-bound cold start), False paid a trace + XLA compile."""
+        with self._lock:
+            if loaded:
+                self.artifact_loads += 1
+            else:
+                self.artifact_compiles += 1
 
     # -- resilience recording (called by FleetQueue under its own lock,
     # but kept self-locking so direct callers stay safe) ----------------
@@ -175,6 +187,8 @@ class FleetStats:
                 "edges_real": self.edges_real,
                 "pool_hits": self.pool_hits,
                 "pool_misses": self.pool_misses,
+                "artifact_loads": self.artifact_loads,
+                "artifact_compiles": self.artifact_compiles,
                 "per_bucket": {k: dict(v)
                                for k, v in self.per_bucket.items()},
                 "sheds": self.sheds,
@@ -213,6 +227,10 @@ class FleetStats:
             f"  compile pool: {d['pool_hits']} hits / {d['pool_misses']} "
             f"misses ({100 * d['pool_hit_rate']:.0f}% hit rate)",
         ]
+        if d["artifact_loads"] or d["artifact_compiles"]:
+            lines.append(
+                f"  artifact store: {d['artifact_loads']} loaded / "
+                f"{d['artifact_compiles']} compiled")
         if (d["sheds"] or d["retries"] or d["rejected"]
                 or d["deadline_misses"] or d["breaker_trips"]
                 or d["breaker_fast_fails"]):

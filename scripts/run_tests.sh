@@ -624,6 +624,123 @@ assert "under_constrained_point=1" in out_text, out_text
 PY
 echo "triage smoke OK"
 
+# Federation smoke (ISSUE 12): the scale-out tier end to end.  A
+# 16-problem mixed f64 fleet is first solved single-host (the control)
+# through a CompilePool that then EXPORTS its working set — manifest +
+# serialized executables (portable compiles, see
+# serving/compile_pool._portable_compile_scope).  A 2-worker
+# FleetRouter warms from those artifacts: every bucket must load
+# (mode=artifact, zero compiles) and the first fleet must dispatch with
+# ZERO traces (worker-side retrace-sentinel certification).  One worker
+# is then SIGKILLed mid-fleet — a real host loss: its in-flight
+# problems must re-route to the survivor (typed counters), flush() must
+# return with every future resolved (the no-wedge gate), and all 16
+# results must be BITWISE identical to the single-host control
+# (shape-class padding exactness makes federated placement
+# result-invariant).  `summarize --aggregate` must render the
+# federation block from the merged telemetry streams.
+FED_DIR=$(mktemp -d /tmp/megba_federation_smoke.XXXXXX)
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$FED_DIR"' EXIT
+JAX_PLATFORMS=cpu MEGBA_FED_DIR="$FED_DIR" python - <<'PY'
+import os
+import signal
+import time
+
+import numpy as np
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_fleet
+from megba_tpu.observability import summarize
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.serving import (
+    CompilePool, FleetProblem, FleetRouter, FleetStats, solve_many)
+
+work = os.environ["MEGBA_FED_DIR"]
+OPT = ProblemOption(dtype=np.float64, algo_option=AlgoOption(max_iter=6),
+                    solver_option=SolverOption(max_iter=12, tol=1e-10))
+engine = make_residual_jacobian_fn(mode=OPT.jacobian_mode)
+fleet = [FleetProblem.from_synthetic(s, name=f"fed{i}")
+         for i, s in enumerate(make_fleet(16, size_range=(12, 96), seed=0,
+                                          dtype=np.float64))]
+
+# -- exporter service: the control solve + the working-set export ------
+store_root = os.path.join(work, "artifacts")
+stats = FleetStats()
+pool = CompilePool(stats=stats, artifacts=store_root)
+control = solve_many(fleet, OPT, pool=pool, stats=stats)
+manifest = os.path.join(work, "manifest.json")
+pool.save_manifest(manifest, option=OPT)
+t0 = time.perf_counter()
+n_exported = pool.export_artifacts(engine, OPT)
+print(f"federation smoke: exported {n_exported} bucket executables in "
+      f"{time.perf_counter() - t0:.1f}s")
+assert n_exported >= 3, n_exported
+
+# -- fresh replicas: 2 workers, millisecond-class warm, zero traces ----
+sink = os.path.join(work, "telemetry.jsonl")
+t0 = time.perf_counter()
+router = FleetRouter(OPT, n_workers=2, artifacts=store_root,
+                     manifest=manifest, strict_manifest=True,
+                     telemetry=sink)
+up_s = time.perf_counter() - t0
+d0 = router.stats.as_dict()
+for wid, cs in d0["cold_start"].items():
+    assert cs["mode"] == "artifact", (wid, cs)
+    assert cs["artifact_compiles"] == 0, (wid, cs)
+loads = sum(cs["artifact_loads"] for cs in d0["cold_start"].values())
+print(f"federation smoke: 2 workers artifact-warmed in {up_s:.1f}s "
+      f"({loads} executables loaded, 0 compiled)")
+
+# -- a real host loss mid-fleet ----------------------------------------
+# submit_many: the fleet enqueues ATOMICALLY, so batch composition
+# reproduces the exporter's solve_many batches exactly and the
+# zero-trace assertion below cannot flake on a mid-submission partial
+# pick (a different lane rung would miss the store and compile).
+# Kill IMMEDIATELY after: nothing has resolved yet, several buckets
+# are pending, so w1's serve thread is guaranteed to pick a batch and
+# hit the dead pipe — deterministic reroutes >= 1 with no sleep race.
+futs = router.submit_many(fleet)
+victim = router.workers["w1"]
+os.kill(victim.pid, signal.SIGKILL)
+t0 = time.perf_counter()
+router.flush()  # the no-wedge gate: returns with every future resolved
+flush_s = time.perf_counter() - t0
+results = [f.result(timeout=5) for f in futs]  # none may raise
+router.close()
+d = router.stats.as_dict()
+assert d["workers_lost"] == 1 and d["lost_workers"] == ["w1"], d
+assert d["reroutes"] >= 1, d
+assert sum(d["problems_by_worker"].values()) == 16, d
+assert d["first_solve"]["w0"]["traces"] == 0, d["first_solve"]
+for r, c in zip(results, control):
+    assert r.cameras.tobytes() == c.cameras.tobytes(), r.name
+    assert r.cost.tobytes() == c.cost.tobytes(), r.name
+    assert int(r.status) == int(c.status), r.name
+print(f"federation smoke: w1 SIGKILLed mid-fleet, {d['reroutes']} problems "
+      f"rerouted, flush returned in {flush_s:.1f}s, 16/16 BITWISE vs the "
+      "single-host solve_many control")
+
+# -- aggregate CLI renders the federation block ------------------------
+out = summarize.aggregate_paths(
+    [p for p in (sink, sink + ".w0", sink + ".w1") if os.path.exists(p)])
+print(out)
+assert "1 workers lost" in out, out
+assert "rerouted" in out, out
+assert "cold start w0: artifact" in out, out
+assert "first solve 0 traces" in out, out
+PY
+echo "federation smoke OK"
+
 # Elastic chaos smoke (ISSUE 9): a REAL 2-process gloo solve on the
 # venice-10% configuration (f64), rank 1 SIGKILL'd the moment the first
 # world-2 snapshot lands.  Rank 0 must surface a typed WorkerLost
@@ -639,7 +756,7 @@ if JAX_PLATFORMS=cpu python -c "import sys
 from megba_tpu.parallel.multihost import cpu_cross_process_collectives_available
 sys.exit(0 if cpu_cross_process_collectives_available() else 3)"; then
 ELASTIC_DIR=$(mktemp -d /tmp/megba_elastic_smoke.XXXXXX)
-trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$ELASTIC_DIR"' EXIT
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$FED_DIR" "$ELASTIC_DIR"' EXIT
 JAX_PLATFORMS=cpu MEGBA_ELASTIC_DIR="$ELASTIC_DIR" python - <<'PY'
 import importlib.util
 import os

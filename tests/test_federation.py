@@ -1,0 +1,845 @@
+"""Federation-tier tests: artifact store, routing, stealing, rerouting.
+
+Compile discipline (the test_serving.py contract): tier-1 keeps only
+host-side machinery — frame RPC, artifact-file hardening with a
+monkeypatched serializer, strict-manifest refusal, the pure
+`RoutingTable` policy, and the FULL router (submit → route → steal →
+worker-loss reroute → flush) driven through in-process stub workers
+with zero subprocesses and zero compiles.  Everything that compiles a
+real program or spawns a real worker process is marked `slow`; the
+run_tests.sh federation smoke additionally kills a real worker
+mid-fleet at 16-problem scale.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+from concurrent.futures import Future
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from megba_tpu.common import (
+    AlgoOption,
+    ProblemOption,
+    SolverOption,
+    SolveStatus,
+)
+from megba_tpu.io.synthetic import make_fleet, make_synthetic_bal
+from megba_tpu.serving import (
+    ArtifactKey,
+    ArtifactStore,
+    BucketLadder,
+    CompilePool,
+    FederationStats,
+    FleetProblem,
+    FleetResult,
+    FleetRouter,
+    FleetStats,
+    ManifestMismatch,
+    RoutingTable,
+    WorkerLostError,
+    classify,
+    solve_many,
+)
+from megba_tpu.serving import artifacts as artifacts_mod
+from megba_tpu.serving.federation import (
+    FrameChannel,
+    FrameError,
+    WorkerView,
+    append_federation_report,
+)
+from megba_tpu.serving.resilience import DeadlineExceeded
+
+OPT64 = ProblemOption(dtype=np.float64,
+                      algo_option=AlgoOption(max_iter=6),
+                      solver_option=SolverOption(max_iter=12, tol=1e-10))
+LADDER = BucketLadder()
+
+
+def _mk(seed, n_pt, n_cam=4):
+    s = make_synthetic_bal(num_cameras=n_cam, num_points=n_pt,
+                           obs_per_point=3, seed=seed, param_noise=2e-2,
+                           pixel_noise=0.3, dtype=np.float64)
+    return FleetProblem.from_synthetic(s, name=f"s{seed}_p{n_pt}")
+
+
+def _bits(a) -> bytes:
+    return np.asarray(a).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Frame RPC
+# ---------------------------------------------------------------------------
+
+
+def _pipe_channel():
+    r1, w1 = os.pipe()
+    return (FrameChannel(os.fdopen(r1, "rb", buffering=0),
+                         os.fdopen(w1, "wb", buffering=0)))
+
+
+def test_frame_roundtrip_including_arrays():
+    chan = _pipe_channel()
+    msg = {"op": "solve", "x": np.arange(1000.0).reshape(10, 100),
+           "nested": [1, "two", {"three": np.int32(3)}]}
+    chan.send(msg)
+    out = chan.recv(timeout_s=5.0)
+    assert out["op"] == "solve"
+    np.testing.assert_array_equal(out["x"], msg["x"])
+    assert out["nested"][2]["three"] == 3
+    chan.close()
+
+
+def test_frame_eof_and_timeout_and_poll_abort():
+    # EOF: writer closed with no bytes -> typed FrameError.
+    r, w = os.pipe()
+    chan = FrameChannel(os.fdopen(r, "rb", buffering=0),
+                        os.fdopen(os.dup(w), "wb", buffering=0))
+    os.close(w)
+    chan._wfile.close()
+    with pytest.raises(FrameError):
+        chan.recv(timeout_s=5.0)
+    # Timeout: open pipe, no frame.
+    chan2 = _pipe_channel()
+    with pytest.raises(TimeoutError):
+        chan2.recv(timeout_s=0.15)
+    # Poll abort: the liveness hook's exception propagates.
+
+    class Boom(RuntimeError):
+        pass
+
+    def poll():
+        raise Boom("dead")
+
+    with pytest.raises(Boom):
+        chan2.recv(timeout_s=5.0, poll=poll)
+    chan2.close()
+
+
+def test_frame_truncated_mid_frame_is_typed():
+    r, w = os.pipe()
+    chan = FrameChannel(os.fdopen(r, "rb", buffering=0),
+                        os.fdopen(os.dup(w), "wb", buffering=0))
+    import struct
+
+    os.write(w, struct.pack(">Q", 100) + b"only-a-few-bytes")
+    os.close(w)
+    chan._wfile.close()
+    with pytest.raises(FrameError, match="mid-frame"):
+        chan.recv(timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Artifact store hardening (monkeypatched serializer: zero compiles)
+# ---------------------------------------------------------------------------
+
+
+KEY = ArtifactKey(option_fingerprint="fp", shape="c4_p16_e2048_float64",
+                  lanes=2, cd=9, pd=3, od=2)
+
+
+@pytest.fixture
+def fake_serializer(monkeypatch):
+    """Replace jax's executable (de)serializer with a byte-level fake so
+    the store's file format, checksum and env checks are testable
+    without compiling anything; priming is skipped the same way."""
+    from jax.experimental import serialize_executable as se
+
+    monkeypatch.setattr(se, "serialize",
+                        lambda compiled: (b"XBLOB:" + compiled, None, None))
+    monkeypatch.setattr(se, "deserialize_and_load",
+                        lambda payload, it, ot: ("LOADED", payload))
+    monkeypatch.setattr(artifacts_mod, "_PRIMED", True)
+    return se
+
+
+def test_artifact_roundtrip_and_digest(tmp_path, fake_serializer):
+    store = ArtifactStore(str(tmp_path))
+    assert store.load(KEY) is None  # plain miss: silent
+    path = store.save(KEY, b"exe-bytes")
+    assert os.path.basename(path) == KEY.filename()
+    assert store.entries() == [KEY.filename()]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a clean load must not warn
+        loaded = store.load(KEY)
+    assert loaded == ("LOADED", b"XBLOB:exe-bytes")
+    d1 = store.content_digest(KEY)
+    store.save(KEY, b"exe-bytes")  # re-export: byte-identical body
+    assert store.content_digest(KEY) == d1
+
+
+def test_artifact_corrupt_truncated_magic_schema(tmp_path, fake_serializer):
+    store = ArtifactStore(str(tmp_path))
+    path = store.save(KEY, b"exe")
+    blob = open(path, "rb").read()
+
+    def expect_warn(data, pattern):
+        with open(path, "wb") as fh:
+            fh.write(data)
+        with pytest.warns(artifacts_mod.ArtifactWarning, match=pattern):
+            assert store.load(KEY) is None
+
+    expect_warn(blob[:-7], "checksum mismatch")  # truncated body
+    flipped = bytearray(blob)
+    flipped[-1] ^= 0xFF
+    expect_warn(bytes(flipped), "checksum mismatch")  # corrupt body
+    expect_warn(b"NOTMEGBA" + blob[8:], "bad magic")
+    expect_warn(blob[:20], "bad magic or truncated")
+    # valid container, wrong schema
+    import hashlib
+    import pickle
+
+    body = pickle.dumps({"schema": "other/v9"})
+    digest = hashlib.blake2b(body, digest_size=16).digest()
+    expect_warn(b"MEGBAEXE" + digest + body, "unknown artifact schema")
+
+
+def test_artifact_version_mismatch_names_fields(tmp_path, fake_serializer,
+                                                monkeypatch):
+    store = ArtifactStore(str(tmp_path))
+    with monkeypatch.context() as m:
+        m.setattr(
+            artifacts_mod, "current_environment",
+            lambda: {"jax": "0.0.1", "jaxlib": "0.0.1", "backend": "cpu"})
+        store.save(KEY, b"exe")
+    with pytest.warns(artifacts_mod.ArtifactWarning,
+                      match=r"jaxlib='0\.0\.1'.*compile-and-refresh"):
+        assert store.load(KEY) is None
+    # refresh heals: a re-save under the CURRENT env loads again
+    store.save(KEY, b"exe2")
+    assert store.load(KEY) is not None
+
+
+def test_artifact_deserialize_refusal_warns(tmp_path, fake_serializer,
+                                            monkeypatch):
+    from jax.experimental import serialize_executable as se
+
+    store = ArtifactStore(str(tmp_path))
+    store.save(KEY, b"exe")
+
+    def boom(payload, it, ot):
+        raise RuntimeError("Symbols not found: [...]")
+
+    monkeypatch.setattr(se, "deserialize_and_load", boom)
+    with pytest.warns(artifacts_mod.ArtifactWarning,
+                      match="runtime refused"):
+        assert store.load(KEY) is None
+
+
+# ---------------------------------------------------------------------------
+# Strict manifests
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_strict_mismatch_names_fields(tmp_path):
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    engine = make_residual_jacobian_fn(mode=OPT64.jacobian_mode)
+    manifest = tmp_path / "m.json"
+    CompilePool().save_manifest(str(manifest), option=OPT64)
+
+    # Matching option: strict is a no-op (empty manifest warms nothing).
+    assert CompilePool().warm_from_manifest(
+        str(manifest), engine, OPT64, strict=True) == 0
+
+    drifted = dataclasses.replace(
+        OPT64, algo_option=AlgoOption(max_iter=9))
+    with pytest.raises(ManifestMismatch) as exc:
+        CompilePool().warm_from_manifest(str(manifest), engine, drifted,
+                                         strict=True)
+    assert "algo_option.max_iter" in exc.value.fields
+    assert "algo_option.max_iter" in str(exc.value)
+    # non-strict: the historical warn-and-recompile contract, now
+    # naming the fields too
+    with pytest.warns(UserWarning, match="algo_option.max_iter"):
+        CompilePool().warm_from_manifest(str(manifest), engine, drifted)
+
+    # A telemetry-only difference is NOT a mismatch: sinks never reach
+    # a program.
+    sink_only = dataclasses.replace(OPT64, telemetry="/tmp/x.jsonl")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert CompilePool().warm_from_manifest(
+            str(manifest), engine, sink_only, strict=True) == 0
+
+
+def test_telemetry_option_shares_keys_and_artifacts(tmp_path,
+                                                    fake_serializer):
+    """A telemetry-carrying option must warm/export/dispatch under the
+    SAME pool keys and artifact fingerprints as its stripped twin —
+    sinks never reach a program, so a sink-configured replica must LOAD
+    the store a sink-less exporter wrote, not silently recompile it
+    (review finding: warm once keyed on the unstripped option)."""
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving.compile_pool import _sans_telemetry, pool_key
+    from megba_tpu.serving.shape_class import ShapeClass
+
+    engine = make_residual_jacobian_fn(mode=OPT64.jacobian_mode)
+    with_sink = dataclasses.replace(OPT64, telemetry="/tmp/sink.jsonl")
+    sc = ShapeClass(n_cam=4, n_pt=16, n_edge=2048, dtype="float64")
+    assert (pool_key(engine, OPT64, sc, 1, 9, 3, 2)
+            == pool_key(engine, _sans_telemetry(with_sink), sc, 1, 9, 3, 2))
+
+    from megba_tpu.serving.compile_pool import reset_process_cache
+
+    reset_process_cache()
+    try:
+        store = ArtifactStore(str(tmp_path))
+        pool = CompilePool(stats=FleetStats(), artifacts=store)
+        store.save(pool._artifact_key(engine, OPT64, sc, 1, 9, 3, 2,
+                                      False), b"exe")
+        stats = FleetStats()
+        pool2 = CompilePool(stats=stats, artifacts=store)
+        assert pool2.warm(engine, with_sink,
+                          [{"shape": sc.to_dict(), "lanes": 1}]) == 1
+        assert stats.artifact_loads == 1 and stats.artifact_compiles == 0
+        # strict manifest round-trip across the sink difference
+        m = tmp_path / "m.json"
+        pool.save_manifest(str(m), option=with_sink)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            pool2.warm_from_manifest(str(m), engine, OPT64, strict=True)
+    finally:
+        reset_process_cache()
+
+
+def test_manifest_without_option_config_still_refuses(tmp_path):
+    """Pre-strict manifests carry only the opaque fingerprint: strict
+    must still refuse, naming the placeholder."""
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    engine = make_residual_jacobian_fn(mode=OPT64.jacobian_mode)
+    manifest = tmp_path / "legacy.json"
+    CompilePool().save_manifest(str(manifest), option=OPT64)
+    doc = json.loads(manifest.read_text())
+    del doc["option_config"]
+    manifest.write_text(json.dumps(doc))
+    drifted = dataclasses.replace(OPT64,
+                                  algo_option=AlgoOption(max_iter=9))
+    with pytest.raises(ManifestMismatch) as exc:
+        CompilePool().warm_from_manifest(str(manifest), engine, drifted,
+                                         strict=True)
+    assert any("fingerprint" in f for f in exc.value.fields)
+
+
+# ---------------------------------------------------------------------------
+# Routing policy (pure)
+# ---------------------------------------------------------------------------
+
+
+def _views(*specs):
+    out = {}
+    for wid, warm in specs:
+        out[wid] = WorkerView(worker_id=wid, warm=set(warm))
+    return out
+
+
+def test_routing_warm_first_then_least_loaded_then_id():
+    t = RoutingTable()
+    views = _views(("w0", []), ("w1", ["B1"]), ("w2", []))
+    assert t.route("B1", views) == "w1"  # warm-first
+    assert t.route("B2", views) in ("w0", "w2")
+    assert t.route("B2", views) == "w0"  # deterministic id tiebreak
+    assert t.route("B3", views) == "w2"  # least-assigned spreads
+    # sticky: B1 stays home even after w1 got loaded
+    views["w1"].routed = 100
+    assert t.route("B1", views) == "w1"
+
+
+def test_routing_dead_home_reroutes_and_reassign():
+    t = RoutingTable()
+    views = _views(("w0", []), ("w1", []))
+    assert t.route("B1", views) == "w0"
+    views["w0"].alive = False
+    orphaned = t.reassign_lost("w0", views)
+    assert orphaned == ["B1"]
+    assert t.route("B1", views) == "w1"
+    # all dead: route returns None
+    views["w1"].alive = False
+    t2 = RoutingTable()
+    assert t2.route("B9", views) is None
+
+
+def test_steal_candidate_warm_and_deepest_only():
+    t = RoutingTable()
+    views = _views(("w0", ["B1", "B2"]), ("w1", ["B2"]))
+    # both buckets homed on w0 (explicit: the scenario under test is
+    # the steal policy, not the assignment path)
+    t.assignment.update({"B1": "w0", "B2": "w0"})
+    views["w0"].assigned.update({"B1", "B2"})
+    depths = {"B1": 5, "B2": 9}
+    # w1 is only warm on B2 -> steals B2 even though B1 is listed too
+    assert t.steal_candidate("w1", views, depths) == "B2"
+    # never steals its own bucket
+    t.assignment["B2"] = "w1"
+    assert t.steal_candidate("w1", views, depths) is None
+    # never steals a bucket it would have to compile
+    t.assignment["B2"] = "w0"
+    views["w1"].warm.discard("B2")
+    assert t.steal_candidate("w1", views, depths) is None
+    # a dead victim is not a steal source (reroute handles it)
+    views["w1"].warm.add("B2")
+    views["w0"].alive = False
+    assert t.steal_candidate("w1", views, depths) is None
+
+
+# ---------------------------------------------------------------------------
+# Router end-to-end through stub workers (no subprocess, no compile)
+# ---------------------------------------------------------------------------
+
+
+class StubWorker:
+    """In-process stand-in for a worker process: same request surface,
+    scripted behavior."""
+
+    def __init__(self, worker_id, warm=(), behavior=None):
+        self.worker_id = worker_id
+        self.warm = set(warm)
+        self.alive = True
+        self.pid = 0
+        self.behavior = behavior
+        self.batches = []  # list of lists of problem names
+
+    def request(self, msg, timeout_s=None):
+        if msg.get("op") == "shutdown":
+            return {"ok": True}
+        problems = msg["problems"]
+        self.batches.append([p.name for p in problems])
+        if self.behavior is not None:
+            return self.behavior(self, problems)
+        return {"ok": True, "results": [_stub_result(p) for p in problems],
+                "warm": sorted(self.warm)}
+
+    def terminate(self):
+        self.alive = False
+
+
+def _stub_result(p) -> FleetResult:
+    sc = classify(*p.dims(), OPT64.dtype, LADDER)
+    return FleetResult(
+        name=p.name, shape=sc, lane=0, lanes=1,
+        cameras=np.asarray(p.cameras).copy(),
+        points=np.asarray(p.points).copy(),
+        cost=np.float64(1.0), initial_cost=np.float64(2.0),
+        iterations=1, accepted=1, pcg_iterations=1,
+        status=int(SolveStatus.CONVERGED), recoveries=0, latency_s=0.0)
+
+
+def _fleet(n, n_pt=16):
+    return [_mk(seed, n_pt) for seed in range(n)]
+
+
+def test_router_routes_resolves_and_counts():
+    probs = _fleet(4, n_pt=16) + _fleet(3, n_pt=128)
+    w0, w1 = StubWorker("w0"), StubWorker("w1")
+    with FleetRouter(OPT64, workers=[w0, w1], max_batch=8) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        results = [f.result(timeout=5) for f in futs]
+    assert all(r.name == p.name for r, p in zip(results, probs))
+    assert all(r.status == int(SolveStatus.CONVERGED) for r in results)
+    d = router.stats.as_dict()
+    assert d["problems"] == 7
+    assert sum(d["problems_by_worker"].values()) == 7
+    # two shape classes -> two homes: both workers served
+    assert len(d["problems_by_worker"]) == 2
+    assert d["workers_lost"] == 0 and d["reroutes"] == 0
+
+
+def test_router_steal_moves_backlog_to_idle_warm_worker():
+    probs = _fleet(8, n_pt=16)
+    bucket = str(classify(*probs[0].dims(), OPT64.dtype, LADDER))
+    release = threading.Event()
+
+    def blocking(stub, problems):
+        # First batch wedges until released: the other worker must pull
+        # the backlog rather than wait behind it.
+        if len(stub.batches) == 1:
+            assert release.wait(timeout=30), "test deadlock"
+        return {"ok": True,
+                "results": [_stub_result(p) for p in problems],
+                "warm": sorted(stub.warm)}
+
+    w0 = StubWorker("w0", warm=[bucket], behavior=blocking)
+    w1 = StubWorker("w1", warm=[bucket], behavior=blocking)
+    try:
+        with FleetRouter(OPT64, workers=[w0, w1], max_batch=4) as router:
+            futs = [router.submit(p) for p in probs]
+            # both workers take one 4-batch each: one owns the bucket,
+            # the other STEALS the backlog it is warm for
+            t0 = time.monotonic()
+            while (len(w0.batches) + len(w1.batches) < 2
+                   and time.monotonic() - t0 < 10):
+                time.sleep(0.005)
+            release.set()
+            router.flush()
+            results = [f.result(timeout=10) for f in futs]
+    finally:
+        release.set()
+    assert len(results) == 8
+    d = router.stats.as_dict()
+    assert d["steals"] == 1, d
+    assert d["stolen_problems"] == 4, d
+    assert sorted(d["problems_by_worker"].values()) == [4, 4], d
+
+
+def test_router_worker_loss_reroutes_to_survivor():
+    probs = _fleet(6, n_pt=16)
+
+    def dying(stub, problems):
+        raise WorkerLostError(stub.worker_id, "stub SIGKILL")
+
+    w0 = StubWorker("w0", behavior=dying)  # id tiebreak homes bucket here
+    w1 = StubWorker("w1")  # not warm: cannot steal, only reroute-absorb
+    with FleetRouter(OPT64, workers=[w0, w1], max_batch=16,
+                     steal=False) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        results = [f.result(timeout=10) for f in futs]
+        assert len(results) == 6
+        d = router.stats.as_dict()
+        assert d["workers_lost"] == 1 and d["lost_workers"] == ["w0"]
+        assert d["reroutes"] == 6
+        assert d["problems_by_worker"] == {"w1": 6}
+        # the router keeps serving on the survivor
+        fut = router.submit(_mk(99, 16))
+        router.flush()
+        assert fut.result(timeout=10).name == "s99_p16"
+
+
+def test_router_all_workers_lost_fails_typed_and_flush_returns():
+    def dying(stub, problems):
+        raise WorkerLostError(stub.worker_id, "stub death")
+
+    probs = _fleet(5, n_pt=16)
+    w0 = StubWorker("w0", behavior=dying)
+    w1 = StubWorker("w1", behavior=dying)
+    router = FleetRouter(OPT64, workers=[w0, w1], max_batch=4)
+    futs = [router.submit(p) for p in probs]
+    router.flush()  # must NOT wedge
+    for f in futs:
+        with pytest.raises(WorkerLostError, match="no surviving workers"):
+            f.result(timeout=5)
+    with pytest.raises(WorkerLostError, match="no surviving workers"):
+        router.submit(_mk(7, 16))
+    router.close()
+    assert router.stats.as_dict()["workers_lost"] == 2
+
+
+def test_router_max_reroutes_exhausted_is_typed():
+    calls = []
+
+    def dying(stub, problems):
+        calls.append(stub.worker_id)
+        raise WorkerLostError(stub.worker_id, "stub death")
+
+    probs = _fleet(2, n_pt=16)
+    # three workers, max_reroutes=1: initial + 1 reroute both die, the
+    # THIRD worker never gets the problems (bounded retry, PR 8 stance)
+    w = [StubWorker(f"w{i}", behavior=dying) for i in range(3)]
+    with FleetRouter(OPT64, workers=w, max_batch=4,
+                     max_reroutes=1, steal=False) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        for f in futs:
+            with pytest.raises(WorkerLostError, match="rerouted 1 times"):
+                f.result(timeout=5)
+    d = router.stats.as_dict()
+    assert d["workers_lost"] == 2  # the third never dispatched
+    assert d["reroute_failures"] == 2
+    assert len(set(calls)) == 2
+
+
+def test_router_solve_error_fails_batch_but_worker_survives():
+    def flaky(stub, problems):
+        if len(stub.batches) == 1:
+            return {"ok": False, "error": "ValueError('bad operand')"}
+        return {"ok": True,
+                "results": [_stub_result(p) for p in problems],
+                "warm": sorted(stub.warm)}
+
+    w0 = StubWorker("w0", behavior=flaky)
+    with FleetRouter(OPT64, workers=[w0], max_batch=4) as router:
+        bad = router.submit(_mk(0, 16))
+        router.flush()
+        with pytest.raises(RuntimeError, match="bad operand"):
+            bad.result(timeout=5)
+        good = router.submit(_mk(1, 16))
+        router.flush()
+        assert good.result(timeout=5).name == "s1_p16"
+    assert router.stats.as_dict()["workers_lost"] == 0
+
+
+def test_router_deadline_shed_before_dispatch():
+    gate = threading.Event()
+
+    def slow(stub, problems):
+        gate.wait(timeout=30)
+        return {"ok": True,
+                "results": [_stub_result(p) for p in problems],
+                "warm": sorted(stub.warm)}
+
+    w0 = StubWorker("w0", behavior=slow)
+    try:
+        with FleetRouter(OPT64, workers=[w0], max_batch=1) as router:
+            first = router.submit(_mk(0, 16))  # occupies the worker
+            doomed = router.submit(_mk(1, 16), deadline_s=0.01)
+            time.sleep(0.05)
+            gate.set()
+            router.flush()
+            assert first.result(timeout=10) is not None
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=5)
+    finally:
+        gate.set()
+    assert router.stats.as_dict()["sheds"] == 1
+
+
+def test_router_late_completion_flagged_deadline_missed():
+    """The FleetQueue parity contract: a result completing AFTER its
+    deadline is DELIVERED, flagged and counted — not silently on time
+    and not shed (it was dispatched in time)."""
+    def slow(stub, problems):
+        time.sleep(0.15)
+        return {"ok": True,
+                "results": [_stub_result(p) for p in problems],
+                "warm": sorted(stub.warm)}
+
+    w0 = StubWorker("w0", behavior=slow)
+    with FleetRouter(OPT64, workers=[w0], max_batch=4) as router:
+        fut = router.submit(_mk(0, 16), deadline_s=0.05)
+        router.flush()
+        r = fut.result(timeout=5)
+    assert r.deadline_missed is True
+    d = router.stats.as_dict()
+    assert d["deadline_misses"] == 1 and d["sheds"] == 0, d
+
+
+def test_router_close_idempotent_single_telemetry_line(tmp_path):
+    sink = str(tmp_path / "fed.jsonl")
+    router = FleetRouter(OPT64, workers=[StubWorker("w0")],
+                         telemetry=sink)
+    fut = router.submit(_mk(0, 16))
+    router.flush()
+    assert fut.result(timeout=5) is not None
+    router.close()
+    router.close()  # explicit double close
+    with open(sink) as fh:
+        lines = [l for l in fh if l.strip()]
+    assert len(lines) == 1, "duplicate federation report on double close"
+
+
+def test_router_done_callback_may_reenter_router():
+    """Shed and worker-lost resolutions run OUTSIDE the router lock: a
+    done-callback that re-enters the router (submit from a completion
+    hook) must not self-deadlock the serve thread."""
+    resubmitted = []
+
+    def dying(stub, problems):
+        raise WorkerLostError(stub.worker_id, "stub death")
+
+    w0 = StubWorker("w0", behavior=dying)
+    w1 = StubWorker("w1")
+    router = FleetRouter(OPT64, workers=[w0, w1], max_batch=4,
+                         steal=False, max_reroutes=0)
+    fut = router.submit(_mk(0, 16))
+
+    def reenter(f):
+        try:
+            resubmitted.append(router.submit(_mk(1, 16)))
+        except Exception as e:  # noqa: BLE001 - recorded for the assert
+            resubmitted.append(e)
+
+    fut.add_done_callback(reenter)
+    router.flush()
+    with pytest.raises(WorkerLostError):
+        fut.result(timeout=5)
+    assert len(resubmitted) == 1
+    if isinstance(resubmitted[0], Exception):
+        raise AssertionError(f"re-entrant submit failed: {resubmitted[0]}")
+    router.flush()
+    assert resubmitted[0].result(timeout=5) is not None
+    router.close()
+
+
+def test_router_validation():
+    with pytest.raises(ValueError, match="n_workers"):
+        FleetRouter(OPT64, n_workers=0)
+    with pytest.raises(ValueError, match="max_reroutes"):
+        FleetRouter(OPT64, workers=[StubWorker("w0")], max_reroutes=-1)
+    router = FleetRouter(OPT64, workers=[StubWorker("w0")])
+    with pytest.raises(ValueError, match="deadline_s"):
+        router.submit(_mk(0, 16), deadline_s=-1.0)
+    router.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        router.submit(_mk(0, 16))
+
+
+# ---------------------------------------------------------------------------
+# Federation observability
+# ---------------------------------------------------------------------------
+
+
+def test_federation_stats_counters_and_report():
+    st = FederationStats()
+    st.record_batch("w0", 4, stolen=False)
+    st.record_batch("w1", 2, stolen=True)
+    st.record_reroute(3)
+    st.record_worker_lost("w1")
+    st.record_cold_start("w0", {"mode": "artifact", "warm_s": 0.42,
+                                "artifact_loads": 5,
+                                "artifact_compiles": 0})
+    st.record_first_solve("w0", {"traces": 0, "wall_s": 0.5})
+    d = st.as_dict()
+    assert d["problems"] == 6 and d["steals"] == 1
+    assert d["stolen_problems"] == 2 and d["reroutes"] == 3
+    assert d["workers_lost"] == 1 and d["lost_workers"] == ["w1"]
+    text = st.report()
+    assert "6 problems" in text and "1 steals" in text
+    assert "artifact 0.420s" in text and "first solve 0 traces" in text
+
+
+def test_summarize_federation_block(tmp_path):
+    from megba_tpu.observability import summarize
+    from megba_tpu.utils.timing import PhaseTimer
+
+    st = FederationStats()
+    st.record_batch("w0", 9, stolen=False)
+    st.record_batch("w1", 7, stolen=True)
+    st.record_reroute(5)
+    st.record_worker_lost("w1")
+    st.record_cold_start("w0", {"mode": "artifact", "warm_s": 0.351,
+                                "artifact_loads": 5,
+                                "artifact_compiles": 0})
+    st.record_cold_start("w1", {"mode": "compile", "warm_s": 93.2,
+                                "artifact_loads": 0,
+                                "artifact_compiles": 5})
+    st.record_first_solve("w0", {"traces": 0, "wall_s": 1.0})
+    sink = str(tmp_path / "fed.jsonl")
+    append_federation_report(OPT64, st, PhaseTimer(), sink)
+    # a second (older-router) snapshot must SUM, not duplicate: same
+    # router id keeps only the newest line
+    append_federation_report(OPT64, st, PhaseTimer(), sink)
+    out = summarize.aggregate_paths([sink])
+    assert "federation: 16 problems across 2 workers" in out
+    assert "w0:9 / w1:7" in out
+    assert "1 steals (7 problems)" in out
+    assert "5 rerouted, 1 workers lost" in out
+    assert "cold start w0: artifact 0.351s (5 loaded / 0 compiled)" in out
+    assert "first solve 0 traces" in out
+    assert "cold start w1: compile 93.200s (0 loaded / 5 compiled)" in out
+    assert summarize.main(["--aggregate", sink]) == 0
+
+
+def test_solve_report_federation_round_trip():
+    from megba_tpu.observability.report import SolveReport
+
+    rep = SolveReport(problem={}, config={}, backend={}, phases={},
+                      result={}, federation={"router": "abc",
+                                             "problems": 3})
+    back = SolveReport.from_json(rep.to_json())
+    assert back.federation == {"router": "abc", "problems": 3}
+    # pre-federation lines (no field) still parse
+    line = json.dumps({"problem": {}, "config": {}, "backend": {},
+                       "phases": {}, "result": {}})
+    assert SolveReport.from_json(line).federation is None
+
+
+def test_fleet_stats_artifact_counters():
+    st = FleetStats()
+    st.record_artifact(True)
+    st.record_artifact(True)
+    st.record_artifact(False)
+    d = st.as_dict()
+    assert d["artifact_loads"] == 2 and d["artifact_compiles"] == 1
+    assert "artifact store: 2 loaded / 1 compiled" in st.report()
+
+
+# ---------------------------------------------------------------------------
+# Real programs (slow lane)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_artifact_export_import_bitwise_and_zero_trace(tmp_path):
+    """The cold-start contract on a REAL bucket program: export →
+    fresh-replica state → warm from artifacts (zero compiles, zero
+    traces) → dispatch bitwise-identical to the exporter's."""
+    from megba_tpu.analysis import retrace
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving.compile_pool import reset_process_cache
+
+    engine = make_residual_jacobian_fn(mode=OPT64.jacobian_mode)
+    store = ArtifactStore(str(tmp_path / "store"))
+    probs = [_mk(0, 16), _mk(1, 16)]
+
+    stats = FleetStats()
+    pool = CompilePool(stats=stats, artifacts=store)
+    base = solve_many(probs, OPT64, pool=pool, stats=stats)
+    manifest = str(tmp_path / "manifest.json")
+    pool.save_manifest(manifest, option=OPT64)
+    assert pool.export_artifacts(engine, OPT64) == 1
+    assert len(store.entries()) == 1
+
+    # -- fresh replica ---------------------------------------------------
+    reset_process_cache()
+    stats2 = FleetStats()
+    pool2 = CompilePool(stats=stats2, artifacts=store)
+    snap = retrace.snapshot()
+    assert pool2.warm_from_manifest(manifest, engine, OPT64,
+                                    strict=True) == 1
+    assert stats2.artifact_loads == 1 and stats2.artifact_compiles == 0
+    again = solve_many(probs, OPT64, pool=pool2, stats=stats2)
+    new = {k: v - snap.get(k, 0) for k, v in retrace.snapshot().items()
+           if v > snap.get(k, 0)}
+    assert sum(new.values()) == 0, (
+        f"artifact-warmed replica traced a program: {new}")
+    assert stats2.pool_hits >= 1 and stats2.pool_misses == 0
+    for a, b in zip(base, again):
+        assert _bits(a.cameras) == _bits(b.cameras)
+        assert _bits(a.points) == _bits(b.points)
+        assert _bits(a.cost) == _bits(b.cost)
+        assert int(a.status) == int(b.status)
+
+
+@pytest.mark.slow
+def test_router_two_real_workers_bitwise_vs_solve_many(tmp_path):
+    """Two REAL worker processes warmed from artifacts: zero first-solve
+    traces in both, results bitwise vs a single-host solve_many
+    control.  (The kill/reroute path at scale lives in the run_tests.sh
+    federation smoke.)"""
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.serving.compile_pool import reset_process_cache
+
+    engine = make_residual_jacobian_fn(mode=OPT64.jacobian_mode)
+    store_root = str(tmp_path / "store")
+    store = ArtifactStore(store_root)
+    probs = [_mk(i, 16) for i in range(3)] + [_mk(i, 128) for i in range(2)]
+
+    stats = FleetStats()
+    pool = CompilePool(stats=stats, artifacts=store)
+    control = solve_many(probs, OPT64, pool=pool, stats=stats)
+    manifest = str(tmp_path / "manifest.json")
+    pool.save_manifest(manifest, option=OPT64)
+    assert pool.export_artifacts(engine, OPT64) == len(store.entries())
+
+    with FleetRouter(OPT64, n_workers=2, artifacts=store_root,
+                     manifest=manifest, strict_manifest=True) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        results = [f.result(timeout=60) for f in futs]
+        d = router.stats.as_dict()
+    for r, c in zip(results, control):
+        assert _bits(r.cameras) == _bits(c.cameras), r.name
+        assert _bits(r.cost) == _bits(c.cost), r.name
+        assert int(r.status) == int(c.status), r.name
+    for wid, cs in d["cold_start"].items():
+        assert cs["mode"] == "artifact", (wid, cs)
+        assert cs["artifact_compiles"] == 0, (wid, cs)
+    for wid, fs in d["first_solve"].items():
+        assert fs["traces"] == 0, (wid, fs)
+    assert sum(d["problems_by_worker"].values()) == len(probs)
