@@ -70,6 +70,15 @@ def solve_g2o(*args, **kwargs):
     return _solve_g2o(*args, **kwargs)
 
 
+def flat_solve(*args, **kwargs):
+    """The flat-array solve pipeline — see solve.py.  With `factor=` a
+    registered residual family (megba_tpu/factors/) resolves the
+    engine; lazy import keeps package import light."""
+    from megba_tpu.solve import flat_solve as _flat_solve
+
+    return _flat_solve(*args, **kwargs)
+
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -97,6 +106,7 @@ __all__ = [
     "SolverKind",
     "SolverOption",
     "VertexKind",
+    "flat_solve",
     "solve_bal",
     "solve_g2o",
     "solve_pgo",

@@ -332,6 +332,50 @@ def _lower_pgo(world: int):
                      lower_only=True)
 
 
+def _lower_factor(factor: str, dtype=np.float32):
+    """Canonical program of one registered Schur factor family, lowered
+    through flat_solve's registry dispatch (the production seam every
+    factor solve rides — engine resolution included)."""
+    import dataclasses as _dc
+
+    from megba_tpu.solve import flat_solve
+
+    if factor == "rig":
+        from megba_tpu.factors.rig import make_synthetic_rig
+
+        s = make_synthetic_rig(num_bodies=4, num_points=24, seed=0,
+                               dtype=dtype)
+    elif factor == "pinhole_radial":
+        from megba_tpu.factors.radial import make_synthetic_radial
+
+        s = make_synthetic_radial(num_cameras=4, num_points=24, seed=0,
+                                  dtype=dtype)
+    elif factor == "pose_prior":
+        from megba_tpu.factors.priors import make_synthetic_priors
+
+        s = make_synthetic_priors(num_poses=8, seed=0, dtype=dtype)
+    else:
+        raise ValueError(f"no canonical problem for factor {factor!r}")
+    option = _dc.replace(_ba_option(), dtype=dtype)
+    return flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                      s.pt_idx, option, use_tiled=False, factor=factor,
+                      lower_only=True)
+
+
+def _lower_sim3(world: int):
+    from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+    from megba_tpu.factors.sim3 import make_synthetic_sim3_graph
+    from megba_tpu.models.pgo import solve_pgo
+
+    g = make_synthetic_sim3_graph(num_poses=16, loop_closures=4, seed=1)
+    option = ProblemOption(
+        dtype=np.float64, world_size=world,
+        algo_option=AlgoOption(max_iter=3),
+        solver_option=SolverOption(max_iter=8, tol=1e-10))
+    return solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, option,
+                     factor="sim3_between", lower_only=True)
+
+
 def _sharded_donation() -> Tuple[int, ...]:
     # Donation of the replicated parameter blocks is gated off under the
     # experimental shard_map fallback (freed-buffer aliasing hazard —
@@ -432,6 +476,44 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # (compile_pool._build_batched_solve donate_argnums=(0, 1)).
             donate_leaves=(0, 1),
             build=lambda: _lower_batched(lanes=4)),
+        # ---- factor-registry canonical programs ----------------------
+        # One per new family (ISSUE 13): each is lowered through the
+        # registry seam itself (flat_solve(factor=...) / solve_pgo
+        # (factor=...)), so the audited program IS what a registered-
+        # factor solve dispatches — a registry refactor that changed
+        # the lowering, leaked a dtype through a new residual, or added
+        # a collective fails this gate, exactly like the BAL/PGO
+        # originals.
+        "ba_rig_single_f32": ProgramSpec(
+            name="ba_rig_single_f32", float_family="f32", world=1,
+            # Single device: the rig's shared-body-block Schur solve
+            # must carry zero collectives like every single-device
+            # program.
+            pcg_psums=0,
+            donate_leaves=(0, 1),
+            build=lambda: _lower_factor("rig")),
+        "ba_radial_single_f32": ProgramSpec(
+            name="ba_radial_single_f32", float_family="f32", world=1,
+            pcg_psums=0,
+            donate_leaves=(0, 1),
+            build=lambda: _lower_factor("pinhole_radial")),
+        "prior_single_f64": ProgramSpec(
+            name="prior_single_f64", float_family="f64", world=1,
+            # The unary-prior family runs f64 (its GPS/marginalization
+            # use cases are precision-sensitive), exercising the
+            # inverse dtype census on a registry factor: an f32 leak in
+            # the prior residual's rotation chain fails here.
+            pcg_psums=0,
+            donate_leaves=(0, 1),
+            build=lambda: _lower_factor("pose_prior", np.float64)),
+        "pgo_sim3_single_f64": ProgramSpec(
+            name="pgo_sim3_single_f64", float_family="f64", world=1,
+            # The sim(3) family rides the genericized PGO driver; its
+            # 7-dof blocks must lower collective-free on one device
+            # exactly like the SE(3) program.
+            pcg_psums=0,
+            donate_leaves=(0,),
+            build=lambda: _lower_sim3(world=1)),
         "pgo_single_f64": ProgramSpec(
             name="pgo_single_f64", float_family="f64", world=1, pcg_psums=0,
             donate_leaves=(0,),

@@ -1,0 +1,69 @@
+"""Pluggable factor registry — the multi-model residual engine.
+
+Import this package and the built-in families are registered:
+
+Schur (camera/point) families, solved by `solve.flat_solve` /
+`serving.solve_many` / `serving.FleetQueue`:
+
+  - ``bal``            BAL pinhole (9/3/2) — the flagship
+  - ``planar``         SE(2) planar BA (4/2/1)
+  - ``rig``            multi-camera rig, shared body extrinsic (7/3/8)
+  - ``pinhole_radial`` full-intrinsics radial pinhole (12/3/2)
+  - ``pose_prior``     GPS/IMU/marginalization unary SE(3) prior (6/3/6)
+
+Pose-graph families, solved by `models.pgo.solve_pgo`:
+
+  - ``se3_between``    SE(3) between-factor PGO (6-dof)
+  - ``sim3_between``   scale-aware sim(3) PGO (7-dof)
+
+Registering your own (see README "Registering a custom factor"): write
+a per-edge residual function, wrap it in a `FactorSpec`, call
+`register_factor` — the engine, all three flat_solve lowerings, the
+fleet serving tier (shape classes key on (factor, dims, dtype)), triage
+and telemetry all dispatch through the spec with zero further wiring.
+"""
+
+from megba_tpu.factors.engine import engine_for
+from megba_tpu.factors.registry import (
+    DuplicateFactorError,
+    FactorError,
+    FactorSpec,
+    FactorTriage,
+    PoseFactorSpec,
+    UnknownFactorError,
+    get_factor,
+    list_factors,
+    register_factor,
+    unregister_factor,
+    validate_factor_arrays,
+)
+
+# ---- built-in registrations (import order = table order above) ----------
+from megba_tpu.factors import bal as _bal
+from megba_tpu.factors import planar as _planar
+from megba_tpu.factors import rig as _rig
+from megba_tpu.factors import radial as _radial
+from megba_tpu.factors import priors as _priors
+from megba_tpu.factors import pose_graph as _pose_graph
+from megba_tpu.factors import sim3 as _sim3
+
+for _spec in (_bal.SPEC, _planar.SPEC, _rig.SPEC, _radial.SPEC,
+              _priors.SPEC, _pose_graph.SPEC, _sim3.SPEC):
+    # Idempotent: a re-imported package (importlib.reload in tests)
+    # must not trip its own duplicate refusal.
+    register_factor(_spec, allow_override=True)
+
+__all__ = [
+    "DuplicateFactorError",
+    "FactorError",
+    "FactorSpec",
+    "FactorTriage",
+    "PoseFactorSpec",
+    "UnknownFactorError",
+    "engine_for",
+    "get_factor",
+    "list_factors",
+    "register_factor",
+    "unregister_factor",
+    "validate_factor_arrays",
+]

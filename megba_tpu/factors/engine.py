@@ -1,0 +1,65 @@
+"""Registry-keyed engine resolution.
+
+One factor config must map to ONE engine object, forever: every jit
+program cache in the stack (solve._cached_single_solve, the sharded
+program cache, the serving compile pool, the AOT artifact keys) keys on
+engine IDENTITY, so two engines for one config silently double every
+trace and compile.  `engine_for` guarantees that by composing two
+normalisations:
+
+1. call-shape normalisation — the lookup rides
+   `utils.memo.normalized_lru_cache` (the generalised form of PR 6's
+   footgun fix on `make_residual_jacobian_fn`), so positional/keyword/
+   defaulted spellings collapse;
+2. mode-irrelevant-field canonicalisation — `analytical_fn` is dropped
+   from the underlying engine key unless the mode actually selects it
+   (AUTODIFF ignores it; keying on it anyway would make the registry's
+   `bal` engine a DIFFERENT object from the historical
+   `make_residual_jacobian_fn()` default — a duplicate program per
+   bucket, which the bitwise-identity tests pin against).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from megba_tpu.common import JacobianMode
+from megba_tpu.factors.registry import (
+    FactorSpec,
+    get_factor,
+    require_schur,
+)
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.utils.memo import normalized_lru_cache
+
+
+@normalized_lru_cache(maxsize=64)
+def _engine_for_spec(spec: FactorSpec, mode: JacobianMode):
+    if mode == JacobianMode.ANALYTICAL:
+        if spec.analytical_fn is None:
+            from megba_tpu.factors.registry import FactorError
+
+            raise FactorError(
+                f"factor {spec.name!r} has no analytical Jacobian; use "
+                "JacobianMode.AUTODIFF / AUTODIFF_FORWARD, or register "
+                "the spec with analytical_fn")
+        return make_residual_jacobian_fn(
+            spec.residual_fn, mode, spec.analytical_fn)
+    # Autodiff modes ignore analytical_fn: canonicalise it OUT of the
+    # engine key so get_factor("bal") resolves to the IDENTICAL engine
+    # object the historical make_residual_jacobian_fn() default returns
+    # (same lru entry -> same jit caches -> zero duplicate programs).
+    return make_residual_jacobian_fn(spec.residual_fn, mode, None)
+
+
+def engine_for(factor: Union[str, FactorSpec],
+               mode: JacobianMode = JacobianMode.AUTODIFF):
+    """The residual+Jacobian engine of a registered factor.
+
+    Accepts a name or a spec; raises typed `UnknownFactorError` /
+    `FactorError` for unknown names, pose-graph factors (they have no
+    camera/point engine) and ANALYTICAL requests on factors without a
+    closed form.  Memoised: one (spec, mode) -> one engine object.
+    """
+    spec = require_schur(get_factor(factor), "engine_for")
+    return _engine_for_spec(spec, mode)

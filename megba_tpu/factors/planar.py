@@ -1,0 +1,24 @@
+"""The planar SE(2) family as a registered factor spec.
+
+models/planar.py proved the solver stack dimension-generic; registering
+it costs three lines and makes the family servable through the fleet.
+No triage hooks: the 1-D image-line projection has no cheirality
+half-space in the BAL sense, so the geometric triage pass is skipped
+for planar problems (structural checks still run).
+"""
+
+from __future__ import annotations
+
+from megba_tpu.factors.registry import FactorSpec
+from megba_tpu.models.planar import CAMERA_DIM, OBS_DIM, POINT_DIM, residual
+
+SPEC = FactorSpec(
+    name="planar",
+    cam_dim=CAMERA_DIM,
+    pt_dim=POINT_DIM,
+    obs_dim=OBS_DIM,
+    residual_dim=1,
+    residual_fn=residual,
+    description="planar (2D) BA: camera [theta, tx, ty, f], point (2,), "
+                "obs = 1-D image coordinate",
+)

@@ -1,0 +1,23 @@
+"""SE(3) between-factor PGO as a registered pose-graph factor.
+
+The historical PGO family (models/pgo.py), re-declared as registry
+data.  The spec's `residual_fn` IS `models.pgo.between_residual`, so
+`solve_pgo(factor="se3_between")` — the default — traces the exact
+program the pre-registry driver traced (byte-identical lowering pinned
+by tests/test_factors.py; the `pgo_*` audit budgets are unchanged).
+"""
+
+from __future__ import annotations
+
+from megba_tpu.factors.registry import PoseFactorSpec
+from megba_tpu.models.pgo import POSE_DIM, between_residual
+
+SPEC = PoseFactorSpec(
+    name="se3_between",
+    pose_dim=POSE_DIM,
+    meas_dim=POSE_DIM,
+    residual_dim=POSE_DIM,
+    residual_fn=between_residual,
+    description="SE(3) between-factor PGO: pose [aa(3), t(3)], "
+                "right-invariant error [log_SO3, t]",
+)

@@ -1,0 +1,259 @@
+"""Factor registry: declarative specs for every residual family.
+
+MegBA's public surface is a g2o-compatible Problem/Vertex/Edge API over
+one end-to-end vectorised residual engine (arxiv 2112.01349 §3); until
+this subsystem the repo hard-coded two residual families (BAL pinhole
+reprojection and SE(3) between-factor PGO), each with bespoke plumbing.
+The registry turns "a residual family" into DATA: a frozen spec naming
+the parameter-block dims, the residual dimension, the per-edge residual
+function, the optional closed-form Jacobian, and the host-side triage
+hooks — and every layer of the stack dispatches through it:
+
+- `solve.flat_solve(..., factor=)` resolves the engine via
+  `factors.engine.engine_for` (all three lowerings, unchanged);
+- the serving layer keys shape classes on (factor, dims, dtype), so a
+  registered factor is IMMEDIATELY servable through `solve_many` /
+  `FleetQueue` with zero cross-factor retraces (engine identity is in
+  every program-cache key);
+- pre-flight triage dispatches its geometric checks through the spec's
+  hooks (cheirality only means something for projective factors);
+- the ingestion gate reads `unique_edges` (a rig observes one
+  (body, point) pair once per physical camera; a prior may legitimately
+  repeat a constraint — neither is the duplicate-factor poison BAL
+  ingestion rejects).
+
+Two spec kinds cover the solver's two drivers: `FactorSpec` for the
+camera/point (Schur) pipeline and `PoseFactorSpec` for the pose-graph
+driver (two same-kind blocks, models/pgo.py).  Both are frozen and
+hashable — a spec IS a cache key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+
+class FactorError(ValueError):
+    """Base class for registry errors (typed, caller-matchable)."""
+
+
+class UnknownFactorError(FactorError):
+    """A factor name no registered spec answers to.
+
+    Raised at every dispatch boundary (`flat_solve`, `solve_pgo`,
+    `solve_many`, `FleetQueue.submit`) so a typo'd factor name fails
+    typed at ingestion, never as a shape error mid-lowering.
+    """
+
+    def __init__(self, name: str, known: List[str]):
+        self.name = name
+        self.known = list(known)
+        super().__init__(
+            f"unknown factor {name!r}; registered factors: "
+            f"{', '.join(known) if known else '(none)'}")
+
+
+class DuplicateFactorError(FactorError):
+    """`register_factor` refused to overwrite an existing name.
+
+    Silent re-registration would swap the engine behind every cache
+    keyed on the old spec; pass `allow_override=True` only in tests.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        super().__init__(
+            f"factor {name!r} is already registered; re-registering "
+            "would orphan every engine/program cached under the old "
+            "spec (pass allow_override=True only if you mean it)")
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorTriage:
+    """Host-side geometric hooks for pre-flight triage (pure NumPy).
+
+    Only PROJECTIVE factors can answer "is this point behind the
+    camera" — for a factor without hooks the triage geometric pass is
+    skipped entirely (structural + non-finite checks still run, and the
+    HealthReport records `geometric=False` so downstream gates know the
+    projective checks never happened).
+
+    `project_depth(cam_blocks [nE, cd], pt_blocks [nE, pd],
+    obs [nE, od]) -> (uv [nE, 2], depth [nE])` projects each edge's
+    point through its camera — obs rides along because some factors
+    (the rig) carry per-edge constants (the mount extrinsic) the
+    projection needs.  `uv_cols` names the obs columns holding the
+    measured pixel, for the extreme-residual check.  `camera_centers
+    (cameras [Nc, cd]) -> [Nc, 3]` is optional; without it the
+    low-parallax check is skipped (it needs 3D viewing rays).
+    """
+
+    project_depth: Callable  # (cams, pts, obs) -> (uv, depth)
+    uv_cols: Tuple[int, int] = (0, 2)  # obs[:, lo:hi] = measured pixel
+    camera_centers: Optional[Callable] = None  # (cameras) -> [Nc, 3]
+
+
+@dataclasses.dataclass(frozen=True)
+class FactorSpec:
+    """One camera/point (Schur-pipeline) residual family.
+
+    The engine contract is the one `ops/residuals.py` has always had:
+    `residual_fn(camera [cam_dim], point [pt_dim], obs [obs_dim]) ->
+    r [residual_dim]` for ONE edge, vectorised over the minor edge axis
+    by the engine builder; `analytical_fn`, when present, is the
+    feature-major closed form ((cam [cd, nE], pt [pd, nE],
+    obs [od, nE]) -> (r, Jc, Jp) row layout) selected by
+    `JacobianMode.ANALYTICAL`.
+
+    `obs_dim` and `residual_dim` are independent: obs is the per-edge
+    CONSTANT vector (a rig edge carries its mount extrinsic there, a
+    prior edge its prior pose), residual_dim is the row count of r —
+    `sqrt_info` weights are [residual_dim, residual_dim] per edge.
+
+    `robust_ok=False` marks families whose residual is not a
+    reprojection-style error where IRLS reweighting is meaningful
+    (validated at solve time).  `unique_edges=False` lifts the
+    duplicate-(cam_idx, pt_idx) ingestion refusal — repeated index
+    pairs are how rigs (one pair per physical camera) and repeated
+    priors encode legitimate factors.  `point_coupled=False` declares
+    the residual ignores the point block (unary camera factors): the
+    point side assembles to identity Hessian blocks and the Schur trick
+    degenerates gracefully.
+    """
+
+    name: str
+    cam_dim: int
+    pt_dim: int
+    obs_dim: int
+    residual_dim: int
+    residual_fn: Callable
+    analytical_fn: Optional[Callable] = None
+    robust_ok: bool = True
+    unique_edges: bool = True
+    point_coupled: bool = True
+    triage: Optional[FactorTriage] = None
+    description: str = ""
+
+    kind = "schur"
+
+    def __post_init__(self) -> None:
+        for f in ("cam_dim", "pt_dim", "obs_dim", "residual_dim"):
+            if getattr(self, f) < 1:
+                raise FactorError(
+                    f"factor {self.name!r}: {f} must be >= 1, "
+                    f"got {getattr(self, f)}")
+
+
+@dataclasses.dataclass(frozen=True)
+class PoseFactorSpec:
+    """One pose-graph (two same-kind blocks) residual family.
+
+    Drives the PGO pipeline (models/pgo.py): `residual_fn(pose_i
+    [pose_dim], pose_j [pose_dim], meas [meas_dim]) ->
+    r [residual_dim]` for one edge; Jacobians come from forward-mode
+    autodiff of the exact residual, exactly as the SE(3) family always
+    has.  `sqrt_info` weights are [residual_dim, residual_dim].
+    """
+
+    name: str
+    pose_dim: int
+    meas_dim: int
+    residual_dim: int
+    residual_fn: Callable
+    description: str = ""
+
+    kind = "pose_graph"
+
+    def __post_init__(self) -> None:
+        for f in ("pose_dim", "meas_dim", "residual_dim"):
+            if getattr(self, f) < 1:
+                raise FactorError(
+                    f"factor {self.name!r}: {f} must be >= 1, "
+                    f"got {getattr(self, f)}")
+
+
+AnySpec = Union[FactorSpec, PoseFactorSpec]
+
+_REGISTRY: Dict[str, AnySpec] = {}
+
+
+def register_factor(spec: AnySpec, allow_override: bool = False) -> AnySpec:
+    """Register a factor spec under its name; returns the spec.
+
+    Refuses duplicates (typed `DuplicateFactorError`) unless
+    `allow_override=True`: the registry is process-global and every
+    engine/program cache keys on spec identity, so silently swapping a
+    name would leave stale engines serving the old physics.
+    """
+    if not isinstance(spec, (FactorSpec, PoseFactorSpec)):
+        raise FactorError(
+            f"register_factor wants a FactorSpec or PoseFactorSpec, "
+            f"got {type(spec).__name__}")
+    if spec.name in _REGISTRY and not allow_override:
+        raise DuplicateFactorError(spec.name)
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def unregister_factor(name: str) -> None:
+    """Remove a registration (test helper; pairs with allow_override)."""
+    _REGISTRY.pop(name, None)
+
+
+def get_factor(name_or_spec: Union[str, AnySpec]) -> AnySpec:
+    """Resolve a factor by name (typed `UnknownFactorError` on a miss);
+    specs pass through unchanged so call sites accept either."""
+    if isinstance(name_or_spec, (FactorSpec, PoseFactorSpec)):
+        return name_or_spec
+    spec = _REGISTRY.get(name_or_spec)
+    if spec is None:
+        raise UnknownFactorError(str(name_or_spec), sorted(_REGISTRY))
+    return spec
+
+
+def list_factors() -> Dict[str, AnySpec]:
+    """Snapshot of the registry (name -> spec), registration-stable."""
+    return dict(_REGISTRY)
+
+
+def require_schur(spec: AnySpec, where: str) -> FactorSpec:
+    """Typed refusal when a pose-graph factor reaches the Schur
+    pipeline (`flat_solve`/`solve_many` cannot solve it — the blocks
+    are same-kind; point the caller at `solve_pgo`)."""
+    if spec.kind != "schur":
+        raise FactorError(
+            f"{where}: factor {spec.name!r} is a pose-graph family "
+            "(two same-kind blocks); solve it with "
+            "megba_tpu.models.pgo.solve_pgo(factor=...), not the "
+            "camera/point Schur pipeline")
+    return spec  # type: ignore[return-value]
+
+
+def require_pose_graph(spec: AnySpec, where: str) -> PoseFactorSpec:
+    """Typed refusal when a Schur factor reaches the PGO driver."""
+    if spec.kind != "pose_graph":
+        raise FactorError(
+            f"{where}: factor {spec.name!r} is a camera/point (Schur) "
+            "family; solve it with megba_tpu.solve.flat_solve / "
+            "solve_many(factor=...), not the pose-graph driver")
+    return spec  # type: ignore[return-value]
+
+
+def validate_factor_arrays(spec: FactorSpec, cameras, points, obs,
+                           where: str = "flat_solve") -> None:
+    """Typed dim check: the arrays' feature widths must match the spec.
+
+    Catching a (cd, pd, od) mismatch HERE names the factor and the
+    offending axis; letting it through surfaces as an opaque reshape
+    error deep inside the engine vmap.
+    """
+    got = (int(cameras.shape[1]), int(points.shape[1]), int(obs.shape[1]))
+    want = (spec.cam_dim, spec.pt_dim, spec.obs_dim)
+    if got != want:
+        axes = ("cameras", "points", "obs")
+        bad = ", ".join(
+            f"{axes[k]} width {got[k]} (factor wants {want[k]})"
+            for k in range(3) if got[k] != want[k])
+        raise FactorError(
+            f"{where}: arrays do not match factor {spec.name!r}: {bad}")

@@ -1,0 +1,187 @@
+"""Multi-camera rig BA: N physical cameras sharing one body extrinsic.
+
+The rig family models a camera CLUSTER (stereo head, surround-view car
+rig, ladybug sphere): each capture has ONE optimisable body pose, and
+every physical camera k on the rig is a FIXED mount extrinsic
+T_mount_k composed on top of it.  In the camera/point block layout that
+means the camera-side block is the shared body pose (+ the rig's
+focal), and the mount rides the edge's OBSERVATION vector as a per-edge
+constant — so all K cameras of a capture share one 7-wide block through
+the Schur trick, and a rig problem has K edges per (body, point) pair
+(hence `unique_edges=False`: repeated (cam_idx, pt_idx) pairs are how
+the rig encodes its cameras, not duplicate factors).
+
+Block layout:
+  camera (7) = [body angle-axis (3), body translation (3), focal f]
+  point  (3)
+  obs    (8) = [u, v, mount angle-axis (3), mount translation (3)]
+
+Projection chain (BAL minus convention, shared with the pinhole
+families): X_body = R(w_b) X + t_b; X_cam = R(w_m) X_body + t_m;
+p = -X_cam[:2] / X_cam[2]; r = f p - [u, v].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from megba_tpu.factors.registry import FactorSpec, FactorTriage
+
+CAMERA_DIM = 7
+POINT_DIM = 3
+OBS_DIM = 8
+
+
+def rig_residual(camera: jnp.ndarray, point: jnp.ndarray,
+                 obs: jnp.ndarray) -> jnp.ndarray:  # megba: jit-entry
+    """2-row reprojection residual of one rig edge."""
+    from megba_tpu.ops import geo
+
+    w_b, t_b, f = camera[0:3], camera[3:6], camera[6]
+    uv, w_m, t_m = obs[0:2], obs[2:5], obs[5:8]
+    X_body = geo.angle_axis_rotate_point(w_b, point) + t_b
+    X_cam = geo.angle_axis_rotate_point(w_m, X_body) + t_m
+    p = -X_cam[0:2] / X_cam[2]
+    return f * p - uv
+
+
+def _rig_project_depth(cam_blocks: np.ndarray, pt_blocks: np.ndarray,
+                       obs: np.ndarray):
+    """Host twin of `rig_residual`'s projection, + camera-frame depth.
+
+    The triage cheirality check needs the PHYSICAL camera's depth, so
+    the mount (riding in obs) composes here exactly as on device.
+    """
+    from megba_tpu.io.synthetic import rotate_batch
+
+    X_body = rotate_batch(cam_blocks[:, 0:3], pt_blocks) + cam_blocks[:, 3:6]
+    X_cam = rotate_batch(obs[:, 2:5], X_body) + obs[:, 5:8]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p = -X_cam[:, 0:2] / X_cam[:, 2:3]
+        uv = cam_blocks[:, 6:7] * p
+    return uv, X_cam[:, 2]
+
+
+def _rig_centers(cameras: np.ndarray) -> np.ndarray:
+    """Body-frame centers C = -R_b^T t_b — the parallax proxy origin.
+
+    The physical cameras sit within mount-baseline distance of the body
+    center; for the ray-SPREAD proxy (robustness/triage.py) that offset
+    is noise, so the body center stands in for all of them.
+    """
+    from megba_tpu.io.synthetic import camera_centers
+
+    return camera_centers(cameras)
+
+
+SPEC = FactorSpec(
+    name="rig",
+    cam_dim=CAMERA_DIM,
+    pt_dim=POINT_DIM,
+    obs_dim=OBS_DIM,
+    residual_dim=2,
+    residual_fn=rig_residual,
+    unique_edges=False,  # K edges per (body, point): one per rig camera
+    triage=FactorTriage(project_depth=_rig_project_depth, uv_cols=(0, 2),
+                        camera_centers=_rig_centers),
+    description="multi-camera rig BA: shared body pose [aa(3), t(3), f], "
+                "per-edge mount extrinsic in obs[2:8]",
+)
+
+
+@dataclasses.dataclass
+class SyntheticRig:
+    """Ground truth + perturbed init for a synthetic rig scene."""
+
+    cameras_gt: np.ndarray  # [Nb, 7] body blocks
+    points_gt: np.ndarray  # [Np, 3]
+    cameras0: np.ndarray
+    points0: np.ndarray
+    obs: np.ndarray  # [nE, 8]
+    cam_idx: np.ndarray  # [nE] int32 (body index)
+    pt_idx: np.ndarray  # [nE] int32
+    mounts: np.ndarray  # [K, 6] the rig's mount extrinsics
+
+
+def make_synthetic_rig(
+    num_bodies: int = 4,
+    num_points: int = 24,
+    rig_cameras: int = 2,
+    obs_per_point: int = 2,
+    pixel_noise: float = 0.3,
+    param_noise: float = 2e-2,
+    seed: int = 0,
+    dtype: np.dtype = np.float64,
+) -> SyntheticRig:
+    """A K-camera rig observing a point cloud from `num_bodies` poses.
+
+    Scene convention mirrors io/synthetic.make_synthetic_bal (points in
+    a unit ball, bodies at camera-frame z ~ -5 so everything is visible
+    under the BAL minus projection); each observed (body, point) pair
+    is seen by ALL `rig_cameras` mounts — K edges per pair, the repeat
+    structure `unique_edges=False` exists for.  Observations come from
+    the model itself (residual with uv = 0), so generator and residual
+    cannot diverge.
+    """
+    r = np.random.default_rng(seed)
+    obs_per_point = min(obs_per_point, num_bodies)
+
+    points_gt = r.uniform(-1.0, 1.0, size=(num_points, 3))
+    bodies_gt = np.zeros((num_bodies, 7))
+    bodies_gt[:, 0:3] = r.normal(scale=0.05, size=(num_bodies, 3))
+    bodies_gt[:, 3:5] = r.normal(scale=0.2, size=(num_bodies, 2))
+    bodies_gt[:, 5] = -5.0 + r.normal(scale=0.2, size=num_bodies)
+    bodies_gt[:, 6] = 400.0 + r.normal(scale=4.0, size=num_bodies)
+
+    # Mount extrinsics: small rotations, ~0.3-unit baselines (a stereo
+    # head / surround cluster).  Identity-mean so the composed chain
+    # stays in the visible half-space.
+    mounts = np.zeros((rig_cameras, 6))
+    mounts[:, 0:3] = r.normal(scale=0.03, size=(rig_cameras, 3))
+    mounts[:, 3:6] = r.normal(scale=0.15, size=(rig_cameras, 3))
+
+    base = r.integers(0, num_bodies, size=(num_points, 1))
+    stride = 1 + r.integers(0, max(num_bodies // max(obs_per_point, 1), 1),
+                            size=(num_points, 1))
+    pair_cam = ((base + np.arange(obs_per_point)[None, :] * stride)
+                % num_bodies).reshape(-1)
+    pair_pt = np.repeat(np.arange(num_points), obs_per_point)
+    missing = np.setdiff1d(np.arange(num_bodies), pair_cam)
+    if missing.size:
+        pair_cam = np.concatenate([pair_cam, missing])
+        pair_pt = np.concatenate(
+            [pair_pt, r.integers(0, num_points, size=missing.size)])
+
+    # Fan each (body, point) pair out over the K rig cameras.
+    k_ax = np.arange(rig_cameras)
+    cam_idx = np.repeat(pair_cam, rig_cameras)
+    pt_idx = np.repeat(pair_pt, rig_cameras)
+    mount_rows = mounts[np.tile(k_ax, pair_cam.shape[0])]
+
+    uv, _ = _rig_project_depth(
+        bodies_gt[cam_idx],
+        points_gt[pt_idx],
+        np.concatenate([np.zeros((cam_idx.shape[0], 2)), mount_rows],
+                       axis=1))
+    obs = np.concatenate(
+        [uv + r.normal(scale=pixel_noise, size=uv.shape), mount_rows],
+        axis=1)
+
+    order = np.argsort(cam_idx, kind="stable")
+    cameras0 = bodies_gt + r.normal(
+        scale=param_noise, size=bodies_gt.shape) * np.array(
+            [1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 20.0])
+    points0 = points_gt + r.normal(scale=param_noise, size=points_gt.shape)
+    return SyntheticRig(
+        cameras_gt=bodies_gt.astype(dtype),
+        points_gt=points_gt.astype(dtype),
+        cameras0=cameras0.astype(dtype),
+        points0=points0.astype(dtype),
+        obs=obs[order].astype(dtype),
+        cam_idx=cam_idx[order].astype(np.int32),
+        pt_idx=pt_idx[order].astype(np.int32),
+        mounts=mounts.astype(dtype),
+    )

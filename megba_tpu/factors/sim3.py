@@ -1,0 +1,153 @@
+"""Scale-aware sim(3) pose-graph optimization.
+
+Monocular SLAM accumulates SCALE drift alongside rotation/translation
+drift; loop closing then needs pose-graph optimization over Sim(3)
+(Strasdat's "Scale Drift-Aware Large Scale Monocular SLAM" formulation,
+the one ORB-SLAM's EssentialGraph uses).  This family extends the SE(3)
+between-factor driver with one log-scale dof per pose:
+
+  pose (7) = [angle-axis (3), translation (3), log-scale l]
+  T x = e^l R x + t
+
+Between residual on edge (i, j) with measurement m = expected relative
+sim(3) transform T_ij = T_i^{-1} T_j:
+
+  T_rel = (R_i^T R_j,  e^{-l_i} R_i^T (t_j - t_i),  l_j - l_i)
+  E     = T_m^{-1} T_rel
+  r     = [log_SO3(E_R); E_t; E_l]            (7 rows)
+
+which reduces EXACTLY to the SE(3) between residual on the rotation and
+translation rows when every scale is 1 (l = 0) — the parity anchor
+tests/test_factors.py pins.  Jacobians come from forward-mode autodiff
+of the exact residual, like every pose-graph family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from megba_tpu.factors.registry import PoseFactorSpec
+
+SIM3_DIM = 7
+
+
+def sim3_between_residual(pose_i: jnp.ndarray, pose_j: jnp.ndarray,
+                          meas: jnp.ndarray) -> jnp.ndarray:  # megba: jit-entry
+    """7-row sim(3) between-factor residual for one edge."""
+    from megba_tpu.ops import geo
+
+    Ri = geo.angle_axis_to_rotation_matrix(pose_i[0:3])
+    Rj = geo.angle_axis_to_rotation_matrix(pose_j[0:3])
+    Rm = geo.angle_axis_to_rotation_matrix(meas[0:3])
+    li, lj, lm = pose_i[6], pose_j[6], meas[6]
+    R_rel = geo.mm(Ri.T, Rj)
+    t_rel = jnp.exp(-li) * geo.mm(
+        Ri.T, (pose_j[3:6] - pose_i[3:6])[:, None])[:, 0]
+    E_R = geo.mm(Rm.T, R_rel)
+    E_t = jnp.exp(-lm) * geo.mm(Rm.T, (t_rel - meas[3:6])[:, None])[:, 0]
+    E_l = (lj - li) - lm
+    return jnp.concatenate(
+        [geo.rotation_matrix_to_angle_axis(E_R), E_t, E_l[None]])
+
+
+SPEC = PoseFactorSpec(
+    name="sim3_between",
+    pose_dim=SIM3_DIM,
+    meas_dim=SIM3_DIM,
+    residual_dim=SIM3_DIM,
+    residual_fn=sim3_between_residual,
+    description="scale-aware sim(3) PGO: pose [aa(3), t(3), log-scale], "
+                "error [log_SO3, t, dlog-scale]",
+)
+
+
+# ---------------------------------------------------------------------------
+# Host-side sim(3) chart maps (batched NumPy, mirroring core/host_se3's
+# SE(3) pair) + a synthetic scale-drift pose graph.
+# ---------------------------------------------------------------------------
+
+def compose_sim3(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """T_a o T_b over [..., 7] sim(3) charts."""
+    from megba_tpu.core.host_se3 import compose
+
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    b6 = np.concatenate(
+        [b[..., 0:3], np.exp(a[..., 6:7]) * b[..., 3:6]], axis=-1)
+    se3 = compose(a[..., 0:6], b6)
+    return np.concatenate([se3, a[..., 6:7] + b[..., 6:7]], axis=-1)
+
+
+def relative_sim3(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """T_a^{-1} T_b over [..., 7] sim(3) charts (the measurement on an
+    (a, b) edge; `sim3_between_residual(a, b, relative_sim3(a, b))` is
+    identically zero — pinned by tests)."""
+    from megba_tpu.core.host_se3 import relative
+
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    se3 = relative(a[..., 0:6], b[..., 0:6])
+    return np.concatenate(
+        [se3[..., 0:3], np.exp(-a[..., 6:7]) * se3[..., 3:6],
+         b[..., 6:7] - a[..., 6:7]], axis=-1)
+
+
+@dataclasses.dataclass
+class SyntheticSim3Graph:
+    """Ground truth + scale-drifted odometry init for a loop-closed
+    sim(3) graph."""
+
+    poses_gt: np.ndarray  # [N, 7]
+    poses0: np.ndarray
+    edge_i: np.ndarray
+    edge_j: np.ndarray
+    meas: np.ndarray  # [nE, 7]
+
+
+def make_synthetic_sim3_graph(
+    num_poses: int = 24,
+    loop_closures: int = 5,
+    meas_noise: float = 0.0,
+    drift_noise: float = 0.04,
+    scale_drift: float = 0.02,
+    seed: int = 0,
+) -> SyntheticSim3Graph:
+    """Circle trajectory with odometry + loop closures, monocular-style:
+    the init integrates noisy odometry whose LOG-SCALE also drifts, so
+    loop closures must correct rotation, translation AND scale."""
+    rng = np.random.default_rng(seed)
+    th = 2 * np.pi * np.arange(num_poses) / num_poses
+    poses_gt = np.zeros((num_poses, SIM3_DIM))
+    poses_gt[:, 2] = th
+    poses_gt[:, 3] = np.cos(th)
+    poses_gt[:, 4] = np.sin(th)
+    poses_gt[:, 5] = 0.05 * np.sin(2 * th)
+    # Ground truth carries a gentle scale wave so the scale dof is live
+    # even in the noise-free measurements.
+    poses_gt[:, 6] = 0.1 * np.sin(th)
+
+    ei = list(range(num_poses - 1))
+    ej = list(range(1, num_poses))
+    for _ in range(loop_closures):
+        a = int(rng.integers(0, num_poses - 4))
+        b = int(rng.integers(a + 2, num_poses))
+        ei.append(a)
+        ej.append(b)
+    ei, ej = np.asarray(ei, np.int32), np.asarray(ej, np.int32)
+
+    meas = (relative_sim3(poses_gt[ei], poses_gt[ej])
+            + meas_noise * rng.standard_normal((len(ei), SIM3_DIM)))
+
+    poses0 = poses_gt.copy()
+    cur = poses_gt[0].copy()
+    noise = rng.standard_normal((num_poses - 1, SIM3_DIM))
+    noise[:, 0:6] *= drift_noise
+    noise[:, 6] *= scale_drift
+    for k in range(1, num_poses):
+        cur = compose_sim3(cur, meas[k - 1] + noise[k - 1])
+        poses0[k] = cur
+    return SyntheticSim3Graph(
+        poses_gt=poses_gt, poses0=poses0, edge_i=ei, edge_j=ej, meas=meas)

@@ -165,7 +165,8 @@ def _is_semantic_error(exc: BaseException) -> bool:
 
 def validate_problem(cameras: np.ndarray, points: np.ndarray,
                      obs: np.ndarray, cam_idx: np.ndarray,
-                     pt_idx: np.ndarray, *, where: str) -> None:
+                     pt_idx: np.ndarray, *, where: str,
+                     unique_edges: bool = True) -> None:
     """Reject semantically-poisoned problems with actionable context.
 
     A single NaN observation silently poisons every psum-reduced cost in
@@ -213,7 +214,12 @@ def validate_problem(cameras: np.ndarray, points: np.ndarray,
         raise ValueError(
             f"BAL semantic error in {where}: point {i} has non-finite "
             f"coordinates {np.asarray(points)[i].tolist()}")
-    if n_obs:
+    # Duplicate refusal is FACTOR semantics, not array hygiene: BAL
+    # edges are unique by construction, but a rig factor repeats a
+    # (body, point) pair once per physical camera and a prior factor
+    # may repeat a constraint — such families pass unique_edges=False
+    # (factors.FactorSpec.unique_edges) and skip only this check.
+    if n_obs and unique_edges:
         key = (cam_idx.astype(np.int64) * np.int64(n_pt)
                + pt_idx.astype(np.int64))
         uniq, first, counts = np.unique(key, return_index=True,

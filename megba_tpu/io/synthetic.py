@@ -71,6 +71,18 @@ def _project_batch(cameras: np.ndarray, points: np.ndarray) -> np.ndarray:
     return project_batch_depth(cameras, points)[0]
 
 
+def camera_centers(cameras: np.ndarray) -> np.ndarray:
+    """Camera centers C = -R^T t for [Nc, >=6] blocks laid out
+    [angle-axis(3), translation(3), ...].
+
+    THE host definition of "where does this camera sit" — shared by the
+    factor registry's triage hooks (factors/{bal,rig,radial}.py) and
+    the triage default (robustness/triage.py), so the parallax
+    viewing-ray origin can never diverge between factor families.
+    """
+    return -rotate_batch(-cameras[:, 0:3], cameras[:, 3:6])
+
+
 LOCALITY_MODES = (None, "ring", "grid")
 
 

@@ -89,8 +89,10 @@ class PGOResult(NamedTuple):
 
 def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
                emask=None, axis_name=None,
-               robust=None, robust_delta=1.0):
-    """r [6, nE], Ji/Jj [6, 6, nE] (weighted, fixed-masked), cost, wcost.
+               robust=None, robust_delta=1.0,
+               residual_fn=between_residual, pose_dim=POSE_DIM):
+    """r [rd, nE], Ji/Jj [rd, pd, nE] (weighted, fixed-masked), cost,
+    wcost — rd/pd from the factor spec (6/6 for the SE(3) family).
 
     `emask` [nE] zeroes padding edges (sharded solves pad the edge axis
     to a multiple of world_size, same scheme as core/types.pad_edges);
@@ -101,17 +103,19 @@ def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
     the weighted squared norm (the quadratic-model observable); without
     one they coincide.
     """
+    pd = pose_dim
 
     def g(x12, m):
-        return between_residual(x12[:POSE_DIM], x12[POSE_DIM:], m)
+        return residual_fn(x12[:pd], x12[pd:], m)
 
-    xi = jnp.take(poses_fm, edge_i, axis=1)  # [6, nE]
+    xi = jnp.take(poses_fm, edge_i, axis=1)  # [pd, nE]
     xj = jnp.take(poses_fm, edge_j, axis=1)
-    x12 = jnp.concatenate([xi, xj])  # [12, nE]
+    x12 = jnp.concatenate([xi, xj])  # [2*pd, nE]
     r = jax.vmap(g, in_axes=(1, 1), out_axes=1)(x12, meas_fm)
     J = jax.vmap(jax.jacfwd(g), in_axes=(1, 1), out_axes=2)(x12, meas_fm)
-    Ji, Jj = J[:, :POSE_DIM], J[:, POSE_DIM:]  # [6, 6, nE]
-    if sqrt_info is not None:  # [6, 6, nE] row-form W per edge
+    Ji, Jj = J[:, :pd], J[:, pd:]  # [rd, pd, nE]
+    rd = r.shape[0]
+    if sqrt_info is not None:  # [rd, rd, nE] row-form W per edge
         r = jnp.einsum("abe,be->ae", sqrt_info, r)
         Ji = jnp.einsum("abe,bce->ace", sqrt_info, Ji)
         Jj = jnp.einsum("abe,bce->ace", sqrt_info, Jj)
@@ -131,10 +135,10 @@ def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
         # r = 0 -> s = 0 -> rho = 0, w = 1.
         n_e = r.shape[1]
         r, Ji_f, Jj_f, rho_e = robustify(
-            r, Ji.reshape(POSE_DIM * POSE_DIM, n_e),
-            Jj.reshape(POSE_DIM * POSE_DIM, n_e), robust, robust_delta)
-        Ji = Ji_f.reshape(POSE_DIM, POSE_DIM, n_e)
-        Jj = Jj_f.reshape(POSE_DIM, POSE_DIM, n_e)
+            r, Ji.reshape(rd * pd, n_e),
+            Jj.reshape(rd * pd, n_e), robust, robust_delta)
+        Ji = Ji_f.reshape(rd, pd, n_e)
+        Jj = Jj_f.reshape(rd, pd, n_e)
         cost = comp_sum(rho_e)
         wcost = comp_sum_sq(r.reshape(-1))
     if axis_name is not None:
@@ -144,7 +148,7 @@ def _linearize(poses_fm, edge_i, edge_j, meas_fm, sqrt_info, free_i, free_j,
 
 
 def _grad_fm(r, Ji, Jj, edge_i, edge_j, n_poses):
-    """Gradient J^T r as [6, N] feature-major (fixed poses come out zero
+    """Gradient J^T r as [pd, N] feature-major (fixed poses come out zero
     because _linearize already masks their Jacobian columns)."""
     gi = jnp.einsum("oae,oe->ae", Ji, r)
     gj = jnp.einsum("oae,oe->ae", Jj, r)
@@ -153,16 +157,17 @@ def _grad_fm(r, Ji, Jj, edge_i, edge_j, n_poses):
 
 
 def _grad_and_diag(r, Ji, Jj, edge_i, edge_j, n_poses, fixed,
-                   axis_name=None):
-    """g [6, N] and block-diagonal H rows [36, N] (identity at fixed).
+                   axis_name=None, pose_dim=POSE_DIM):
+    """g [pd, N] and block-diagonal H rows [pd*pd, N] (identity at fixed).
 
     Sharded solves psum g and h BEFORE the identity guard below: a pose
     whose edges all live on other shards must see the global sum, not a
     per-shard identity block.
     """
+    pd = pose_dim
     g = _grad_fm(r, Ji, Jj, edge_i, edge_j, n_poses)
-    hi = jnp.einsum("oae,obe->abe", Ji, Ji).reshape(36, -1)
-    hj = jnp.einsum("oae,obe->abe", Jj, Jj).reshape(36, -1)
+    hi = jnp.einsum("oae,obe->abe", Ji, Ji).reshape(pd * pd, -1)
+    hj = jnp.einsum("oae,obe->abe", Jj, Jj).reshape(pd * pd, -1)
     h = (segsum_fm(hi, edge_i, n_poses)
          + segsum_fm(hj, edge_j, n_poses))
     if axis_name is not None:
@@ -174,7 +179,7 @@ def _grad_and_diag(r, Ji, Jj, edge_i, edge_j, n_poses, fixed,
     # edge-less-vertex identity blocks).
     # dtype pinned: a bare jnp.eye is float64 under x64 and would upcast
     # h (and through it the whole PCG state) in float32 solves.
-    eye = jnp.eye(POSE_DIM, dtype=h.dtype).reshape(36, 1)
+    eye = jnp.eye(pd, dtype=h.dtype).reshape(pd * pd, 1)
     guard = fixed | (h[0] == 0)
     h = jnp.where(guard[None, :], eye, h)
     g = g * (1.0 - fixed.astype(g.dtype))[None, :]
@@ -192,14 +197,22 @@ def solve_pgo(
     verbose: bool = False,
     initial_region: Optional[float] = None,
     initial_v: Optional[float] = None,
+    factor="se3_between",
     lower_only: bool = False,
 ) -> PGOResult:
-    """Solve an SE(3) pose graph.  PUBLIC edge-major boundary.
+    """Solve a pose graph.  PUBLIC edge-major boundary.
 
-    poses0 [N, 6] (angle-axis + translation), edge_i/edge_j [nE] int,
-    meas [nE, 6], sqrt_info [nE, 6, 6] optional, fixed [N] bool (pose 0
-    is fixed by default — the gauge anchor).  LM trust-region semantics
-    and PCG stopping mirror the BA path (algo/lm.py, solver/pcg.py).
+    poses0 [N, pd], edge_i/edge_j [nE] int, meas [nE, md],
+    sqrt_info [nE, rd, rd] optional, fixed [N] bool (pose 0 is fixed by
+    default — the gauge anchor), with (pd, md, rd) from the registered
+    pose-graph `factor` — `"se3_between"` (the default, 6/6/6:
+    angle-axis + translation, byte-identical programs to the
+    pre-registry driver) or `"sim3_between"` (7/7/7: scale-aware
+    monocular-SLAM PGO, factors/sim3.py), or any registered
+    `factors.PoseFactorSpec`.  A Schur (camera/point) factor name here
+    raises typed `FactorError`; unknown names raise
+    `UnknownFactorError`.  LM trust-region semantics and PCG stopping
+    mirror the BA path (algo/lm.py, solver/pcg.py).
 
     `option.world_size > 1` shards the EDGE axis over a 1-D device mesh
     (same layout as the BA path, parallel/mesh.py): pose state is
@@ -221,6 +234,22 @@ def solve_pgo(
         # profiling" scopes the sink to the BA pipeline); strip the
         # host-only knob so it cannot fragment _pgo_program's lru cache.
         option = dataclasses.replace(option, telemetry=None)
+    # Registry dispatch (lazy import: factors/pose_graph.py imports
+    # THIS module at registration time).
+    from megba_tpu.factors import get_factor
+    from megba_tpu.factors.registry import require_pose_graph
+
+    spec = require_pose_graph(get_factor(factor), "solve_pgo")
+    pd, md, rd = spec.pose_dim, spec.meas_dim, spec.residual_dim
+    if int(poses0.shape[1]) != pd:
+        raise ValueError(
+            f"solve_pgo: poses0 width {int(poses0.shape[1])} does not "
+            f"match factor {spec.name!r} pose_dim {pd}")
+    if np.asarray(meas).ndim != 2 or int(np.asarray(meas).shape[1]) != md:
+        raise ValueError(
+            f"solve_pgo: meas width "
+            f"{np.asarray(meas).shape[1:] or '?'} does not match factor "
+            f"{spec.name!r} meas_dim {md}")
     # f64 only when actually available (x64 enabled) — otherwise warn
     # loudly, same precision contract as flat_solve.
     warn_if_x64_unavailable(option.dtype)
@@ -238,6 +267,10 @@ def solve_pgo(
     edge_j = np.asarray(edge_j, np.int32)
     meas_np = np.asarray(meas)
     si_np = None if sqrt_info is None else np.asarray(sqrt_info)
+    if si_np is not None and si_np.shape[1:] != (rd, rd):
+        raise ValueError(
+            f"solve_pgo: sqrt_info must be [nE, {rd}, {rd}] for factor "
+            f"{spec.name!r}, got {si_np.shape}")
     n_e = edge_i.shape[0]
     n_pad = (-n_e) % world
     emask = None
@@ -247,7 +280,7 @@ def solve_pgo(
         emask = np.asarray(emask_np, dtype)
         if si_np is not None:
             si_np = np.concatenate(
-                [si_np, np.zeros((n_pad, 6, 6), si_np.dtype)])
+                [si_np, np.zeros((n_pad, rd, rd), si_np.dtype)])
 
     if fixed is None:
         fixed_np = np.zeros(n_poses, bool)
@@ -278,7 +311,7 @@ def solve_pgo(
         extras.append(si)
 
     prog, mesh = _pgo_program(option, world, n_poses, np.dtype(dtype),
-                              tuple(extra_keys), bool(verbose))
+                              tuple(extra_keys), bool(verbose), spec)
     region0 = (option.algo_option.initial_region if initial_region is None
                else initial_region)
     v0 = 2.0 if initial_v is None else initial_v
@@ -330,7 +363,7 @@ def _pgo_in_specs(extra_keys):
 @functools.lru_cache(maxsize=32)
 def _pgo_program(option: ProblemOption, world: int, n_poses: int,
                  np_dtype: np.dtype, extra_keys: tuple,
-                 verbose: bool = False):
+                 verbose: bool, factor_spec):
     """Build (once per configuration) the jitted PGO LM program.
 
     Returns (program, mesh-or-None).  Cached so repeat solves of one
@@ -339,12 +372,19 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
     (region0, v0) and the verbose-clock token ride as DYNAMIC operands,
     exactly like the BA path's get_or_build_program contract
     (parallel/mesh.py).  jit handles shape-based re-specialisation
-    internally.
+    internally.  `factor_spec` (a registered `PoseFactorSpec`,
+    hashable — part of the cache key) selects the residual family and
+    is REQUIRED: a defaultable spec would let one SE(3) configuration
+    land under two lru keys (None vs the spec) and trace a duplicate
+    program — the one-config-one-program hazard the registry exists to
+    prevent.  solve_pgo's "se3_between" default traces the identical
+    program the pre-registry driver traced.
     """
     dtype = np_dtype
     algo_opt = option.algo_option
     solver_opt = option.solver_option
     axis_name = EDGE_AXIS if world > 1 else None
+    pd = factor_spec.pose_dim
 
     from megba_tpu.observability.emit import emit_verbose_iteration
     from megba_tpu.algo.lm import eisenstat_walker_eta, initial_forcing_eta
@@ -361,20 +401,22 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
         def lin(p):
             return _linearize(p, ei, ej, meas_fm, si_, free_i, free_j,
                               emask, axis_name,
-                              option.robust_kind, option.robust_delta)
+                              option.robust_kind, option.robust_delta,
+                              residual_fn=factor_spec.residual_fn,
+                              pose_dim=pd)
 
         def grad_and_diag(r, Ji, Jj):
             return _grad_and_diag(r, Ji, Jj, ei, ej, n_poses, fixed_j,
-                                  axis_name)
+                                  axis_name, pose_dim=pd)
 
         def step_system(g, h_rows, Ji, Jj, region, tol, x0):
             damp = 1.0 + 1.0 / region
-            h_blocks = jnp.moveaxis(h_rows.reshape(6, 6, n_poses), -1, 0)
-            # Diagonal ENTRIES of each 6x6 block: rows 0,7,...,35 of the
-            # [36, N] row store.
-            h_diag = h_rows[:: POSE_DIM + 1]
+            h_blocks = jnp.moveaxis(h_rows.reshape(pd, pd, n_poses), -1, 0)
+            # Diagonal ENTRIES of each pd x pd block: rows 0, pd+1, ...
+            # of the [pd*pd, N] row store.
+            h_diag = h_rows[:: pd + 1]
             h_damped = h_blocks * (
-                jnp.eye(POSE_DIM, dtype=dtype) * (damp - 1.0) + 1.0)
+                jnp.eye(pd, dtype=dtype) * (damp - 1.0) + 1.0)
             minv = block_inv(h_damped)
 
             def matvec(x):  # [6, N] -> [6, N]; damped H x, matrix-free
@@ -530,7 +572,7 @@ def _pgo_program(option: ProblemOption, world: int, n_poses: int,
     run = traced(
         "pgo.run", run,
         static=static_key(option, f"world{world}", n_poses, np_dtype,
-                          extra_keys, verbose))
+                          extra_keys, verbose, factor_spec.name))
 
     if world > 1:
         mesh = make_mesh(world)

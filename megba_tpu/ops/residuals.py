@@ -25,13 +25,13 @@ instead of CUDA threads.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from megba_tpu.common import JacobianMode
+from megba_tpu.utils.memo import normalized_lru_cache
 
 _SMALL_ANGLE = 1e-12
 
@@ -224,7 +224,7 @@ def bal_residual_jacobian_analytical(
     return r[:, 0], Jc[:, 0].reshape(2, 9), Jp[:, 0].reshape(2, 3)
 
 
-@functools.lru_cache(maxsize=64)
+@normalized_lru_cache(maxsize=64)
 def make_residual_fn(
     residual_fn: ResidualFn = bal_residual,
 ) -> Callable[..., jnp.ndarray]:
@@ -314,10 +314,7 @@ def build_residual_jacobian_fn(
     return fm_fn
 
 
-_cached_residual_jacobian_fn = functools.lru_cache(maxsize=64)(
-    build_residual_jacobian_fn)
-
-
+@normalized_lru_cache(maxsize=64)
 def make_residual_jacobian_fn(
     residual_fn: ResidualFn = bal_residual,
     mode: JacobianMode = JacobianMode.AUTODIFF,
@@ -329,14 +326,19 @@ def make_residual_jacobian_fn(
     `residual_fn`s (module-level functions); per-problem closures go
     through `build_residual_jacobian_fn` to avoid cache retention.
 
-    Call-shape normalised: the lru cache sits BEHIND this wrapper with
-    every argument bound positionally, so `make_residual_jacobian_fn()`
-    and `make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)` return
-    the IDENTICAL object (raw functools.lru_cache keys keyword and
+    Call-shape normalised (utils/memo.normalized_lru_cache — the
+    generalised form of PR 6's hand-written wrapper here), so
+    `make_residual_jacobian_fn()` and
+    `make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)` return the
+    IDENTICAL object (raw functools.lru_cache keys keyword and
     positional spellings separately — two engines for one config would
     silently double every jit/program cache keyed on engine identity,
-    e.g. the serving compile pool)."""
-    return _cached_residual_jacobian_fn(residual_fn, mode, analytical_fn)
+    e.g. the serving compile pool).  The factor registry's
+    `factors.engine.engine_for` additionally canonicalises
+    mode-IRRELEVANT fields (an `analytical_fn` that AUTODIFF would
+    ignore) before landing here, so a registry lookup and a direct
+    default call can never mint two engines for one program."""
+    return build_residual_jacobian_fn(residual_fn, mode, analytical_fn)
 
 
 def apply_sqrt_info(

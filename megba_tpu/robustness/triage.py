@@ -59,9 +59,32 @@ from __future__ import annotations
 import dataclasses
 import enum
 import time
+import types
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+
+def _default_bal_hooks():
+    """The historical BAL geometric hooks (host NumPy twins from
+    io/synthetic) — what `factor=None` callers have always gotten.
+
+    Duck-typed (SimpleNamespace with the `factors.FactorTriage` field
+    names) rather than the registry dataclass itself: this module's
+    contract is that it NEVER imports jax, and importing the factors
+    package would pull the jnp-importing residual modules in.  Callers
+    that hold a registered spec pass it via `factor=`; its `triage`
+    attribute carries the real `FactorTriage` hooks, which this module
+    only reads attributes off.
+    """
+    from megba_tpu.io.synthetic import camera_centers, project_batch_depth
+
+    def project(cam_blocks, pt_blocks, obs):
+        del obs
+        return project_batch_depth(cam_blocks, pt_blocks)
+
+    return types.SimpleNamespace(project_depth=project, uv_cols=(0, 2),
+                                 camera_centers=camera_centers)
 
 
 # Chunk size for the geometric pass: bounds the [nE, 3] float64
@@ -435,8 +458,21 @@ def check_problem(
     edge_mask: Optional[np.ndarray] = None,
     cam_fixed: Optional[np.ndarray] = None,
     pt_fixed: Optional[np.ndarray] = None,
+    factor=None,
 ) -> Tuple[HealthReport, Dict[str, np.ndarray]]:
     """Run every enabled check; return (report, internals).
+
+    `factor` (a registered `factors.FactorSpec`, or None for the
+    historical BAL behaviour) REGISTRY-DISPATCHES the checks: the
+    geometric pass runs through the spec's `triage` hooks (projection +
+    depth for cheirality/outliers, camera centers for parallax) — a
+    factor WITHOUT hooks (priors, planar) skips the projective checks
+    entirely, because cheirality is meaningless for a non-projective
+    residual, and the report records `geometric=False` so downstream
+    gates know those checks never ran (advisory absence, not a clean
+    bill); the duplicate-edge check honours `spec.unique_edges` (a rig
+    repeats (body, point) pairs by construction).  Structural and
+    non-finite checks are factor-agnostic and always run.
 
     `edge_mask` / `cam_fixed` / `pt_fixed` are the caller's OWN solve
     operands, and the checks honour them: a caller-masked (mask <= 0)
@@ -465,6 +501,16 @@ def check_problem(
     the analysis callgraph).
     """
     policy = policy or TriagePolicy()
+    # Factor dispatch: hooks + duplicate-edge semantics off the spec
+    # (duck-typed attribute reads — see _default_bal_hooks on why the
+    # registry itself is never imported here).
+    if factor is None:
+        hooks = _default_bal_hooks()
+        unique_edges = True
+    else:
+        hooks = getattr(factor, "triage", None)
+        unique_edges = bool(getattr(factor, "unique_edges", True))
+    geometric_on = bool(policy.geometric) and hooks is not None
     t0 = time.perf_counter()
     cameras = np.asarray(cameras)
     points = np.asarray(points)
@@ -526,8 +572,10 @@ def check_problem(
     # An edge touching poisoned data is dead either way.
     bad_edge |= nf_obs | nf_cam[ci] | nf_pt[pi]
 
-    if policy.structural and n_edge:
+    if policy.structural and n_edge and unique_edges:
         # ---- duplicate (cam, pt) edges: keep the FIRST occurrence ----
+        # Factor-gated: families declaring unique_edges=False (rig,
+        # priors) encode repeated index pairs deliberately.
         # Caller-masked copies don't double-count a factor, so the scan
         # runs over the caller-alive subset only.
         live = np.nonzero(~pre_dead)[0]
@@ -547,38 +595,39 @@ def check_problem(
     # projection and the parallax rays): NaN params would make every
     # derived check on those edges NaN — they are already flagged
     # above; zero stand-ins keep the passes finite.
-    if policy.geometric and n_edge:
+    if geometric_on and n_edge:
         cams_f = np.where(san_cam[:, None], 0.0,
                           cameras.astype(np.float64, copy=False))
         pts_f = np.where(san_pt[:, None], 0.0,
                          points.astype(np.float64, copy=False))
+        ob_f = np.where(san_obs[:, None], 0.0,
+                        obs.astype(np.float64, copy=False))
 
-    if policy.geometric and n_edge:
-        from megba_tpu.io.synthetic import project_batch_depth
-
+    if geometric_on and n_edge:
         uv = np.empty((n_edge, 2))
         depth = np.empty((n_edge,))
         for lo in range(0, n_edge, _GEOM_CHUNK):
             hi = min(lo + _GEOM_CHUNK, n_edge)
-            uv[lo:hi], depth[lo:hi] = project_batch_depth(
-                cams_f[ci[lo:hi]], pts_f[pi[lo:hi]])
+            uv[lo:hi], depth[lo:hi] = hooks.project_depth(
+                cams_f[ci[lo:hi]], pts_f[pi[lo:hi]], ob_f[lo:hi])
 
         # ---- cheirality: behind (or on) the camera plane -------------
-        # BAL visible half-space is z < 0; z >= -min_depth means the
-        # -P/P.z projection is about to divide by ~0 or the point sits
-        # behind the camera — either way the first linearisation is
-        # poisoned.  Already-dead edges (flagged above, or caller-
-        # masked) are excluded so nothing double-reports.
+        # BAL-convention visible half-space is z < 0 (every projective
+        # hook returns the camera-frame depth in that convention);
+        # z >= -min_depth means the -P/P.z projection is about to
+        # divide by ~0 or the point sits behind the camera — either way
+        # the first linearisation is poisoned.  Already-dead edges
+        # (flagged above, or caller-masked) are excluded so nothing
+        # double-reports.
         behind = (depth >= -policy.min_depth) & ~bad_edge & ~pre_dead
         add(CheckKind.BEHIND_CAMERA, behind,
             "point behind/on camera plane at the initial estimate")
         bad_edge |= behind
 
         # ---- extreme initial reprojection residuals ------------------
+        lo_c, hi_c = hooks.uv_cols
         with np.errstate(invalid="ignore", over="ignore"):
-            ob = np.where(san_obs[:, None], 0.0,
-                          obs.astype(np.float64, copy=False))
-            rnorm = np.linalg.norm(uv - ob, axis=1)
+            rnorm = np.linalg.norm(uv - ob_f[:, lo_c:hi_c], axis=1)
         extreme = (~np.isfinite(rnorm) | (rnorm > policy.max_residual_px)
                    ) & ~bad_edge & ~pre_dead
         add(CheckKind.EXTREME_RESIDUAL, extreme,
@@ -636,13 +685,15 @@ def check_problem(
             f"camera observed by < {policy.min_camera_degree} edges "
             "(fewer residual rows than camera dof at the default)")
 
-    # ---- low parallax (geometric, needs surviving degrees) -----------
-    if policy.geometric and n_edge and policy.min_parallax_rad > 0:
-        from megba_tpu.io.synthetic import rotate_batch
-
-        # Camera centers C = -R^T t (rotate t by -w), [Nc, 3]; cams_f /
-        # pts_f are the scrubbed copies hoisted above the projection.
-        centers = -rotate_batch(-cams_f[:, 0:3], cams_f[:, 3:6])
+    # ---- low parallax (geometric, needs surviving degrees, a
+    # camera-centers hook AND 3D points for the viewing rays) ----------
+    if (geometric_on and n_edge and policy.min_parallax_rad > 0
+            and hooks.camera_centers is not None
+            and points.shape[1] == 3):
+        # Camera centers [Nc, 3] from the factor hook (BAL/radial:
+        # C = -R^T t; rig: the body center); cams_f / pts_f are the
+        # scrubbed copies hoisted above the projection.
+        centers = hooks.camera_centers(cams_f)
         # Per-edge unit viewing rays, accumulated per point; the spread
         # proxy is the max angular deviation from the point's mean ray
         # (>= half the true max pairwise angle, <= the full one).
@@ -719,7 +770,11 @@ def check_problem(
     report = HealthReport(
         n_cam=n_cam, n_pt=n_pt, n_edge=n_edge, findings=findings,
         n_components=n_components, triage_s=time.perf_counter() - t0,
-        structural=policy.structural, geometric=policy.geometric)
+        # `geometric` records what actually RAN: a hook-less factor
+        # (priors, planar) reports False even under a geometric policy,
+        # so downstream gates never mistake "not applicable" for
+        # "checked clean".
+        structural=policy.structural, geometric=geometric_on)
     internals = {
         "bad_edge": bad_edge, "weight": weight,
         "bad_cam": bad_cam, "bad_pt": bad_pt,
@@ -850,13 +905,16 @@ def triage_problem(
     edge_mask: Optional[np.ndarray] = None,
     cam_fixed: Optional[np.ndarray] = None,
     pt_fixed: Optional[np.ndarray] = None,
+    factor=None,
 ) -> TriageOutcome:
     """Check one problem and act on the policy.
 
     `edge_mask` / `cam_fixed` / `pt_fixed` are the caller's own solve
     operands, honoured by the checks (see `check_problem`) — the
     returned repair composes with them via
-    `TriageRepair.merge_operands`.
+    `TriageRepair.merge_operands`.  `factor` (a registered
+    `factors.FactorSpec` or None = BAL) registry-dispatches the
+    geometric hooks and duplicate-edge semantics (see `check_problem`).
 
     Returns a `TriageOutcome`; raises `ProblemRejected` (report
     attached) when the problem is degenerate under REJECT.  Clean
@@ -867,7 +925,8 @@ def triage_problem(
     policy = policy or TriagePolicy()
     report, internals = check_problem(
         cameras, points, obs, cam_idx, pt_idx, policy,
-        edge_mask=edge_mask, cam_fixed=cam_fixed, pt_fixed=pt_fixed)
+        edge_mask=edge_mask, cam_fixed=cam_fixed, pt_fixed=pt_fixed,
+        factor=factor)
     if not report.degenerate:
         report.action = TriageAction.WARN.value
         return TriageOutcome(report=report, action=TriageAction.WARN)

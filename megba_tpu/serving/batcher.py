@@ -29,7 +29,6 @@ import numpy as np
 
 from megba_tpu.common import ProblemOption, status_name, validate_options
 from megba_tpu.observability.trace import SolveTrace
-from megba_tpu.ops.residuals import make_residual_jacobian_fn
 from megba_tpu.serving.compile_pool import CompilePool
 from megba_tpu.serving.shape_class import (
     BucketLadder,
@@ -75,12 +74,24 @@ class FleetProblem:
     cam_fixed: Optional[np.ndarray] = None
     pt_fixed: Optional[np.ndarray] = None
     health: Optional[Dict[str, Any]] = None
+    # Which registered residual family this problem solves under
+    # (factors/registry.py).  The fleet layer is factor-agnostic by
+    # construction: problems group by (factor, shape class, block
+    # dims), each group resolves its own engine, and engine identity is
+    # already in every program-cache key — so a fleet can mix rig,
+    # radial, prior and BAL problems with zero cross-factor retraces.
+    factor: str = "bal"
 
     @classmethod
-    def from_synthetic(cls, s, name: str = "") -> "FleetProblem":
-        """Wrap an io.synthetic.SyntheticBAL (initial parameters)."""
+    def from_synthetic(cls, s, name: str = "",
+                       factor: str = "bal") -> "FleetProblem":
+        """Wrap a synthetic scene (initial parameters).  Accepts any of
+        the generator dataclasses exposing cameras0/points0/obs/
+        cam_idx/pt_idx (io.synthetic.SyntheticBAL, factors.rig.
+        SyntheticRig, factors.radial.SyntheticRadial, ...)."""
         return cls(cameras=s.cameras0, points=s.points0, obs=s.obs,
-                   cam_idx=s.cam_idx, pt_idx=s.pt_idx, name=name)
+                   cam_idx=s.cam_idx, pt_idx=s.pt_idx, name=name,
+                   factor=factor)
 
     def dims(self) -> Tuple[int, int, int]:
         return (int(self.cameras.shape[0]), int(self.points.shape[0]),
@@ -146,16 +157,48 @@ def _check_option(option: ProblemOption) -> None:
             "one problem across devices")
 
 
-def _validate_problem(p: FleetProblem, index: int = -1) -> None:
+def _problem_spec(p: FleetProblem, index: int = -1):
+    """Resolve + dim-check a fleet problem's factor spec (typed
+    `UnknownFactorError`/`FactorError` at the ingestion boundary)."""
+    from megba_tpu.factors import get_factor, validate_factor_arrays
+    from megba_tpu.factors.registry import require_schur
+
+    where = (f"FleetProblem {p.name!r}" if p.name
+             else f"FleetProblem #{index}" if index >= 0
+             else "FleetProblem")
+    spec = require_schur(get_factor(p.factor), where)
+    validate_factor_arrays(spec, p.cameras, p.points, p.obs, where=where)
+    return spec
+
+
+def _validate_problem(p: FleetProblem, index: int = -1,
+                      option: Optional[ProblemOption] = None) -> None:
     """The serving layer's ingestion gate: the SAME semantic validation
     the BAL parsers apply (io/bal.validate_problem), so duplicate
     (cam, pt) edges and non-finite values cannot sneak into a batch
     through `solve_many` / `FleetQueue.submit` when no triage policy is
-    armed.  Skipped only when the problem carries a triage `health`
-    record whose STRUCTURAL pass ran — that pass subsumes this gate's
-    duplicate check (non-finite checks are unconditional in triage), so
-    a `TriagePolicy(structural=False)` submission still hits the gate
-    here."""
+    armed.  Factor-dispatched: the duplicate-edge refusal only applies
+    to families declaring `unique_edges` (a rig legitimately repeats a
+    (body, point) pair once per physical camera; a prior may repeat a
+    constraint), and with `option` given a robust kernel on a
+    `robust_ok=False` family is refused typed HERE — the same refusal
+    `flat_solve(factor=)` makes, so the fleet path cannot silently
+    IRLS-downweight a marginalization prior.  Skipped only when the
+    problem carries a triage `health` record whose STRUCTURAL pass ran
+    — that pass subsumes this gate's duplicate check (non-finite checks
+    are unconditional in triage), so a `TriagePolicy(structural=False)`
+    submission still hits the gate here."""
+    spec = _problem_spec(p, index)
+    if option is not None and not spec.robust_ok:
+        from megba_tpu.factors.registry import FactorError
+        from megba_tpu.ops.robust import RobustKind
+
+        if option.robust_kind != RobustKind.NONE:
+            raise FactorError(
+                f"factor {spec.name!r} is not robust-kernel eligible "
+                "(robust_ok=False — e.g. a marginalization prior must "
+                "not be IRLS-downweighted); submit it under "
+                "robust_kind=NONE")
     if p.health is not None and p.health.get("structural", False):
         return
     from megba_tpu.io.bal import validate_problem
@@ -167,19 +210,26 @@ def _validate_problem(p: FleetProblem, index: int = -1) -> None:
     else:
         where = "FleetProblem"
     validate_problem(p.cameras, p.points, p.obs, p.cam_idx, p.pt_idx,
-                     where=where)
+                     where=where, unique_edges=spec.unique_edges)
 
 
 def _group_by_bucket(problems: Sequence[FleetProblem], option: ProblemOption,
                      ladder: BucketLadder):
-    """index-preserving grouping: (shape, cd, pd, od) -> [(i, problem)]."""
+    """index-preserving grouping:
+    (shape, (cd, pd, od), factor) -> [(i, problem)].
+
+    The factor name is part of the key even though two factors RARELY
+    share block dims: if they ever did, batching them together would
+    hand one factor's lanes to the other's engine — the bucket must be
+    one residual family by construction.
+    """
     groups: Dict[Tuple, List[Tuple[int, FleetProblem]]] = {}
     for i, p in enumerate(problems):
         n_cam, n_pt, n_edge = p.dims()
         sc = classify(n_cam, n_pt, n_edge, option.dtype, ladder)
         dims = (int(p.cameras.shape[1]), int(p.points.shape[1]),
                 int(p.obs.shape[1]))
-        groups.setdefault((sc, dims), []).append((i, p))
+        groups.setdefault((sc, dims, p.factor), []).append((i, p))
     return groups
 
 
@@ -237,6 +287,7 @@ def _solve_bucket(
     initial_region: Optional[float] = None,
     rung: int = 0,
     attempts: int = 1,
+    factor: str = "bal",
 ) -> List[Tuple[int, FleetResult]]:
     """Solve one bucket's problems in a single batched dispatch.
 
@@ -287,8 +338,11 @@ def _solve_bucket(
     od = operands[2].shape[1]
 
     with timer.phase("program"):
+        # `factor` rides to the pool's manifest entry (not the program
+        # key — engine identity covers that) so a mixed-factor
+        # service's manifest warms each bucket with its own engine.
         program = pool.program(engine, option, shape, lanes, cd, pd, od,
-                               faulted=faulted)
+                               faulted=faulted, factor=factor)
     ir = jnp.asarray(option.algo_option.initial_region
                      if initial_region is None else initial_region, dtype)
     iv = jnp.asarray(2.0, dtype)
@@ -400,20 +454,25 @@ def solve_many(
     option = option or ProblemOption()
     _check_option(option)
     for i, p in enumerate(problems):
-        _validate_problem(p, i)
+        _validate_problem(p, i, option)
     option, telemetry, report_option = _strip_telemetry(option)
     warn_if_x64_unavailable(np.dtype(option.dtype))
     ladder = ladder or BucketLadder()
     stats = stats or FleetStats()
     pool = pool or CompilePool(stats=stats)
     timer = PhaseTimer() if timer is None else timer
-    engine = make_residual_jacobian_fn(mode=option.jacobian_mode)
+    from megba_tpu.factors import engine_for
 
     results: List[Optional[FleetResult]] = [None] * len(problems)
-    for (shape, _dims), items in _group_by_bucket(
+    for (shape, _dims, factor), items in _group_by_bucket(
             problems, option, ladder).items():
+        # One engine per factor group (memoised: a factor resolves to
+        # ONE engine object process-wide, so a mixed-factor fleet pays
+        # exactly one program per (factor, bucket) — the zero-cross-
+        # factor-retrace contract the sentinel certifies).
+        engine = engine_for(factor, option.jacobian_mode)
         for orig_i, fr in _solve_bucket(
                 items, shape, option, engine, ladder, pool, stats, timer,
-                telemetry, report_option):
+                telemetry, report_option, factor=factor):
             results[orig_i] = fr
     return results  # type: ignore[return-value]

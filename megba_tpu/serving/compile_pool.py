@@ -38,7 +38,6 @@ trace, or the retrace sentinel would rightly flag the duplicate.
 from __future__ import annotations
 
 import contextlib
-import functools
 import json
 import os
 import threading
@@ -52,6 +51,7 @@ from megba_tpu.algo.lm import lm_solve
 from megba_tpu.analysis.retrace import static_key, traced
 from megba_tpu.serving.artifacts import ArtifactKey, ArtifactStore
 from megba_tpu.serving.shape_class import ShapeClass
+from megba_tpu.utils.memo import normalized_lru_cache
 
 MANIFEST_SCHEMA = "megba_tpu.fleet_manifest/v1"
 
@@ -221,16 +221,20 @@ def _build_batched_solve(residual_jac_fn, option, faulted=False):
         donate_argnums=(0, 1))
 
 
-# Long-lived engines only (make_residual_jacobian_fn is itself memoised,
-# so the default BAL engines qualify); mirrors _cached_single_solve.
-_cached_batched_solve = functools.lru_cache(maxsize=64)(_build_batched_solve)
+# Long-lived engines only (make_residual_jacobian_fn / the factor
+# registry's engine_for are themselves memoised, so every registered
+# factor's engines qualify); mirrors _cached_single_solve.
+_cached_batched_solve = normalized_lru_cache(maxsize=64)(
+    _build_batched_solve)
 
 
 def batched_solve_program(residual_jac_fn, option, faulted=False):
     """Call-shape-normalising front for the lru cache: positional,
     keyword and defaulted spellings of `faulted` must hit ONE entry (the
-    same double-cache footgun make_residual_jacobian_fn fixed in PR 6 —
-    two entries would mean two jit wrappers and a duplicate trace)."""
+    same double-cache footgun make_residual_jacobian_fn fixed in PR 6,
+    now the shared utils/memo.normalized_lru_cache — two entries would
+    mean two jit wrappers and a duplicate trace).  `faulted` is coerced
+    to bool here so truthy ints cannot split the key either."""
     return _cached_batched_solve(residual_jac_fn, option, bool(faulted))
 
 
@@ -345,13 +349,34 @@ class CompilePool:
             self._stats.record_artifact(True)
         return compiled
 
+    @staticmethod
+    def _entry_engine(entry: Dict[str, Any], engine, option):
+        """The engine a manifest entry warms with: entries recorded
+        with a `factor` name resolve THEIR OWN engine through the
+        registry (a mixed-factor service's manifest must not warm rig
+        buckets with the BAL engine — wrong dims trace-crash, and a
+        dim-coincident family would silently compile wrong physics);
+        legacy/factor-less entries use the caller's engine, exactly the
+        pre-registry behaviour."""
+        factor = entry.get("factor")
+        if not factor:
+            return engine
+        from megba_tpu.factors import engine_for
+
+        return engine_for(factor, option.jacobian_mode)
+
     # -- dispatch path ---------------------------------------------------
     def program(self, engine, option, shape: ShapeClass, lanes: int,
-                cd: int, pd: int, od: int, faulted: bool = False):
-        """Callable for one bucket; prefers the AOT executable."""
+                cd: int, pd: int, od: int, faulted: bool = False,
+                factor: Optional[str] = None):
+        """Callable for one bucket; prefers the AOT executable.
+        `factor` (a registered family name) is recorded on the manifest
+        entry so `warm_from_manifest` can resolve the bucket's OWN
+        engine later; it does not key the program — `engine` identity
+        already does."""
         option = _sans_telemetry(option)
         key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
-        self._note(key, shape, lanes, cd, pd, od, faulted)
+        self._note(key, shape, lanes, cd, pd, od, faulted, factor)
         with _LOCK:
             compiled = _AOT.get(key)
             hit = compiled is not None or key in _DISPATCHED
@@ -388,12 +413,16 @@ class CompilePool:
         """AOT-compile the given buckets; returns how many were built.
 
         `entries` are manifest-entry dicts ({"shape": {...}, "lanes": n,
-        "cd": .., "pd": .., "od": ..}).  Buckets already in the AOT
-        store are skipped (idempotent warmup).  With an `ArtifactStore`
-        attached, each bucket first tries a serialized-executable load —
-        compile-free, I/O-bound — and only a miss (or a stale/corrupt
-        artifact, which warns) pays the trace + XLA compile; freshly
-        compiled programs are saved back so the store heals itself."""
+        "cd": .., "pd": .., "od": .., ["factor": name]}).  An entry
+        naming a `factor` warms with THAT family's engine
+        (`_entry_engine`) — the mixed-factor cold-start contract;
+        factor-less (legacy) entries use the given `engine`.  Buckets
+        already in the AOT store are skipped (idempotent warmup).  With
+        an `ArtifactStore` attached, each bucket first tries a
+        serialized-executable load — compile-free, I/O-bound — and only
+        a miss (or a stale/corrupt artifact, which warns) pays the
+        trace + XLA compile; freshly compiled programs are saved back
+        so the store heals itself."""
         option = _sans_telemetry(option)
         built = 0
         for e in entries:
@@ -402,10 +431,13 @@ class CompilePool:
             cd, pd, od = int(e.get("cd", 9)), int(e.get("pd", 3)), \
                 int(e.get("od", 2))
             faulted = bool(e.get("faulted", False))
-            key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
-            self._note(key, shape, lanes, cd, pd, od, faulted)
-            akey = self._artifact_key(engine, option, shape, lanes, cd,
-                                      pd, od, faulted)
+            entry_engine = self._entry_engine(e, engine, option)
+            key = pool_key(entry_engine, option, shape, lanes, cd, pd, od,
+                           faulted)
+            self._note(key, shape, lanes, cd, pd, od, faulted,
+                       e.get("factor"))
+            akey = self._artifact_key(entry_engine, option, shape, lanes,
+                                      cd, pd, od, faulted)
             with _LOCK:
                 already = key in _AOT or key in _DISPATCHED
             if already:
@@ -428,7 +460,7 @@ class CompilePool:
                           else contextlib.nullcontext())
                 with scope, timing:
                     compiled = lower_bucket(
-                        engine, option, shape, lanes, cd, pd, od,
+                        entry_engine, option, shape, lanes, cd, pd, od,
                         faulted).compile()
                 with _LOCK:
                     _AOT[key] = compiled
@@ -496,7 +528,13 @@ class CompilePool:
             cd, pd, od = int(e.get("cd", 9)), int(e.get("pd", 3)), \
                 int(e.get("od", 2))
             faulted = bool(e.get("faulted", False))
-            key = pool_key(engine, option, shape, lanes, cd, pd, od, faulted)
+            # Factor-recorded entries export under THEIR engine (same
+            # per-entry resolution warm() makes — a mixed-factor
+            # service's export must not re-lower every bucket through
+            # one family's physics).
+            entry_engine = self._entry_engine(e, engine, option)
+            key = pool_key(entry_engine, option, shape, lanes, cd, pd, od,
+                           faulted)
             with _LOCK:
                 compiled = _AOT.get(key)
                 from_artifact = key in _FROM_ARTIFACT
@@ -507,26 +545,34 @@ class CompilePool:
                 if not compile_missing:
                     continue
                 with _portable_compile_scope():
-                    compiled = lower_bucket(engine, option, shape, lanes,
-                                            cd, pd, od, faulted).compile()
+                    compiled = lower_bucket(entry_engine, option, shape,
+                                            lanes, cd, pd, od,
+                                            faulted).compile()
                 with _LOCK:
                     _AOT[key] = compiled
                     _PORTABLE.add(key)
             self.artifacts.save(
-                self._artifact_key(engine, option, shape, lanes, cd, pd,
-                                   od, faulted), compiled)
+                self._artifact_key(entry_engine, option, shape, lanes, cd,
+                                   pd, od, faulted), compiled)
             written += 1
         return written
 
     # -- manifests -------------------------------------------------------
     def _note(self, key: Tuple, shape: ShapeClass, lanes: int, cd: int,
-              pd: int, od: int, faulted: bool = False) -> None:
+              pd: int, od: int, faulted: bool = False,
+              factor: Optional[str] = None) -> None:
         entry = {"shape": shape.to_dict(), "lanes": int(lanes),
                  "cd": int(cd), "pd": int(pd), "od": int(od)}
         if faulted:
             # Additive manifest field: pre-PR-8 manifests (no key) read
             # back as the plain program, which is what they warmed.
             entry["faulted"] = True
+        if factor:
+            # Additive too: warm()/export resolve this entry's OWN
+            # engine from the registry; factor-less entries (legacy
+            # manifests, direct-engine callers) warm with the caller's
+            # engine as always.
+            entry["factor"] = str(factor)
         with self._lock:
             self._seen.setdefault(key, entry)
 

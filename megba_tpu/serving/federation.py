@@ -834,8 +834,12 @@ class FleetRouter:
 
         n_cam, n_pt, n_edge = problem.dims()
         sc = classify(n_cam, n_pt, n_edge, self.option.dtype, self.ladder)
+        # The factor name rides the dims element (same 2-tuple shape the
+        # routing/steal sites unpack): a routed batch must be one
+        # residual family, exactly like the local queue's bucket key.
         dims = (int(problem.cameras.shape[1]),
-                int(problem.points.shape[1]), int(problem.obs.shape[1]))
+                int(problem.points.shape[1]), int(problem.obs.shape[1]),
+                str(getattr(problem, "factor", "bal")))
         return (sc, dims)
 
     def submit(self, problem, deadline_s: Optional[float] = None) -> Future:

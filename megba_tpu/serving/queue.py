@@ -56,7 +56,6 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from megba_tpu.common import ProblemOption
-from megba_tpu.ops.residuals import make_residual_jacobian_fn
 from megba_tpu.serving.batcher import (
     FleetProblem,
     _check_option,
@@ -146,8 +145,6 @@ class FleetQueue:
         self.stats = stats or FleetStats()
         self.pool = pool or CompilePool(stats=self.stats)
         self.timer = PhaseTimer() if timer is None else timer
-        self._engine = make_residual_jacobian_fn(
-            mode=self._option.jacobian_mode)
         self.escalation = escalation
         self.max_pending = max_pending
         self.reject_policy = reject_policy
@@ -168,14 +165,17 @@ class FleetQueue:
                 warn_if_x64_unavailable(np.dtype(rung_opt.dtype))
 
         self._lock = threading.Condition()
-        # (shape class, feature dims, escalation rung) -> pending items.
-        # Rung is part of the key because each rung solves under its own
-        # option (its own compiled program); empty buckets are PRUNED
-        # when their last item is taken — breaker state lives in
-        # `self.breaker`, keyed separately, so trip history survives an
-        # empty queue.
-        self._pending: Dict[Tuple[ShapeClass, Tuple[int, int, int], int],
-                            List[_Pending]] = {}
+        # (shape class, feature dims, factor, escalation rung) ->
+        # pending items.  Rung is part of the key because each rung
+        # solves under its own option (its own compiled program);
+        # factor is part of the key because each residual family is its
+        # own engine — a bucket is one family by construction.  Empty
+        # buckets are PRUNED when their last item is taken — breaker
+        # state lives in `self.breaker`, keyed separately, so trip
+        # history survives an empty queue.
+        self._pending: Dict[
+            Tuple[ShapeClass, Tuple[int, int, int], str, int],
+            List[_Pending]] = {}
         self._inflight = 0  # work taken from _pending, not yet resolved
         self._npending = 0  # O(1) pending gauge (append/take/shed-kept)
         self._seq = 0
@@ -207,7 +207,8 @@ class FleetQueue:
             return self._report_option
         return self.escalation.option_for_rung(self._report_option, rung)
 
-    def _triage_problem(self, problem: FleetProblem, policy) -> FleetProblem:
+    def _triage_problem(self, problem: FleetProblem, policy,
+                        spec) -> FleetProblem:
         """Run pre-flight triage on one submission (host-side, on the
         submitter's thread).  Raises `ProblemRejected` under REJECT;
         returns the (possibly repaired) problem otherwise, with the
@@ -215,13 +216,17 @@ class FleetQueue:
         from megba_tpu.robustness.triage import TriageAction, triage_problem
 
         # The problem's own mask/fixed operands ride into the checks so
-        # triage sees the graph the solver will (see check_problem).
+        # triage sees the graph the solver will (see check_problem);
+        # the (already dim-validated) factor spec dispatches the
+        # geometric hooks — a non-projective family skips
+        # cheirality/parallax entirely.
         outcome = triage_problem(problem.cameras, problem.points,
                                  problem.obs, problem.cam_idx,
                                  problem.pt_idx, policy,
                                  edge_mask=problem.edge_mask,
                                  cam_fixed=problem.cam_fixed,
-                                 pt_fixed=problem.pt_fixed)
+                                 pt_fixed=problem.pt_fixed,
+                                 factor=spec)
         health = outcome.report.to_dict()
         rep = outcome.repair
         if rep is None or rep.is_noop:
@@ -244,14 +249,15 @@ class FleetQueue:
             problem, cameras=cameras, points=points, obs=obs,
             edge_mask=em, cam_fixed=cf, pt_fixed=pf, health=health)
 
-    def _key_for(self, problem: FleetProblem,
-                 rung: int) -> Tuple[ShapeClass, Tuple[int, int, int], int]:
+    def _key_for(
+        self, problem: FleetProblem, rung: int,
+    ) -> Tuple[ShapeClass, Tuple[int, int, int], str, int]:
         opt = self._rung_option(rung)
         n_cam, n_pt, n_edge = problem.dims()
         sc = classify(n_cam, n_pt, n_edge, opt.dtype, self.ladder)
         dims = (int(problem.cameras.shape[1]),
                 int(problem.points.shape[1]), int(problem.obs.shape[1]))
-        return (sc, dims, rung)
+        return (sc, dims, problem.factor, rung)
 
     def _depth_locked(self) -> int:
         """Pending problems that still want service: client-cancelled
@@ -289,13 +295,20 @@ class FleetQueue:
         """
         if deadline_s is not None and deadline_s < 0:
             raise ValueError(f"deadline_s must be >= 0, got {deadline_s}")
-        from megba_tpu.serving.batcher import _validate_problem
+        from megba_tpu.serving.batcher import (
+            _problem_spec,
+            _validate_problem,
+        )
 
+        # Factor resolution + block-dim check FIRST: an unknown name or
+        # wrong-width array must fail typed here, before the triage
+        # hooks (which index the spec's columns) could trip on it.
+        spec = _problem_spec(problem)
         if triage is not None:
             from megba_tpu.robustness.triage import ProblemRejected
 
             try:
-                problem = self._triage_problem(problem, triage)
+                problem = self._triage_problem(problem, triage, spec)
             except ProblemRejected as exc:
                 # Content rejection resolves the Future FAST: no queue
                 # capacity held, no escalation ladder, zero dispatch.
@@ -306,8 +319,11 @@ class FleetQueue:
                 return f
         # The shared ingestion gate still runs after triage when the
         # policy's structural pass (which subsumes the duplicate check)
-        # was disabled — _validate_problem skips itself otherwise.
-        _validate_problem(problem)
+        # was disabled — _validate_problem skips itself otherwise.  The
+        # option rides along for the robust-eligibility refusal (a
+        # robust kernel on a robust_ok=False family fails typed here,
+        # exactly like flat_solve's boundary).
+        _validate_problem(problem, option=self._option)
         key = self._key_for(problem, rung=0)
         now = time.monotonic()
         item = _Pending(
@@ -542,7 +558,7 @@ class FleetQueue:
         self.timer.count_event("fleet_retry")
 
     def _dispatch(self, key, taken: List[_Pending]) -> None:
-        sc, _dims, rung = key
+        sc, _dims, factor, rung = key
         bucket = str(sc)
         option = self._rung_option(rung)
         initial_region = (None if self.escalation is None else
@@ -551,15 +567,21 @@ class FleetQueue:
         for it in taken:
             it.attempts += 1
         items = [(i, p.problem) for i, p in enumerate(taken)]
+        # Per-factor engine, resolved per dispatch (memoised: one
+        # factor+mode = one engine object process-wide, so this costs a
+        # dict hit, and a mixed-factor queue can never cross-batch).
+        from megba_tpu.factors import engine_for
+
+        engine = engine_for(factor, option.jacobian_mode)
         try:
             if self._chaos is not None:
                 self._chaos.before_dispatch(bucket)
             solved = _solve_bucket(
-                items, sc, option, self._engine, self.ladder,
+                items, sc, option, engine, self.ladder,
                 self.pool, self.stats, self.timer, self._telemetry,
                 self._rung_report_option(rung),
                 initial_region=initial_region,
-                rung=rung, attempts=rung + 1)
+                rung=rung, attempts=rung + 1, factor=factor)
         except Exception as exc:  # fan out or escalate, keep serving
             self._on_dispatch_failure(bucket, taken, exc)
             return

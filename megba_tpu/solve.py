@@ -27,7 +27,6 @@ from megba_tpu.core.fm import EDGE_QUANTUM
 from megba_tpu.core.types import is_cam_sorted, pad_edges
 from megba_tpu.io.bal import BALFile, load_bal
 from megba_tpu.observability.emit import next_verbose_token
-from megba_tpu.ops.residuals import make_residual_jacobian_fn
 from megba_tpu.parallel.mesh import (
     distributed_lm_solve,
     get_or_build_program,
@@ -114,6 +113,7 @@ def flat_solve(
     timer: Optional[PhaseTimer] = None,
     elastic_report: Optional[dict] = None,
     triage=None,
+    factor=None,
     lower_only: bool = False,
 ) -> LMResult:
     """Lower flat arrays and run the solve (single- or multi-device).
@@ -192,12 +192,50 @@ def flat_solve(
     the report is attached and the solve is unchanged.  The
     HealthReport rides `SolveReport.health` when telemetry is on.
 
+    `factor` (a registered factor name or `factors.FactorSpec`) routes
+    this solve through the factor registry: the arrays are validated
+    against the spec's block dims (typed `FactorError` naming the
+    offending axis; an unknown name raises typed `UnknownFactorError`
+    HERE, before any device work), `residual_jac_fn=None` is resolved
+    to the spec's engine via `factors.engine_for` (memoised — one
+    config, one engine object, so the jit caches cannot split), robust
+    kernels are refused typed on families with `robust_ok=False`, and
+    the spec's triage hooks drive the geometric pre-flight checks.
+    Without `factor` the call behaves exactly as it always has (the
+    caller owns the engine; triage assumes the BAL family).
+
     `lower_only=True` returns the `jax.stages.Lowered` of the exact
     program this call would have dispatched — same host prep, same
     operands, same jit cache — without executing it.  This is the
     compiled-program auditor's entry point (analysis/program_audit.py):
     what it inspects IS the production program, not a replica.
     """
+    factor_spec = None
+    if factor is not None:
+        from megba_tpu.factors import (
+            engine_for,
+            get_factor,
+            validate_factor_arrays,
+        )
+        from megba_tpu.factors.registry import FactorError, require_schur
+        from megba_tpu.ops.robust import RobustKind
+
+        factor_spec = require_schur(get_factor(factor), "flat_solve")
+        validate_factor_arrays(factor_spec, cameras, points, obs,
+                               where="flat_solve")
+        if (option.robust_kind != RobustKind.NONE
+                and not factor_spec.robust_ok):
+            raise FactorError(
+                f"flat_solve: factor {factor_spec.name!r} is not "
+                "robust-kernel eligible (robust_ok=False — e.g. a "
+                "marginalization prior must not be IRLS-downweighted); "
+                "submit with robust_kind=NONE")
+        if residual_jac_fn is None:
+            residual_jac_fn = engine_for(factor_spec, option.jacobian_mode)
+    if residual_jac_fn is None:
+        raise ValueError(
+            "flat_solve needs residual_jac_fn or a registered factor= "
+            "to resolve one from")
     # Resolve the telemetry target here (knob wins over env), then strip
     # the knob: program caches are keyed on `option` and must stay
     # telemetry-agnostic — turning telemetry on can never recompile.
@@ -224,7 +262,7 @@ def flat_solve(
             outcome = triage_problem(
                 cameras, points, obs, cam_idx, pt_idx, triage,
                 edge_mask=edge_mask, cam_fixed=cam_fixed,
-                pt_fixed=pt_fixed)
+                pt_fixed=pt_fixed, factor=factor_spec)
         health = outcome.report.to_dict()
         rep = outcome.repair
         if rep is not None and not rep.is_noop:
@@ -608,10 +646,13 @@ def solve_bal(
         emit_problem_stats(bal.num_cameras, bal.num_points,
                            bal.num_observations, max_cd, max_pd, nnz)
 
-    f = make_residual_jacobian_fn(mode=option.jacobian_mode)
+    # Registry-dispatched: factor="bal" resolves the IDENTICAL engine
+    # object the historical make_residual_jacobian_fn(mode=...) default
+    # returned (factors/engine.py canonicalisation), so this refactor
+    # is program-cache- and bitwise-neutral.
     result = flat_solve(
-        f, bal.cameras, bal.points, bal.obs, bal.cam_idx, bal.pt_idx,
-        option, verbose=verbose)
+        None, bal.cameras, bal.points, bal.obs, bal.cam_idx, bal.pt_idx,
+        option, verbose=verbose, factor="bal")
 
     solved = BALFile(
         cameras=np.asarray(result.cameras, dtype=np.float64),

@@ -1,0 +1,68 @@
+"""Call-shape-normalising memoisation.
+
+`functools.lru_cache` keys the RAW call shape: `f(x)` and `f(arg=x)` are
+two different cache entries even though they run the same code on the
+same value.  For ordinary pure functions that is merely a wasted slot;
+for ENGINE/PROGRAM factories it is a correctness hazard — every cache in
+the solver stack that keys on engine identity (the jit program caches,
+the serving compile pool, the retrace sentinel's static keys) silently
+doubles when one call site spells a keyword and another does not, and
+the duplicate engine then costs a full duplicate trace + XLA compile.
+
+PR 6 fixed exactly that footgun on `make_residual_jacobian_fn` with a
+hand-written positional-binding wrapper, and PR 8 repeated the pattern
+on `batched_solve_program`.  `normalized_lru_cache` is the general
+form: it binds every call against the wrapped function's signature
+(defaults applied), so ALL spellings of one logical call — positional,
+keyword, defaulted, reordered keywords — collapse onto a single cache
+entry.  The factor registry's engine lookups (megba_tpu/factors/
+engine.py) ride it too, which is what makes "one factor config, one
+engine object, one compiled program" a structural property instead of a
+call-site convention.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+def normalized_lru_cache(maxsize: int = 64) -> Callable[[F], F]:
+    """`functools.lru_cache` behind signature-normalised call binding.
+
+    Every call is bound against the wrapped function's signature with
+    defaults applied and forwarded as a canonical positional tuple, so
+    keyword vs positional vs defaulted spellings of the same logical
+    call hit ONE entry.  Var-positional/var-keyword parameters are
+    rejected at decoration time: they have no canonical positional
+    form, and a factory taking **kwargs should not be memoised this way.
+
+    The wrapper exposes `cache_clear()` / `cache_info()` (forwarded to
+    the underlying lru) and `__wrapped__` (the original function).
+    """
+
+    def deco(fn: F) -> F:
+        sig = inspect.signature(fn)
+        for p in sig.parameters.values():
+            if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                          inspect.Parameter.VAR_KEYWORD):
+                raise TypeError(
+                    f"normalized_lru_cache cannot canonicalise *args/"
+                    f"**kwargs parameter {p.name!r} of {fn.__qualname__}")
+        order = tuple(sig.parameters)
+        cached = functools.lru_cache(maxsize=maxsize)(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()
+            return cached(*(bound.arguments[name] for name in order))
+
+        wrapper.cache_clear = cached.cache_clear  # type: ignore[attr-defined]
+        wrapper.cache_info = cached.cache_info  # type: ignore[attr-defined]
+        return wrapper  # type: ignore[return-value]
+
+    return deco

@@ -624,6 +624,116 @@ assert "under_constrained_point=1" in out_text, out_text
 PY
 echo "triage smoke OK"
 
+# Mixed-factor fleet smoke (ISSUE 13): the factor registry's
+# servability contract.  A fleet mixing FOUR residual families — rig
+# BA (shared body extrinsic, repeated (body, point) pairs), full-
+# intrinsics radial pinhole, GPS/IMU-style unary pose priors, and BAL —
+# rides ONE FleetQueue: problems must group per (factor, shape class)
+# (a bucket is one residual family by construction), every result must
+# come back terminal, the whole fleet must respect the <= 1 compile per
+# (factor, bucket) retrace budget, a REPEATED fleet must trace NOTHING,
+# and every problem must land BITWISE identical to its per-factor
+# solve_many control (cross-factor batching changes scheduling, never
+# answers).
+JAX_PLATFORMS=cpu python - <<'PY'
+import os
+
+import numpy as np
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.analysis import retrace
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption, SolveStatus
+from megba_tpu.factors.priors import make_synthetic_priors
+from megba_tpu.factors.radial import make_synthetic_radial
+from megba_tpu.factors.rig import make_synthetic_rig
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.serving import FleetProblem, FleetQueue, solve_many
+from megba_tpu.serving.batcher import _group_by_bucket
+from megba_tpu.serving.shape_class import BucketLadder
+
+OPT = ProblemOption(dtype=np.float64, algo_option=AlgoOption(max_iter=6),
+                    solver_option=SolverOption(max_iter=20, tol=1e-9))
+
+
+def fleet():
+    probs = []
+    for i in range(3):
+        probs.append(FleetProblem.from_synthetic(
+            make_synthetic_rig(seed=i), name=f"rig{i}", factor="rig"))
+        probs.append(FleetProblem.from_synthetic(
+            make_synthetic_radial(seed=i), name=f"rad{i}",
+            factor="pinhole_radial"))
+        s = make_synthetic_priors(seed=i)
+        probs.append(FleetProblem(
+            cameras=s.cameras0, points=s.points0, obs=s.obs,
+            cam_idx=s.cam_idx, pt_idx=s.pt_idx, name=f"pri{i}",
+            factor="pose_prior"))
+        probs.append(FleetProblem.from_synthetic(
+            make_synthetic_bal(seed=i), name=f"bal{i}"))
+    return probs
+
+
+probs = fleet()
+groups = _group_by_bucket(probs, OPT, BucketLadder())
+for (sc, dims, factor), items in groups.items():
+    assert {p.factor for _, p in items} == {factor}, (sc, factor)
+factors_seen = {factor for (_, _, factor) in groups}
+assert factors_seen == {"rig", "pinhole_radial", "pose_prior", "bal"}, (
+    factors_seen)
+print(f"mixed-factor smoke: {len(probs)} problems -> {len(groups)} "
+      f"(factor, bucket) groups across {len(factors_seen)} families")
+
+base = retrace.snapshot()
+with FleetQueue(OPT, max_batch=4, max_wait_s=0.01) as q:
+    futs = [q.submit(p) for p in probs]
+    q.flush()
+    queued = [f.result(timeout=600) for f in futs]
+new = {k: v - base.get(k, 0) for k, v in retrace.snapshot().items()
+       if k[0].startswith("serving.batched") and v > base.get(k, 0)}
+assert all(d <= 1 for d in new.values()), (
+    f"duplicate batched-program trace (cross-factor cache bust): {new}")
+terminal = {int(SolveStatus.CONVERGED), int(SolveStatus.MAX_ITER),
+            int(SolveStatus.RECOVERED)}
+assert all(int(r.status) in terminal for r in queued), [
+    (r.name, r.status_name) for r in queued]
+print(f"mixed-factor smoke: {sum(new.values())} programs traced "
+      "(<= 1 per (factor, bucket)), all results terminal")
+
+# a repeated fleet is compile-free: every (factor, bucket) program hot
+base2 = retrace.snapshot()
+repeat = solve_many(fleet(), OPT)
+new2 = {k: v - base2.get(k, 0) for k, v in retrace.snapshot().items()
+        if v > base2.get(k, 0)}
+assert not new2, f"repeat mixed fleet traced: {new2}"
+print("mixed-factor smoke: repeated fleet traced ZERO programs")
+
+# batch-mates bitwise vs per-factor solve_many controls
+by_name = {r.name: r for r in queued}
+for factor in sorted(factors_seen):
+    sub = [p for p in fleet() if p.factor == factor]
+    control = solve_many(sub, OPT)
+    for p, c in zip(sub, control):
+        r = by_name[p.name]
+        assert r.cameras.tobytes() == c.cameras.tobytes(), (
+            f"{p.name}: mixed-fleet params drifted from the "
+            f"per-factor control")
+        assert r.points.tobytes() == c.points.tobytes(), p.name
+        assert np.asarray(r.cost).tobytes() == np.asarray(
+            c.cost).tobytes(), p.name
+print("mixed-factor smoke: every problem BITWISE identical to its "
+      "per-factor solve_many control")
+PY
+echo "mixed-factor fleet smoke OK"
+
 # Federation smoke (ISSUE 12): the scale-out tier end to end.  A
 # 16-problem mixed f64 fleet is first solved single-host (the control)
 # through a CompilePool that then EXPORTS its working set — manifest +

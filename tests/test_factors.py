@@ -1,0 +1,747 @@
+"""Factor registry (megba_tpu/factors/): semantics, parity, servability.
+
+Three layers of coverage:
+
+- REGISTRY SEMANTICS (tier-1, compile-free): duplicate-name refusal,
+  typed unknown-factor errors at every dispatch boundary (`flat_solve`,
+  `solve_pgo`, `solve_many`, `FleetQueue.submit`), family/dim/robust
+  validation, the generalized call-shape-normalising engine cache, and
+  factor-dispatched triage/ingestion behaviour.
+- NUMERICAL PARITY (slow): every Schur family's engine against dense
+  jax autodiff at f64 (~1e-9), the pose families' residual conventions,
+  and the BITWISE-identity pin that the registry-dispatched BAL path
+  lowers byte-for-byte the program the direct-engine path always built.
+- SERVABILITY (slow): each new family solves end-to-end through
+  `flat_solve`/`solve_pgo`, and a MIXED-factor fleet through
+  `solve_many` + `FleetQueue` with correct (factor, shape-class)
+  separation, zero cross-factor retraces (sentinel-certified), and
+  batch-mates bitwise against per-factor controls — the acceptance demo
+  of ISSUE 13.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from megba_tpu.common import (
+    AlgoOption,
+    JacobianMode,
+    ProblemOption,
+    SolverOption,
+    SolveStatus,
+)
+from megba_tpu import factors
+from megba_tpu.factors import (
+    DuplicateFactorError,
+    FactorError,
+    FactorSpec,
+    PoseFactorSpec,
+    UnknownFactorError,
+    engine_for,
+    get_factor,
+    list_factors,
+    register_factor,
+    unregister_factor,
+)
+from megba_tpu.factors.priors import make_synthetic_priors
+from megba_tpu.factors.radial import make_synthetic_radial
+from megba_tpu.factors.rig import make_synthetic_rig
+from megba_tpu.factors.sim3 import (
+    make_synthetic_sim3_graph,
+    relative_sim3,
+    sim3_between_residual,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.models.planar import make_synthetic_planar
+from megba_tpu.solve import flat_solve
+
+
+def _opt(**kw):
+    base = dict(dtype=np.float64,
+                algo_option=AlgoOption(max_iter=8),
+                solver_option=SolverOption(max_iter=30, tol=1e-9))
+    base.update(kw)
+    return ProblemOption(**base)
+
+
+def _factor_problem(name, seed=0):
+    """(scene, FleetProblem-ready arrays) for one Schur family."""
+    if name == "rig":
+        s = make_synthetic_rig(seed=seed)
+    elif name == "pinhole_radial":
+        s = make_synthetic_radial(seed=seed)
+    elif name == "pose_prior":
+        s = make_synthetic_priors(seed=seed)
+    elif name == "bal":
+        s = make_synthetic_bal(seed=seed)
+    elif name == "planar":
+        s = make_synthetic_planar(seed=seed)
+    else:
+        raise AssertionError(name)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics (tier-1, compile-free)
+# ---------------------------------------------------------------------------
+
+def test_builtin_families_registered():
+    reg = list_factors()
+    for name in ("bal", "planar", "rig", "pinhole_radial", "pose_prior"):
+        assert reg[name].kind == "schur", name
+    for name in ("se3_between", "sim3_between"):
+        assert reg[name].kind == "pose_graph", name
+
+
+def test_duplicate_registration_refused():
+    spec = dataclasses.replace(get_factor("bal"), description="clone")
+    with pytest.raises(DuplicateFactorError, match="already registered"):
+        register_factor(spec)
+    # allow_override is the explicit escape hatch; restore afterwards.
+    original = get_factor("bal")
+    try:
+        register_factor(spec, allow_override=True)
+        assert get_factor("bal").description == "clone"
+    finally:
+        register_factor(original, allow_override=True)
+
+
+def test_unregister_then_unknown():
+    probe = FactorSpec(name="_probe", cam_dim=2, pt_dim=2, obs_dim=1,
+                       residual_dim=1, residual_fn=lambda c, p, o: o)
+    register_factor(probe)
+    assert get_factor("_probe") is probe
+    unregister_factor("_probe")
+    with pytest.raises(UnknownFactorError, match="_probe"):
+        get_factor("_probe")
+
+
+def test_unknown_factor_names_known_ones():
+    with pytest.raises(UnknownFactorError) as ei:
+        get_factor("pinhole_radail")  # typo
+    assert "pinhole_radial" in str(ei.value)
+
+
+def test_spec_dim_validation():
+    with pytest.raises(FactorError, match="cam_dim"):
+        FactorSpec(name="bad", cam_dim=0, pt_dim=3, obs_dim=2,
+                   residual_dim=2, residual_fn=lambda c, p, o: o)
+    with pytest.raises(FactorError, match="pose_dim"):
+        PoseFactorSpec(name="bad", pose_dim=0, meas_dim=6,
+                       residual_dim=6, residual_fn=lambda i, j, m: m)
+
+
+def test_flat_solve_typed_errors_before_any_device_work():
+    s = make_synthetic_rig()
+    opt = _opt()
+    with pytest.raises(UnknownFactorError):
+        flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, opt, factor="nope")
+    with pytest.raises(FactorError, match="pose-graph family"):
+        flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, opt, factor="se3_between")
+    # dim mismatch names the axis and the factor
+    with pytest.raises(FactorError, match="cameras width 7"):
+        flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, opt, factor="bal")
+    with pytest.raises(ValueError, match="residual_jac_fn or a registered"):
+        flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, opt)
+
+
+def test_flat_solve_refuses_robust_kernel_on_ineligible_factor():
+    from megba_tpu.ops.robust import RobustKind
+
+    s = make_synthetic_priors()
+    opt = dataclasses.replace(_opt(), robust_kind=RobustKind.HUBER)
+    with pytest.raises(FactorError, match="not robust-kernel eligible"):
+        flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, opt, factor="pose_prior")
+
+
+def test_solve_pgo_typed_errors():
+    from megba_tpu.models.pgo import make_synthetic_pose_graph, solve_pgo
+
+    g = make_synthetic_pose_graph(num_poses=6, loop_closures=1)
+    with pytest.raises(UnknownFactorError):
+        solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, _opt(),
+                  factor="nope")
+    with pytest.raises(FactorError, match="Schur"):
+        solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, _opt(),
+                  factor="bal")
+    with pytest.raises(ValueError, match="pose_dim 7"):
+        solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, _opt(),
+                  factor="sim3_between")
+
+
+def test_serving_typed_errors_at_ingestion():
+    from megba_tpu.serving.batcher import FleetProblem, _validate_problem
+
+    s = make_synthetic_bal()
+    with pytest.raises(UnknownFactorError):
+        _validate_problem(FleetProblem.from_synthetic(s, factor="nope"))
+    with pytest.raises(FactorError, match="pose-graph"):
+        _validate_problem(
+            FleetProblem.from_synthetic(s, factor="se3_between"))
+    rig = make_synthetic_rig()
+    with pytest.raises(FactorError, match="width"):
+        _validate_problem(
+            FleetProblem.from_synthetic(rig, name="p0", factor="bal"))
+
+
+def test_queue_submit_typed_unknown_factor():
+    from megba_tpu.serving.batcher import FleetProblem
+    from megba_tpu.serving.queue import FleetQueue
+
+    s = make_synthetic_bal()
+    with FleetQueue(_opt()) as q:
+        with pytest.raises(UnknownFactorError):
+            q.submit(FleetProblem.from_synthetic(s, factor="nope"))
+
+
+def test_serving_refuses_robust_kernel_on_ineligible_factor():
+    """The fleet boundary makes the SAME robust_ok refusal flat_solve
+    makes — a marginalization prior can't be silently IRLS-downweighted
+    through solve_many or the queue."""
+    from megba_tpu.ops.robust import RobustKind
+    from megba_tpu.serving.batcher import FleetProblem, solve_many
+    from megba_tpu.serving.queue import FleetQueue
+
+    s = make_synthetic_priors()
+    p = FleetProblem(cameras=s.cameras0, points=s.points0, obs=s.obs,
+                     cam_idx=s.cam_idx, pt_idx=s.pt_idx,
+                     factor="pose_prior")
+    opt = dataclasses.replace(_opt(), robust_kind=RobustKind.HUBER)
+    with pytest.raises(FactorError, match="not robust-kernel eligible"):
+        solve_many([p], opt)
+    with FleetQueue(opt) as q:
+        with pytest.raises(FactorError, match="not robust-kernel"):
+            q.submit(p)
+
+
+def test_manifest_entries_record_factor_and_warm_per_family():
+    """A mixed-factor service's manifest names each bucket's family,
+    and warm() resolves each entry's OWN engine — warming a rig bucket
+    with the BAL engine would trace-crash on the 7-wide camera blocks
+    (the federation cold-start path)."""
+    from megba_tpu.serving import compile_pool as cp
+    from megba_tpu.serving.shape_class import ShapeClass
+
+    opt = _opt()
+    pool = cp.CompilePool()
+    shape_rig = ShapeClass(n_cam=4, n_pt=32, n_edge=2048, dtype="float64")
+    shape_bal = ShapeClass(n_cam=4, n_pt=32, n_edge=1024, dtype="float64")
+    pool.program(engine_for("rig"), opt, shape_rig, 4, 7, 3, 8,
+                 factor="rig")
+    pool.program(engine_for("bal"), opt, shape_bal, 4, 9, 3, 2,
+                 factor="bal")
+    entries = {e.get("factor"): e for e in pool.entries()}
+    assert entries["rig"]["cd"] == 7 and entries["bal"]["cd"] == 9
+
+    # per-entry engine resolution: factor entries get their family's
+    # engine, factor-less (legacy) entries keep the caller's
+    sentinel = object()
+    assert cp.CompilePool._entry_engine(
+        entries["rig"], sentinel, opt) is engine_for("rig")
+    assert cp.CompilePool._entry_engine(
+        {"shape": {}}, sentinel, opt) is sentinel
+
+    # warm() routes each entry through its own engine (lower_bucket
+    # stubbed: this is an engine-ROUTING test, not a compile test)
+    seen = []
+
+    class _Stub:
+        def compile(self):
+            return object()
+
+    real = cp.lower_bucket
+    cp.reset_process_cache()
+    try:
+        cp.lower_bucket = lambda engine, *a, **kw: (
+            seen.append(engine), _Stub())[1]
+        built = pool.warm(engine_for("bal"), opt,
+                          list(entries.values()))
+    finally:
+        cp.lower_bucket = real
+        cp.reset_process_cache()
+    assert built == 2
+    assert engine_for("rig") in seen and engine_for("bal") in seen
+
+
+def test_rig_duplicate_pairs_pass_ingestion_bal_refuses():
+    """unique_edges drives the duplicate-edge gate per factor."""
+    from megba_tpu.io.bal import validate_problem
+    from megba_tpu.serving.batcher import FleetProblem, _validate_problem
+
+    s = make_synthetic_rig(rig_cameras=2)
+    # The rig fans every (body, point) pair over 2 cameras: repeated
+    # index pairs by construction.
+    key = s.cam_idx.astype(np.int64) * s.points0.shape[0] + s.pt_idx
+    assert np.unique(key).shape[0] < key.shape[0]
+    _validate_problem(FleetProblem.from_synthetic(s, factor="rig"))
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_problem(s.cameras0, s.points0, s.obs, s.cam_idx,
+                         s.pt_idx, where="test", unique_edges=True)
+    # and the exact same arrays pass with the gate lifted
+    validate_problem(s.cameras0, s.points0, s.obs, s.cam_idx,
+                     s.pt_idx, where="test", unique_edges=False)
+
+
+# ---------------------------------------------------------------------------
+# Engine cache normalisation (tier-1, compile-free)
+# ---------------------------------------------------------------------------
+
+def test_engine_identity_registry_vs_direct():
+    """get_factor('bal') resolves to the IDENTICAL engine object the
+    historical default call returns — in every mode spelling."""
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    assert engine_for("bal") is make_residual_jacobian_fn()
+    assert engine_for("bal", JacobianMode.AUTODIFF) is \
+        make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    assert engine_for("bal", JacobianMode.ANALYTICAL) is \
+        make_residual_jacobian_fn(mode=JacobianMode.ANALYTICAL)
+    # memoised: repeat lookups return the same object
+    assert engine_for("rig") is engine_for("rig")
+    assert engine_for("rig") is not engine_for("pinhole_radial")
+
+
+def test_engine_for_analytical_refused_without_closed_form():
+    with pytest.raises(FactorError, match="no analytical Jacobian"):
+        engine_for("rig", JacobianMode.ANALYTICAL)
+
+
+def test_engine_for_rejects_pose_graph_factor():
+    with pytest.raises(FactorError, match="pose-graph"):
+        engine_for("sim3_between")
+
+
+def test_normalized_lru_cache_collapses_spellings():
+    from megba_tpu.utils.memo import normalized_lru_cache
+
+    calls = []
+
+    @normalized_lru_cache(maxsize=8)
+    def make(a, b=2, c=3):
+        calls.append((a, b, c))
+        return object()
+
+    r = make(1)
+    assert make(1, 2) is r
+    assert make(a=1) is r
+    assert make(1, c=3, b=2) is r
+    assert make(b=2, a=1) is r
+    assert len(calls) == 1
+    assert make(1, b=9) is not r
+    assert len(calls) == 2
+    make.cache_clear()
+    assert make(1) is not r
+    assert len(calls) == 3
+
+
+def test_normalized_lru_cache_rejects_var_signatures():
+    from megba_tpu.utils.memo import normalized_lru_cache
+
+    with pytest.raises(TypeError, match="args"):
+        @normalized_lru_cache()
+        def bad(*args):
+            return None
+
+    with pytest.raises(TypeError, match="kw"):
+        @normalized_lru_cache()
+        def bad2(**kw):
+            return None
+
+
+def test_batched_solve_program_spellings_one_entry():
+    """The serving program factory rides the same normalisation (the
+    PR 6 footgun, generalized)."""
+    from megba_tpu.serving.compile_pool import batched_solve_program
+
+    engine = engine_for("bal")
+    opt = _opt()
+    a = batched_solve_program(engine, opt)
+    assert batched_solve_program(engine, opt, False) is a
+    assert batched_solve_program(engine, opt, faulted=False) is a
+    assert batched_solve_program(engine, opt, 0) is a
+    assert batched_solve_program(engine, opt, faulted=True) is not a
+
+
+# ---------------------------------------------------------------------------
+# Factor-dispatched triage (tier-1, host NumPy only)
+# ---------------------------------------------------------------------------
+
+def test_triage_rig_duplicates_not_flagged():
+    from megba_tpu.robustness.triage import (
+        CheckKind,
+        TriagePolicy,
+        check_problem,
+    )
+
+    s = make_synthetic_rig(rig_cameras=2)
+    report, _ = check_problem(s.cameras0, s.points0, s.obs, s.cam_idx,
+                              s.pt_idx, factor=get_factor("rig"))
+    assert report.finding(CheckKind.DUPLICATE_EDGE) is None
+    # the SAME index structure under default (unique-edge) semantics IS
+    # duplicate poison (structural-only policy: the 7-wide rig camera
+    # blocks are not BAL-projectable)
+    report2, _ = check_problem(s.cameras0, s.points0, s.obs, s.cam_idx,
+                               s.pt_idx,
+                               policy=TriagePolicy(geometric=False))
+    assert report2.finding(CheckKind.DUPLICATE_EDGE) is not None
+
+
+def test_triage_rig_cheirality_through_hook():
+    from megba_tpu.robustness.triage import CheckKind, check_problem
+
+    s = make_synthetic_rig()
+    pts = s.points0.copy()
+    pts[int(s.pt_idx[0])] = [0.0, 0.0, 6.0]  # behind the rig (z ~ +1)
+    report, _ = check_problem(s.cameras0, pts, s.obs, s.cam_idx,
+                              s.pt_idx, factor=get_factor("rig"))
+    f = report.finding(CheckKind.BEHIND_CAMERA)
+    assert f is not None and f.count >= 1
+    assert report.geometric
+
+
+def test_triage_hookless_factor_skips_geometric_pass():
+    from megba_tpu.robustness.triage import (
+        CheckKind,
+        TriageAction,
+        TriagePolicy,
+        triage_problem,
+    )
+
+    s = make_synthetic_priors()
+    out = triage_problem(
+        s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
+        TriagePolicy(on_degenerate=TriageAction.REJECT, geometric=True),
+        factor=get_factor("pose_prior"))
+    # no projective findings possible, and the report must record that
+    # the geometric pass never ran (not "ran clean")
+    assert out.report.geometric is False
+    for kind in (CheckKind.BEHIND_CAMERA, CheckKind.LOW_PARALLAX,
+                 CheckKind.EXTREME_RESIDUAL):
+        assert out.report.finding(kind) is None
+
+
+def test_triage_default_factor_unchanged():
+    """factor=None keeps the historical BAL behaviour bit-for-bit."""
+    from megba_tpu.robustness.triage import check_problem
+
+    s = make_synthetic_bal(n_behind_camera=2, num_cameras=6,
+                           num_points=40)
+    r_none, i_none = check_problem(s.cameras0, s.points0, s.obs,
+                                   s.cam_idx, s.pt_idx)
+    r_bal, i_bal = check_problem(s.cameras0, s.points0, s.obs,
+                                 s.cam_idx, s.pt_idx,
+                                 factor=get_factor("bal"))
+    assert r_none.counts() == r_bal.counts()
+    assert np.array_equal(i_none["bad_edge"], i_bal["bad_edge"])
+    assert np.array_equal(i_none["weight"], i_bal["weight"])
+
+
+# ---------------------------------------------------------------------------
+# Host-side sim(3) chart maps (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_sim3_compose_relative_inverse():
+    from megba_tpu.factors.sim3 import compose_sim3
+
+    rng = np.random.default_rng(3)
+    a = rng.normal(scale=0.4, size=(32, 7))
+    b = rng.normal(scale=0.4, size=(32, 7))
+    rel = relative_sim3(a, b)
+    assert np.allclose(compose_sim3(a, rel), b, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Numerical parity + bitwise pins (slow: these compile)
+# ---------------------------------------------------------------------------
+
+SCHUR_FAMILIES = ["bal", "planar", "rig", "pinhole_radial", "pose_prior"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SCHUR_FAMILIES)
+def test_engine_parity_vs_dense_autodiff_f64(name):
+    """Every family's engine (reverse-mode, the production default)
+    against jax.jacobian of the spec's own residual at f64 — and the
+    forward-mode engine against the same reference."""
+    spec = get_factor(name)
+    s = _factor_problem(name)
+    k = min(16, s.cam_idx.shape[0])
+    cams = np.asarray(s.cameras0, np.float64)[s.cam_idx[:k]]
+    pts = np.asarray(s.points0, np.float64)[s.pt_idx[:k]]
+    obs = np.asarray(s.obs, np.float64)[:k]
+
+    modes = [JacobianMode.AUTODIFF, JacobianMode.AUTODIFF_FORWARD]
+    if spec.analytical_fn is not None:
+        modes.append(JacobianMode.ANALYTICAL)
+    for mode in modes:
+        engine = engine_for(spec, mode)
+        r, Jc, Jp = engine(cams.T, pts.T, obs.T)
+        r = np.asarray(r).T
+        Jc = np.asarray(Jc).reshape(spec.residual_dim, spec.cam_dim, k)
+        Jp = np.asarray(Jp).reshape(spec.residual_dim, spec.pt_dim, k)
+        for e in range(k):
+            r_ref = np.asarray(spec.residual_fn(cams[e], pts[e], obs[e]))
+            Jc_ref = np.asarray(jax.jacobian(spec.residual_fn, argnums=0)(
+                cams[e], pts[e], obs[e]))
+            Jp_ref = np.asarray(jax.jacobian(spec.residual_fn, argnums=1)(
+                cams[e], pts[e], obs[e]))
+            scale = max(1.0, np.abs(Jc_ref).max(), np.abs(Jp_ref).max())
+            assert np.allclose(r[e], r_ref, atol=1e-9), (name, mode)
+            assert np.allclose(Jc[:, :, e], Jc_ref,
+                               atol=1e-9 * scale), (name, mode)
+            assert np.allclose(Jp[:, :, e], Jp_ref,
+                               atol=1e-9 * scale), (name, mode)
+
+
+@pytest.mark.slow
+def test_sim3_residual_parity_and_se3_reduction():
+    """sim(3) Jacobian fwd==rev at f64, zero residual on exact
+    measurements, and exact reduction to the SE(3) between residual at
+    unit scale."""
+    from megba_tpu.models.pgo import between_residual
+
+    g = make_synthetic_sim3_graph(num_poses=12, loop_closures=3)
+    pi = jnp.asarray(g.poses_gt[g.edge_i])
+    pj = jnp.asarray(g.poses_gt[g.edge_j])
+    m = jnp.asarray(g.meas)
+    r = jax.vmap(sim3_between_residual)(pi, pj, m)
+    assert np.abs(np.asarray(r)).max() < 1e-12
+
+    def stack(f):
+        return jax.vmap(f)(pi, pj, m)
+
+    Jf = np.asarray(stack(jax.jacfwd(sim3_between_residual, argnums=0)))
+    Jr = np.asarray(stack(jax.jacrev(sim3_between_residual, argnums=0)))
+    assert np.allclose(Jf, Jr, atol=1e-9)
+
+    # unit scale: rows 0:6 reduce to the SE(3) between residual
+    rng = np.random.default_rng(7)
+    a = np.concatenate([rng.normal(scale=0.3, size=(8, 6)),
+                        np.zeros((8, 1))], axis=1)
+    b = np.concatenate([rng.normal(scale=0.3, size=(8, 6)),
+                        np.zeros((8, 1))], axis=1)
+    meas = relative_sim3(a, b) * 0.9  # perturbed so r != 0
+    meas[:, 6] = 0.0
+    r7 = np.asarray(jax.vmap(sim3_between_residual)(
+        jnp.asarray(a), jnp.asarray(b), jnp.asarray(meas)))
+    r6 = np.asarray(jax.vmap(between_residual)(
+        jnp.asarray(a[:, :6]), jnp.asarray(b[:, :6]),
+        jnp.asarray(meas[:, :6])))
+    assert np.allclose(r7[:, :6], r6, atol=1e-12)
+    assert np.abs(r7[:, 6]).max() < 1e-12
+
+
+@pytest.mark.slow
+def test_bal_factor_path_lowers_byte_identical_program():
+    """The registry-dispatched BAL solve and the historical direct-
+    engine call lower the EXACT same program, byte for byte — the
+    refactor's no-regression pin."""
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+
+    s = make_synthetic_bal(num_cameras=4, num_points=24, seed=0)
+    opt = _opt(algo_option=AlgoOption(max_iter=3),
+               solver_option=SolverOption(max_iter=8, tol=1e-9))
+    args = (s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx, opt)
+    direct = flat_solve(make_residual_jacobian_fn(), *args,
+                        use_tiled=False, lower_only=True)
+    via_registry = flat_solve(None, *args, use_tiled=False,
+                              factor="bal", lower_only=True)
+    assert direct.as_text() == via_registry.as_text()
+
+
+@pytest.mark.slow
+def test_pgo_default_factor_is_cached_program_identity():
+    """solve_pgo's default and an explicit se3_between spec hit the
+    SAME lru-cached program object — no duplicate trace, no drift."""
+    from megba_tpu.factors.pose_graph import SPEC
+    from megba_tpu.models.pgo import _pgo_program
+
+    opt = _opt()
+    a = _pgo_program(opt, 1, 16, np.dtype(np.float64), (), False, SPEC)
+    b = _pgo_program(opt, 1, 16, np.dtype(np.float64), (), False, SPEC)
+    assert a is b
+
+
+# ---------------------------------------------------------------------------
+# End-to-end solves (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_rig_solves_and_recovers_scene():
+    s = make_synthetic_rig(pixel_noise=0.0, param_noise=2e-2)
+    r = flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, _opt(), factor="rig")
+    assert float(r.cost) < 1e-2 * float(r.initial_cost)
+    assert int(r.status) in (SolveStatus.CONVERGED, SolveStatus.MAX_ITER)
+
+
+@pytest.mark.slow
+def test_radial_solves_with_live_distortion_dofs():
+    s = make_synthetic_radial(pixel_noise=0.0, param_noise=1e-2)
+    r = flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, _opt(), factor="pinhole_radial")
+    assert float(r.cost) < 1e-2 * float(r.initial_cost)
+
+    # k1/k2 are OPTIMISABLE state, not constants: start everything
+    # else at ground truth, poison only the distortion, and the solve
+    # must repair it (on the full perturbed scene above, the 12-dof
+    # intrinsics admit compensating directions — cx/cy/k1 trade against
+    # rotation on a narrow FOV — so parameter recovery is only
+    # identifiable from this targeted start).
+    cams = s.cameras_gt.copy()
+    cams[:, 10] += 0.05  # ~1000x the generator's k1 spread
+    r2 = flat_solve(None, cams, s.points_gt, s.obs, s.cam_idx,
+                    s.pt_idx,
+                    _opt(algo_option=AlgoOption(max_iter=15,
+                                                epsilon1=1e-8)),
+                    factor="pinhole_radial")
+    k1_err0 = np.abs(cams[:, 10] - s.cameras_gt[:, 10]).max()
+    k1_err = np.abs(
+        np.asarray(r2.cameras)[:, 10] - s.cameras_gt[:, 10]).max()
+    assert float(r2.cost) < 1e-4 * float(r2.initial_cost)
+    assert k1_err < 0.1 * k1_err0
+
+
+@pytest.mark.slow
+def test_pose_prior_solve_recovers_exact_priors():
+    """With exact priors the optimum IS the prior set (closed form)."""
+    s = make_synthetic_priors(prior_noise=0.0, param_noise=5e-2)
+    opt = _opt(algo_option=AlgoOption(max_iter=15, epsilon1=1e-9))
+    r = flat_solve(None, s.cameras0, s.points0, s.obs, s.cam_idx,
+                   s.pt_idx, opt, factor="pose_prior")
+    assert np.abs(np.asarray(r.cameras) - s.poses_gt).max() < 1e-5
+    # the dummy point never moved
+    assert np.array_equal(np.asarray(r.points), s.points0)
+
+
+@pytest.mark.slow
+def test_sim3_pgo_corrects_scale_drift():
+    """Noise-free sim(3) loop closing solves to the exact graph.
+
+    refuse_ratio is RELAXED here: the reference's rho-monotonicity
+    refuse (refuse_ratio=1.0, schur_pcg_solver.cu:288-296) fires on the
+    sim(3) system's very first PCG iteration — the mixed
+    rotation/translation/log-scale block makes the preconditioned
+    residual energy non-monotone even though CG is converging in
+    A-norm — silently returning dx=0 and stalling LM at a 10x cost
+    drop.  With the refuse relaxed the same solve reaches machine-zero
+    cost in 5 LM iterations and recovers the scale trail exactly (see
+    ARCHITECTURE.md "Factor registry").
+    """
+    from megba_tpu.models.pgo import solve_pgo
+
+    g = make_synthetic_sim3_graph(num_poses=24, loop_closures=6,
+                                  scale_drift=0.05)
+    opt = _opt(algo_option=AlgoOption(max_iter=25, epsilon1=1e-8),
+               solver_option=SolverOption(max_iter=80, tol=1e-10,
+                                          refuse_ratio=16.0,
+                                          tol_relative=True))
+    r = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, opt,
+                  factor="sim3_between")
+    assert float(r.cost) < 1e-9 * float(r.initial_cost)
+    scale_err0 = np.abs(g.poses0[:, 6] - g.poses_gt[:, 6]).max()
+    scale_err = np.abs(
+        np.asarray(r.poses)[:, 6] - g.poses_gt[:, 6]).max()
+    assert scale_err0 > 0.05  # the drift was real
+    assert scale_err < 1e-3  # and it is gone
+
+
+# ---------------------------------------------------------------------------
+# Mixed-factor fleet servability (slow) — the ISSUE 13 acceptance demo
+# ---------------------------------------------------------------------------
+
+def _mixed_fleet(n_each=2):
+    from megba_tpu.serving.batcher import FleetProblem
+
+    probs = []
+    for i in range(n_each):
+        probs.append(FleetProblem.from_synthetic(
+            make_synthetic_rig(seed=i), name=f"rig{i}", factor="rig"))
+        probs.append(FleetProblem.from_synthetic(
+            make_synthetic_radial(seed=i), name=f"rad{i}",
+            factor="pinhole_radial"))
+        s = make_synthetic_priors(seed=i)
+        probs.append(FleetProblem(
+            cameras=s.cameras0, points=s.points0, obs=s.obs,
+            cam_idx=s.cam_idx, pt_idx=s.pt_idx, name=f"pri{i}",
+            factor="pose_prior"))
+        probs.append(FleetProblem.from_synthetic(
+            make_synthetic_bal(seed=i), name=f"bal{i}"))
+    return probs
+
+
+@pytest.mark.slow
+def test_mixed_factor_fleet_serves_with_factor_separation(retrace_sentinel):
+    """A rig+radial+prior+BAL fleet through solve_many AND FleetQueue:
+    every problem terminal, queue bitwise-equal to the synchronous
+    path, per-(factor, bucket) batching, and a REPEATED fleet adds
+    ZERO traces (the sentinel window fails on any cross-factor or
+    repeat retrace)."""
+    from megba_tpu.serving.batcher import _group_by_bucket, solve_many
+    from megba_tpu.serving.queue import FleetQueue
+    from megba_tpu.serving.shape_class import BucketLadder
+
+    opt = _opt(algo_option=AlgoOption(max_iter=6),
+               solver_option=SolverOption(max_iter=20, tol=1e-9))
+    probs = _mixed_fleet()
+
+    # factor separation at the grouping layer: rig/radial/prior/bal
+    # never share a bucket even where shape classes collide
+    groups = _group_by_bucket(probs, opt, BucketLadder())
+    for (sc, dims, factor), items in groups.items():
+        assert {p.factor for _, p in items} == {factor}
+    by_factor = {}
+    for (sc, dims, factor) in groups:
+        by_factor.setdefault(factor, 0)
+        by_factor[factor] += 1
+    assert set(by_factor) == {"rig", "pinhole_radial", "pose_prior",
+                              "bal"}
+
+    control = solve_many(probs, opt)
+    assert all(r.status in (SolveStatus.CONVERGED, SolveStatus.MAX_ITER,
+                            SolveStatus.RECOVERED) for r in control)
+
+    with FleetQueue(opt, max_batch=4, max_wait_s=0.01) as q:
+        futs = [q.submit(p) for p in probs]
+        q.flush()
+        queued = [f.result() for f in futs]
+    for a, b in zip(control, queued):
+        assert np.array_equal(a.cameras, b.cameras), a.name
+        assert np.array_equal(a.points, b.points), a.name
+
+    # a second identical fleet must be compile-free: everything below
+    # this line rides the caches (the sentinel fixture fails the test
+    # on ANY duplicate trace in the whole window)
+    repeat = solve_many(_mixed_fleet(), opt)
+    for a, b in zip(control, repeat):
+        assert np.array_equal(a.cameras, b.cameras), a.name
+
+
+@pytest.mark.slow
+def test_mixed_fleet_batchmates_bitwise_vs_per_factor_controls():
+    """Each factor's problems solved in the MIXED fleet are bitwise
+    identical to the same problems solved in a single-factor fleet:
+    batching across factors changes scheduling, never answers."""
+    from megba_tpu.serving.batcher import solve_many
+
+    opt = _opt(algo_option=AlgoOption(max_iter=6),
+               solver_option=SolverOption(max_iter=20, tol=1e-9))
+    mixed = solve_many(_mixed_fleet(), opt)
+    by_name = {r.name: r for r in mixed}
+    for factor in ("rig", "pinhole_radial", "pose_prior", "bal"):
+        sub = [p for p in _mixed_fleet() if p.factor == factor]
+        alone = solve_many(sub, opt)
+        for p, r in zip(sub, alone):
+            m = by_name[p.name]
+            assert np.array_equal(m.cameras, r.cameras), p.name
+            assert np.array_equal(m.points, r.points), p.name
+            assert m.cost == r.cost, p.name

@@ -432,3 +432,217 @@ def test_prior_gauge_decided_per_connected_component():
     # Component B was not reached by the prior: it is anchored at its
     # own first pose (index na), exactly at that pose's file estimate.
     np.testing.assert_allclose(out[na], np.asarray(b.poses0)[0], atol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# EDGE_SE3_PRIOR ingestion (ISSUE 13 satellite: unary-prior tags)
+# ---------------------------------------------------------------------------
+
+_DIAG21 = " ".join("1" if i in (0, 6, 11, 15, 18, 20) else "0"
+                   for i in range(21))
+
+
+def _prior_file(info=_DIAG21):
+    return io.StringIO(
+        "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+        "VERTEX_SE3:QUAT 1 1 0 0 0 0 0 1\n"
+        "EDGE_SE3:QUAT 0 1 1.05 0 0 0 0 0 1 " + _DIAG21 + "\n"
+        "EDGE_SE3_PRIOR 0 0.5 0 0 0 0 0 1 " + info + "\n")
+
+
+def test_prior_records_parsed_with_chart():
+    g = read_g2o(_prior_file())
+    assert g.prior_idx.tolist() == [0]
+    # measurement lands in OUR chart: [aa(3), t(3)]
+    np.testing.assert_allclose(g.prior_meas[0],
+                               [0, 0, 0, 0.5, 0, 0], atol=1e-12)
+    # identity g2o info -> chart-corrected ours: rotation rows x 1/4
+    np.testing.assert_allclose(np.diag(g.prior_info[0]),
+                               [0.25, 0.25, 0.25, 1, 1, 1], atol=1e-12)
+
+
+def test_prior_roundtrip_through_writer():
+    g = read_g2o(_prior_file())
+    buf = io.StringIO()
+    write_g2o(buf, g)
+    g2 = read_g2o(io.StringIO(buf.getvalue()))
+    np.testing.assert_allclose(g2.prior_meas, g.prior_meas, atol=1e-9)
+    np.testing.assert_allclose(g2.prior_info, g.prior_info, atol=1e-9)
+    assert g2.prior_idx.tolist() == g.prior_idx.tolist()
+
+
+def test_prior_malformed_counts_name_the_line():
+    with pytest.raises(ValueError, match="line 2: EDGE_SE3_PRIOR needs"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+            "EDGE_SE3_PRIOR 0 1 2 3\n"))
+    # the 30-token upstream form (offset PARAMS id) is refused typed,
+    # never silently mis-read
+    with pytest.raises(ValueError, match="offset PARAMS id"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+            "EDGE_SE3_PRIOR 0 99 0 0 0 0 0 0 1 " + _DIAG21 + "\n"))
+
+
+def test_prior_unknown_vertex_and_nonfinite():
+    with pytest.raises(ValueError, match="line 2: .*unknown vertex 7"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+            "EDGE_SE3_PRIOR 7 0 0 0 0 0 0 1 " + _DIAG21 + "\n"))
+    with pytest.raises(ValueError, match="line 2: .*non-finite"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+            "EDGE_SE3_PRIOR 0 nan 0 0 0 0 0 1 " + _DIAG21 + "\n"))
+
+
+@pytest.mark.slow
+def test_solve_g2o_file_priors_anchor():
+    """A file-carried prior acts exactly like the prior_ids machinery:
+    the anchored pose lands on the PRIOR pose, not its drifted VERTEX
+    estimate, and the between edge is satisfied around it."""
+    g = read_g2o(_prior_file(
+        " ".join("10000" if i in (0, 6, 11, 15, 18, 20) else "0"
+                 for i in range(21))))
+    assert not g.had_fix  # priors carry the gauge
+    _, res = solve_g2o(g, _option(max_iter=25))
+    out = np.asarray(res.poses)
+    # prior pose: t = [0.5, 0, 0]; edge: pose1 = prior + [1.05, 0, 0]
+    np.testing.assert_allclose(out[0, 3:], [0.5, 0, 0], atol=1e-3)
+    np.testing.assert_allclose(out[1, 3:], [1.55, 0, 0], atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# VERTEX/EDGE_SIM3:QUAT ingestion (ISSUE 13 satellite: sim(3) tags)
+# ---------------------------------------------------------------------------
+
+_DIAG28 = " ".join("1" if i in (0, 7, 13, 18, 22, 25, 27) else "0"
+                   for i in range(28))
+
+
+def _sim3_file():
+    return io.StringIO(
+        "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+        "VERTEX_SIM3:QUAT 1 1 0 0 0 0 0 1 2\n"
+        "EDGE_SIM3:QUAT 0 1 1 0 0 0 0 0 1 2 " + _DIAG28 + "\n")
+
+
+def test_sim3_parsed_into_log_scale_chart():
+    g = read_g2o(_sim3_file())
+    assert g.sim3 and g.poses.shape == (2, 7)
+    np.testing.assert_allclose(g.poses[:, 6], [0.0, np.log(2.0)],
+                               atol=1e-12)
+    np.testing.assert_allclose(g.meas[0, 6], np.log(2.0), atol=1e-12)
+    # identity file info -> chart-corrected: rotation rows x 1/4,
+    # translation + log-scale rows unchanged
+    np.testing.assert_allclose(np.diag(g.info[0]),
+                               [0.25, 0.25, 0.25, 1, 1, 1, 1],
+                               atol=1e-12)
+
+
+def test_sim3_roundtrip_through_writer():
+    from megba_tpu.factors.sim3 import make_synthetic_sim3_graph
+
+    s = make_synthetic_sim3_graph(num_poses=8, loop_closures=2, seed=4)
+    rng = np.random.default_rng(0)
+    m = rng.normal(size=(len(s.edge_i), 7, 7))
+    info = m @ np.swapaxes(m, 1, 2) + 7 * np.eye(7)
+    g = G2OGraph(poses=s.poses0, edge_i=s.edge_i, edge_j=s.edge_j,
+                 meas=s.meas, info=info,
+                 fixed=np.eye(1, 8, 0, dtype=bool)[0],
+                 ids=np.arange(8, dtype=np.int64), sim3=True,
+                 had_fix=True)
+    buf = io.StringIO()
+    write_g2o(buf, g)
+    g2 = read_g2o(io.StringIO(buf.getvalue()))
+    assert g2.sim3 and g2.had_fix and g2.fixed[0]
+    np.testing.assert_allclose(g2.poses, g.poses, atol=1e-7)
+    np.testing.assert_allclose(g2.meas, g.meas, atol=1e-7)
+    np.testing.assert_allclose(g2.info, g.info, rtol=1e-6, atol=1e-6)
+
+
+def test_sim3_info_permutation_involution():
+    from megba_tpu.io.g2o import _info7_g2o_to_ours, _info7_ours_to_g2o
+
+    rng = np.random.default_rng(1)
+    m = rng.normal(size=(5, 7, 7))
+    info = m @ np.swapaxes(m, 1, 2)
+    np.testing.assert_allclose(
+        _info7_ours_to_g2o(_info7_g2o_to_ours(info)), info, atol=1e-12)
+
+
+def test_sim3_adversarial_records():
+    # token counts, with line numbers
+    with pytest.raises(ValueError, match="line 1: VERTEX_SIM3:QUAT needs"):
+        read_g2o(io.StringIO("VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1\n"))
+    with pytest.raises(ValueError, match="line 2: EDGE_SIM3:QUAT needs"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+            "EDGE_SIM3:QUAT 0 0 1 2 3\n"))
+    # non-positive scales (vertex and edge)
+    with pytest.raises(ValueError, match="non-positive scale"):
+        read_g2o(io.StringIO("VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 -2\n"))
+    with pytest.raises(ValueError, match="line 3: .*non-positive scale"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+            "VERTEX_SIM3:QUAT 1 0 0 0 0 0 0 1 1\n"
+            "EDGE_SIM3:QUAT 0 1 0 0 0 0 0 0 1 0 " + _DIAG28 + "\n"))
+    # duplicate vertex, unknown vertex, non-finite
+    with pytest.raises(ValueError, match="line 2: duplicate VERTEX"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"))
+    with pytest.raises(ValueError, match="unknown vertex 9"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+            "EDGE_SIM3:QUAT 0 9 1 0 0 0 0 0 1 1 " + _DIAG28 + "\n"))
+    with pytest.raises(ValueError, match="non-finite"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 inf 0 0 0 1 1\n"))
+
+
+def test_sim3_mixing_with_se3_refused_both_orders():
+    with pytest.raises(ValueError, match="line 2: .*cannot be mixed"):
+        read_g2o(io.StringIO(
+            "VERTEX_SE3:QUAT 0 0 0 0 0 0 0 1\n"
+            "VERTEX_SIM3:QUAT 1 0 0 0 0 0 0 1 1\n"))
+    with pytest.raises(ValueError, match="line 2: .*cannot be mixed"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+            "VERTEX_SE2 1 0 0 0\n"))
+    with pytest.raises(ValueError, match="line 2: .*cannot be mixed"):
+        read_g2o(io.StringIO(
+            "VERTEX_SIM3:QUAT 0 0 0 0 0 0 0 1 1\n"
+            "EDGE_SE3_PRIOR 0 0 0 0 0 0 0 1 " + _DIAG21 + "\n"))
+
+
+def test_sim3_solve_dispatch_guards():
+    """SE(3)-only conveniences are refused typed on sim(3) graphs
+    (host-side, before anything compiles)."""
+    g = read_g2o(_sim3_file())
+    with pytest.raises(ValueError, match="not supported for .*sim"):
+        solve_g2o(g, _option(), prior_ids=[0])
+    with pytest.raises(ValueError, match="spanning_tree.*not supported"):
+        solve_g2o(g, _option(), init="spanning_tree")
+
+
+@pytest.mark.slow
+def test_solve_g2o_sim3_end_to_end():
+    """A drifted sim(3) file solves through the sim3_between factor to
+    (near-)zero cost with the scale trail recovered."""
+    from megba_tpu.factors.sim3 import make_synthetic_sim3_graph
+
+    s = make_synthetic_sim3_graph(num_poses=16, loop_closures=5, seed=2)
+    n_e = len(s.edge_i)
+    g = G2OGraph(poses=s.poses0, edge_i=s.edge_i, edge_j=s.edge_j,
+                 meas=s.meas, info=np.tile(np.eye(7), (n_e, 1, 1)),
+                 fixed=np.eye(1, 16, 0, dtype=bool)[0],
+                 ids=np.arange(16, dtype=np.int64), sim3=True)
+    buf = io.StringIO()
+    write_g2o(buf, g)
+    g2 = read_g2o(io.StringIO(buf.getvalue()))
+    graph, res = solve_g2o(g2, _option(max_iter=25))
+    assert graph.sim3
+    assert float(res.cost) < 1e-6
+    np.testing.assert_allclose(np.asarray(res.poses)[:, 6],
+                               s.poses_gt[:, 6] - s.poses_gt[0, 6],
+                               atol=1e-3)

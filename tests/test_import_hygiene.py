@@ -23,6 +23,7 @@ def test_import_touches_no_backend():
         "import megba_tpu.robustness, megba_tpu.robustness.faults\n"
         "import megba_tpu.robustness.harness\n"
         "import megba_tpu.robustness.elastic\n"
+        "import megba_tpu.factors, megba_tpu.utils.memo\n"
         "from jax._src import xla_bridge\n"
         "assert not xla_bridge.backends_are_initialized(), 'import initialized a backend'\n"
         "print('clean')\n"

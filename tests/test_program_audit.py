@@ -28,11 +28,25 @@ from megba_tpu.analysis import hlo, program_audit
 from megba_tpu.parallel.mesh import EDGE_AXIS, make_mesh, shard_map
 
 
+# The factor-registry canonical programs (ISSUE 13) ride the SLOW lane
+# here: tier-1 sits ~90s from its budget and each extra program costs a
+# trace + parse even with the compile cache warm.  They are still
+# audited on every full run — by scripts/lint.sh gate 4 (audit --check
+# covers ALL programs) and by the slow-marked test below.
+FACTOR_PROGRAMS = frozenset({
+    "ba_rig_single_f32", "ba_radial_single_f32",
+    "prior_single_f64", "pgo_sim3_single_f64",
+})
+
+
 @pytest.fixture(scope="module")
 def audits():
-    """All canonical programs, lowered + compiled once per test module
-    (the persistent compile cache makes repeat runs cheap)."""
-    return program_audit.audit_all()
+    """The historical canonical programs, lowered + compiled once per
+    test module (the persistent compile cache makes repeat runs
+    cheap); the factor-registry programs audit in the slow lane."""
+    names = [n for n in program_audit.program_specs()
+             if n not in FACTOR_PROGRAMS]
+    return program_audit.audit_all(names)
 
 
 def _fake_spec(**kw):
@@ -64,6 +78,26 @@ def test_clean_tree_every_pass_green(audits):
 def test_clean_tree_matches_committed_budget(audits):
     baseline = budget_mod.load_baseline()
     assert baseline, "ANALYSIS_BUDGET.json missing — run audit --update"
+    # Tier-1 audits the historical set; the factor programs' baseline
+    # parity rides the slow test below + lint gate 4 (which always
+    # compares the FULL set, including the "no longer audited" check).
+    baseline = {n: v for n, v in baseline.items()
+                if n not in FACTOR_PROGRAMS}
+    measured = {n: a.metrics() for n, a in audits.items()}
+    assert budget_mod.compare(baseline, measured) == []
+
+
+@pytest.mark.slow
+def test_factor_programs_clean_and_on_budget():
+    """The factor-registry canonical programs (ISSUE 13): every audit
+    pass green and baseline parity, including the census expectations
+    (zero collectives single-device, clean dtype family, donation
+    materialised)."""
+    audits = program_audit.audit_all(sorted(FACTOR_PROGRAMS))
+    for name, audit in audits.items():
+        assert audit.violations() == [], name
+    baseline = {n: v for n, v in budget_mod.load_baseline().items()
+                if n in FACTOR_PROGRAMS}
     measured = {n: a.metrics() for n, a in audits.items()}
     assert budget_mod.compare(baseline, measured) == []
 
