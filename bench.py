@@ -122,6 +122,37 @@ if CONFIG not in CONFIGS:
 
 # MEGBA_BENCH_SCALE in (0, 1] shrinks the problem for smoke tests.
 _SCALE = float(os.environ.get("MEGBA_BENCH_SCALE", "1.0"))
+
+# MEGBA_BENCH_MESH2D=<ExC> (e.g. "2x2"): 2-D mesh head-to-head vs the
+# 1-D edge sharding at the same world size (mesh2d_head_to_head).  The
+# backend needs E*C devices; on the CPU lane that means forcing virtual
+# host devices BEFORE backend init, so the knob is resolved here.
+_MESH2D_SPEC = os.environ.get("MEGBA_BENCH_MESH2D", "")
+
+
+def _parse_mesh2d(spec: str):
+    try:
+        e, c = spec.lower().replace(" ", "").split("x")
+        e, c = int(e), int(c)
+    except ValueError:
+        raise SystemExit(
+            f"MEGBA_BENCH_MESH2D must look like '2x2', got {spec!r}")
+    if e < 1 or c < 1:
+        raise SystemExit(
+            f"MEGBA_BENCH_MESH2D needs positive factors, got {spec!r}")
+    return e, c
+
+
+if _MESH2D_SPEC:
+    _E2D, _C2D = _parse_mesh2d(_MESH2D_SPEC)
+    # Raise-to-floor, not append-if-absent: a pre-set LOWER count
+    # (persisted dev-shell/CI XLA_FLAGS) would otherwise silently skip
+    # the whole head-to-head.  Importing the audit module is safe here:
+    # it only touches XLA_FLAGS, and the backend has not initialised.
+    from megba_tpu.analysis.audit import ensure_host_device_floor
+
+    os.environ["XLA_FLAGS"] = ensure_host_device_floor(
+        os.environ.get("XLA_FLAGS", ""), _E2D * _C2D)
 _C = CONFIGS[CONFIG]
 NUM_CAMERAS = max(8, int(_C.cameras * _SCALE))
 NUM_POINTS = max(64, int(_C.points * _SCALE))
@@ -429,6 +460,122 @@ def federation_head_to_head(n_workers: int, dtype, timer) -> dict:
     artifact_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "BENCH_federation.json")
+    with open(artifact_path, "w") as fh:
+        json.dump(result, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return result
+
+
+def mesh2d_head_to_head(s, base_option, edge_shards, cam_blocks,
+                        timer) -> dict:
+    """2-D (edge_shards x cam_blocks) mesh vs 1-D edge sharding at the
+    SAME world size on the same scene (MEGBA_BENCH_MESH2D=<ExC>).
+
+    Records wall-clock (both sides warmed first), the static
+    bytes-moved-per-CG-step census of each compiled program (ring
+    model, analysis/hlo.collective_bytes_moved over the PCG-body
+    collectives — the same model the budget gate pins), the tile
+    geometry, and the co-observation plan's streaming reuse factor.
+    Results land in BENCH_mesh2d.json.
+
+    HONESTY TAG: this container's bench lane is CPU-only (~1.2 cores of
+    aggregate quota), where virtual-device collectives are memcpys —
+    wall-clock CANNOT show the ICI win here and usually shows 2-D
+    slightly slower (the tile loop adds launches).  The structural
+    bytes/census numbers are the transferable evidence; the wall-clock
+    is recorded so the CPU-lane overhead is known, not hidden.
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from megba_tpu.analysis import hlo as hlo_mod
+    from megba_tpu.analysis.program_audit import pcg_body_collective_summary
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.ops.segtiles import cached_camera_tile_plan
+    from megba_tpu.solve import flat_solve
+
+    world = edge_shards * cam_blocks
+    if len(jax.devices()) < world:
+        return {"skipped": f"need {world} devices, have "
+                           f"{len(jax.devices())}"}
+    f = make_residual_jacobian_fn(mode=base_option.jacobian_mode)
+
+    def opt_for(mesh2d: bool):
+        return _dc.replace(
+            base_option, world_size=world,
+            solver_option=_dc.replace(
+                base_option.solver_option, mesh_2d=mesh2d,
+                cam_blocks=cam_blocks if mesh2d else 0))
+
+    def run(label, mesh2d):
+        opt = opt_for(mesh2d)
+        kw = dict(use_tiled=False, timer=timer)
+        # Census FIRST: the lower_only compile primes the persistent
+        # cache, so the warm solve below pays the trace but not a
+        # second XLA compile (the census itself would otherwise be a
+        # third full compile-path round trip per side).
+        lowered = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                             s.pt_idx, opt, use_tiled=False,
+                             lower_only=True)
+        ops = hlo_mod.parse_compiled_ops(lowered.compile().as_text())
+        with timer.phase(f"mesh2d_warm_{label}"):
+            flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                       s.pt_idx, opt, **kw)
+        t0 = time.perf_counter()
+        with timer.phase(f"mesh2d_solve_{label}"):
+            res = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                             s.pt_idx, opt, **kw)
+            # Dispatch is async: without this the cheaper side can
+            # report its enqueue time, not its solve time.
+            jax.block_until_ready(res)
+        elapsed = time.perf_counter() - t0
+        body, census, bytes_moved = pcg_body_collective_summary(ops, world)
+        return res, {
+            "elapsed_s": round(elapsed, 3),
+            "lm_iters": int(res.iterations),
+            "pcg_iters": int(res.pcg_iterations),
+            "collective_bytes_per_sp": round(bytes_moved, 1),
+            "pcg_body_census": census,
+            "pcg_body_group_sizes": sorted(
+                {op.group_size(world) or world for op in body}),
+        }
+
+    res1, side1 = run("1d", mesh2d=False)
+    res2, side2 = run("2d", mesh2d=True)
+    # Cache hit by construction: the 2-D flat_solve above planned the
+    # identical geometry through the same fingerprint LRU.
+    (plan, _), _ = cached_camera_tile_plan(
+        s.cam_idx, s.pt_idx, len(s.cameras0), len(s.points0),
+        edge_shards, cam_blocks)
+    rel_gap = abs(float(res2.cost) - float(res1.cost)) / max(
+        float(res1.cost), 1e-30)
+    result = {
+        "lane": f"CPU fallback ({jax.default_backend()}); wall-clock "
+                "shows the tile-loop overhead, NOT the ICI overlap win "
+                "— the bytes/census axes are the transferable evidence",
+        "mesh": f"{edge_shards}x{cam_blocks}",
+        "world_size": world,
+        "scene": {"cameras": len(s.cameras0), "points": len(s.points0),
+                  "edges": int(s.obs.shape[0])},
+        "one_d": side1,
+        "two_d": side2,
+        "bytes_per_sp_ratio_2d_vs_1d": round(
+            side2["collective_bytes_per_sp"]
+            / max(side1["collective_bytes_per_sp"], 1e-30), 4),
+        "tile_plan": {
+            "cam_blocks": plan.cam_blocks,
+            "tile_cams": plan.tile_cams,
+            "shard_points": plan.shard_points,
+            "tiles_per_matvec": plan.cam_blocks,  # the C-step loop
+            "edges_padded": plan.n_edges_padded,
+            "bucket_width": plan.bucket_width,
+            "reuse": plan.reuse,
+        },
+        "final_cost_rel_gap": rel_gap,
+    }
+    artifact_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_mesh2d.json")
     with open(artifact_path, "w") as fh:
         json.dump(result, fh, indent=1, sort_keys=True)
         fh.write("\n")
@@ -774,6 +921,14 @@ def main() -> None:
     n_fed = int(os.environ.get("MEGBA_BENCH_FEDERATION", "0") or "0")
     if n_fed:
         federation_cmp = federation_head_to_head(n_fed, dtype, timer)
+    # 2-D mesh head-to-head (MEGBA_BENCH_MESH2D=<ExC>): the 2-D
+    # camera x edge distribution vs 1-D edge sharding at the same world
+    # size — bytes-moved per CG step, subgroup census, tile/reuse
+    # geometry, and (CPU-lane-tagged) wall-clock.  Also written to
+    # BENCH_mesh2d.json as a standalone artifact.
+    mesh2d_cmp = None
+    if _MESH2D_SPEC:
+        mesh2d_cmp = mesh2d_head_to_head(s, option, _E2D, _C2D, timer)
     # Charge the reference model the S·p products this run actually
     # executed (the PCG can exit below the 30-iteration cap), so both
     # sides of vs_baseline do the same algorithmic work.  The fused
@@ -892,6 +1047,10 @@ def main() -> None:
                     # router vs single-host FleetQueue + cold-start
                     # split; also lands in BENCH_federation.json.
                     "federation": federation_cmp,
+                    # 2-D mesh head-to-head (MEGBA_BENCH_MESH2D=<ExC>):
+                    # subgroup-collective bytes-moved + tile/reuse
+                    # geometry vs 1-D; also lands in BENCH_mesh2d.json.
+                    "mesh2d": mesh2d_cmp,
                     # Per-phase wall clocks (compile vs solve, per pass)
                     # so BENCH_*.json artifacts carry phase timings.
                     "phases": {

@@ -165,6 +165,7 @@ def lm_solve(
     initial_dx=None,
     fault_plan=None,
     cluster_plan=None,
+    tile_plan=None,
 ) -> LMResult:
     """Run the LM loop to convergence.  Jit/shard_map-compatible.
 
@@ -211,6 +212,13 @@ def lm_solve(
     MULTILEVEL, ignored otherwise — the flat_solve lowering threads it
     automatically.
 
+    `tile_plan` (ops/segtiles.DeviceCameraTilePlan) arms the 2-D mesh
+    matvec (solver/pcg.make_matvec_2d): `axis_name` must then be the
+    (EDGE_AXIS, CAM_AXIS) tuple — every existing psum site reduces over
+    the tuple (the whole world) unchanged, while the PCG body's matvec
+    runs the subgroup-scoped tiled pipeline.  The flat_solve 2-D
+    lowering threads it automatically; ignored on the 1-D mesh.
+
     `fault_plan` (robustness.faults.FaultPlan, edge_nan already in this
     call's edge order) injects deterministic faults at the residual /
     linear-system boundary — the CI harness for the RobustOption guards.
@@ -232,6 +240,14 @@ def lm_solve(
     note_trace("algo.lm_solve", cameras, points, obs, cam_idx, pt_idx,
                static=static_key(residual_jac_fn, option, axis_name,
                                  verbose, cam_sorted))
+    if tile_plan is not None and not (
+            isinstance(axis_name, (tuple, list)) and len(axis_name) == 2):
+        # The 2-D tiled matvec needs the (EDGE_AXIS, CAM_AXIS) tuple to
+        # scope its subgroup collectives; on a 1-D mesh (or single
+        # device) the plan is documented as ignored — dropping it here
+        # keeps that true instead of crashing in make_matvec_2d's
+        # axis-tuple unpack.
+        tile_plan = None
     num_cameras = cameras.shape[1]
     num_points = points.shape[1]
     algo_opt = option.algo_option
@@ -382,7 +398,8 @@ def lm_solve(
                 precond=solver_opt.precond,
                 neumann_order=solver_opt.neumann_order,
                 cluster_plan=cluster_plan, cam_fixed=cam_fixed,
-                smooth_omega=solver_opt.smooth_omega)
+                smooth_omega=solver_opt.smooth_omega,
+                tile_plan=tile_plan)
         dx_cam, dx_pt = pcg.dx_cam, pcg.dx_pt
 
         # ||dx|| <= eps2 (||x|| + eps1)  -> converged, don't apply

@@ -14,12 +14,35 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 from typing import Optional, Sequence
 
 
+_DEVICE_COUNT_RE = re.compile(
+    r"--?xla_force_host_platform_device_count=(\d+)")
+
+
+def ensure_host_device_floor(flags: str, floor: int) -> str:
+    """XLA_FLAGS with `--xla_force_host_platform_device_count` raised
+    to at least `floor`: appended when absent, rewritten when a pre-set
+    value is lower (e.g. the 2 this module exported before ba_2d_w4_f32
+    existed, persisted in a dev shell or CI env), left alone when
+    already sufficient.  Shared with bench.py's MEGBA_BENCH_MESH2D
+    knob, which needs the same raise-to-floor before backend init."""
+    m = _DEVICE_COUNT_RE.search(flags)
+    if m is None:
+        return (flags +
+                f" --xla_force_host_platform_device_count={floor}").strip()
+    if int(m.group(1)) < floor:
+        return (flags[:m.start()] +
+                f"--xla_force_host_platform_device_count={floor}" +
+                flags[m.end():])
+    return flags
+
+
 def _ensure_cpu_env() -> None:
-    """Pin the audit to the CPU backend with >= 2 virtual devices.
+    """Pin the audit to the CPU backend with >= 4 virtual devices.
 
     jax is typically already *imported* here (the package __init__ pulls
     it), but the backend initialises lazily at the first device query:
@@ -35,10 +58,10 @@ def _ensure_cpu_env() -> None:
             return  # backend already up; caller's device config rules
     except Exception:
         pass
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2").strip()
+    # 4 devices: the 2-D canonical program (ba_2d_w4_f32) lowers on a
+    # 2x2 mesh; the w2 programs use the first two.
+    os.environ["XLA_FLAGS"] = ensure_host_device_floor(
+        os.environ.get("XLA_FLAGS", ""), 4)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -94,11 +117,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         bad = audit.violations()
         measured[name] = audit.metrics()
         status = "FAIL" if bad else "ok"
-        pcg = len(audit.pcg_body_collectives())
+        census = audit.pcg_body_kind_census()
+        pcg = census.get("all_reduce", 0)
+        extra = {k: v for k, v in census.items() if k != "all_reduce"}
+        extra_s = f", pcg_body_extra={extra}" if extra else ""
         print(f"[audit] {name}: {status} "
               f"(flops={audit.flops:.3g}, bytes={audit.bytes_accessed:.3g}, "
               f"temp={audit.peak_temp_bytes:.3g}, "
-              f"pcg_body_all_reduces={pcg})")
+              f"pcg_body_all_reduces={pcg}, "
+              f"bytes_per_sp={measured[name]['collective_bytes_per_sp']:g}"
+              f"{extra_s})")
         failures.extend(bad)
         if args.summary:
             import json

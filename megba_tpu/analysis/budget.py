@@ -33,6 +33,14 @@ TOLERANCES: Dict[str, float] = {
     "output_bytes": 0.15,
     "all_reduce_count": 0.0,
     "other_collective_count": 0.0,
+    # Ring-model bytes each device moves per CG step (PCG-body
+    # collectives: operand bytes x replica-group shape —
+    # analysis/hlo.collective_bytes_moved).  Exact: communication
+    # volume is discrete, and a fatter (or world-scoped) collective
+    # inside the body IS the regression this axis exists to catch;
+    # an overlap/subgroup win re-baselines with --update and is
+    # thereby pinned.
+    "collective_bytes_per_sp": 0.0,
 }
 
 

@@ -57,11 +57,57 @@ class HloOp:
     op_name: Optional[str] = None  # compiled-HLO metadata scope path
     result_dtype: Optional[str] = None
     result_elems: Optional[int] = None
+    # Total result payload in bytes across ALL tuple components (None
+    # when unknown): combined collectives (AllReduceCombiner) price the
+    # SUM of their component tensors, async -start forms the LARGEST
+    # component (their tuples alias the operand beside the output, plus
+    # negligible context scalars).  result_dtype/result_elems keep the
+    # first component only.
+    result_bytes: Optional[float] = None
+    # Collective replica groups from the compiled HLO (None when the op
+    # carries none / the form was not recognised): a tuple of
+    # device-id tuples.  collective_permute carries its
+    # source_target_pairs here instead (pairs, not groups).
+    replica_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     def where(self) -> str:
         scope = f" [{self.op_name}]" if self.op_name else ""
         tgt = f" @{self.target}" if self.target else ""
         return f"line {self.line}: {self.kind}{tgt}{scope}"
+
+    def group_size(self, world: Optional[int] = None) -> Optional[int]:
+        """Largest replica-group size.  collective_permute carries
+        source->target pairs, not groups: the devices a permute spans
+        are the largest weakly-connected component of its pair graph
+        (a ring over a subgroup of g devices is one g-cycle; an open
+        chain 0->1->2->3 still spans 4 devices — a cycle walk would
+        undercount it and mis-certify a world-spanning permute as
+        subgroup-scoped).  XLA's explicit empty form
+        `replica_groups={}` means ONE group spanning every device:
+        resolved to `world` when the caller supplies it (None
+        otherwise — absent metadata stays uncertifiable)."""
+        if not self.replica_groups:
+            return None
+        if self.replica_groups == ((),):
+            return int(world) if world else None
+        if self.kind == "collective_permute":
+            parent: dict = {}
+
+            def find(x):
+                parent.setdefault(x, x)
+                while parent[x] != x:
+                    parent[x] = parent[parent[x]]
+                    x = parent[x]
+                return x
+
+            for a, b in self.replica_groups:
+                parent[find(a)] = find(b)
+            sizes: dict = {}
+            for x in parent:
+                r = find(x)
+                sizes[r] = sizes.get(r, 0) + 1
+            return max(sizes.values()) if sizes else 1
+        return max(len(g) for g in self.replica_groups)
 
 
 _STRING_RE = re.compile(r'"[^"]*"')
@@ -145,6 +191,123 @@ def _stablehlo_result(line: str) -> Tuple[Optional[str], Optional[int]]:
     return dtype, _dims_elems(dims)
 
 
+# Replica groups on compiled collectives.  Two textual forms exist:
+# the explicit list `replica_groups={{0,1},{2,3}}` and the iota form
+# `replica_groups=[2,2]<=[4]` (optionally `[2,2]<=[2,2]T(1,0)`: iota
+# over the <= dims, transposed by T's permutation, then reshaped to
+# [num_groups, group_size]).  collective_permute carries
+# `source_target_pairs={{0,1},{1,0}}` instead.
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{((?:\{[\d,]*\},?)*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[\d,]*\},?)*)\}")
+_GROUP_RE = re.compile(r"\{([\d,]*)\}")
+
+
+def _parse_groups(raw: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    """Replica groups (or permute pairs) of one compiled-HLO op line."""
+    m = _PAIRS_RE.search(raw)
+    if m:
+        return tuple(
+            tuple(int(x) for x in g.group(1).split(",") if x != "")
+            for g in _GROUP_RE.finditer(m.group(1)))
+    m = _GROUPS_LIST_RE.search(raw)
+    if m:
+        groups = tuple(
+            tuple(int(x) for x in g.group(1).split(",") if x != "")
+            for g in _GROUP_RE.finditer(m.group(1)))
+        # XLA's explicit empty form `replica_groups={}` is ONE group
+        # over all devices (world scope), kept as the ((),) marker so
+        # group_size(world=...) can resolve it — distinct from
+        # replica_groups=None (no metadata at all).
+        return groups or ((),)
+    m = _GROUPS_IOTA_RE.search(raw)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        total = 1
+        for d in dims:
+            total *= d
+        ids = list(range(total))
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            # Transpose the iota over `dims` by `perm`, then flatten.
+            strides = [0] * len(dims)
+            acc = 1
+            for i in range(len(dims) - 1, -1, -1):
+                strides[i] = acc
+                acc *= dims[i]
+            tdims = [dims[p] for p in perm]
+            tstrides = [strides[p] for p in perm]
+            out = []
+
+            def rec(i, off):
+                if i == len(tdims):
+                    out.append(off)
+                    return
+                for k in range(tdims[i]):
+                    rec(i + 1, off + k * tstrides[i])
+
+            rec(0, 0)
+            ids = out
+        if n_groups * group_size != total:
+            return None
+        return tuple(
+            tuple(ids[g * group_size:(g + 1) * group_size])
+            for g in range(n_groups))
+    return None
+
+
+# Tensor element sizes (bytes) for the collective byte model.
+DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4,
+    "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+    "c64": 8, "c128": 16,
+}
+
+
+def collective_bytes_moved(op: HloOp, world: int) -> float:
+    """Ring-model bytes moved PER DEVICE by one collective op.
+
+    The standard bandwidth-optimal ring costs, in operand bytes B and
+    replica-group size g (defaulting to `world` when the op carries no
+    groups):
+
+      all_reduce          2 B (g-1)/g     (reduce-scatter + all-gather)
+      reduce_scatter      B_out (g-1)     (input = B_out * g)
+      all_gather          B_out (g-1)/g
+      all_to_all          B (g-1)/g
+      collective_permute  B               (every device sends its block)
+
+    `op.result_bytes` supplies B from the op's FULL result payload
+    (tuple components summed for combined collectives, largest for
+    async -start forms); ops parsed without it fall back to
+    `result_elems` x `result_dtype` (first component — exact for every
+    single-tensor result).  For reduce_scatter the result is the 1/g
+    shard, hence the (g-1) factor against B_out.  Unknown kinds/dtypes
+    cost 0 — the census still counts them, so a new kind can never
+    silently pass the exact count gates while being mis-priced here.
+    """
+    if op.result_bytes is not None:
+        b = op.result_bytes
+    elif op.result_elems is not None:
+        b = float(op.result_elems) * DTYPE_BYTES.get(op.result_dtype or "", 0)
+    else:
+        return 0.0
+    g = op.group_size(world) or max(int(world), 1)
+    g = max(g, 1)
+    if op.kind == "all_reduce":
+        return 2.0 * b * (g - 1) / g
+    if op.kind == "reduce_scatter":
+        return b * (g - 1)
+    if op.kind in ("all_gather", "all_to_all", "collective_broadcast"):
+        return b * (g - 1) / g
+    if op.kind == "collective_permute":
+        return b
+    return 0.0
+
+
 # Optimized-HLO op definitions: `%name = f32[9,24]{1,0} all-reduce(...)`.
 # The result may be a TUPLE type `(f32[..]{..}, s32[..]{..})` — XLA's
 # AllReduceCombiner emits combined collectives in exactly that form, so
@@ -171,18 +334,35 @@ def parse_compiled_ops(text: str) -> List[HloOp]:
             kind_base = kind[:-5]
             if kind_base in COLLECTIVE_KINDS:
                 continue
-        if kind.endswith("_start"):
+        is_async = kind.endswith("_start")
+        if is_async:
             kind = kind[:-6]
         tm = _HLO_TYPE_RE.search(m.group(1))
         rd = tm.group(1) if tm else None
         re_ = _dims_elems(tm.group(2).replace(",", "x")) if tm else None
+        # Per-component payload over the whole (possibly tuple) result:
+        # a combined collective's components are independent outputs
+        # (sum them); an async -start tuple aliases the operand beside
+        # the output plus tiny context scalars (largest component is
+        # the payload for every dedicated -start form: all-reduce and
+        # collective-permute move input-sized blocks, all-gather's
+        # output dominates its input shard).
+        comp = [_dims_elems(c.group(2).replace(",", "x")) *
+                DTYPE_BYTES.get(c.group(1), 0)
+                for c in _HLO_TYPE_RE.finditer(m.group(1))]
+        rb = None
+        if comp:
+            rb = float(max(comp) if is_async else sum(comp))
         nm = _OP_NAME_RE.search(raw)
         tg = _HLO_TARGET_RE.search(raw)
+        groups = (_parse_groups(raw)
+                  if kind in COLLECTIVE_KINDS else None)
         ops.append(HloOp(
             kind=kind, line=lineno, text=raw.strip()[:200],
             target=tg.group(1) if tg else None,
             op_name=nm.group(1) if nm else None,
-            result_dtype=rd, result_elems=re_))
+            result_dtype=rd, result_elems=re_, result_bytes=rb,
+            replica_groups=groups))
     return ops
 
 

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -49,6 +49,26 @@ from megba_tpu.analysis import hlo
 # Scope-path fragment that marks the PCG inner loop's body in compiled
 # op metadata (jax.named_scope "megba.pcg_core" + the while lowering).
 PCG_BODY_MARK = "megba.pcg_core/while/body"
+
+
+def pcg_body_collective_summary(
+    compiled_ops: Sequence[hlo.HloOp], world: int,
+) -> Tuple[List[hlo.HloOp], Dict[str, int], float]:
+    """PCG-body collectives of a compiled program: (ops, kind -> count
+    census, ring-model bytes moved per device per CG step).
+
+    The single body-mark filter + byte model behind both the
+    ProgramAudit.pcg_body_* passes and bench.py's mesh2d head-to-head,
+    so the bench census can never diverge from what the budget gate's
+    `collective_bytes_per_sp` axis pins."""
+    body = [op for op in hlo.collective_ops(compiled_ops)
+            if op.op_name and PCG_BODY_MARK in op.op_name]
+    census: Dict[str, int] = {}
+    for op in body:
+        census[op.kind] = census.get(op.kind, 0) + 1
+    bytes_moved = float(sum(
+        hlo.collective_bytes_moved(op, world) for op in body))
+    return body, census, bytes_moved
 
 # custom_call targets the observability layer is allowed to emit (the
 # sanctioned trace outputs).  The canonical audited programs are built
@@ -73,6 +93,20 @@ class ProgramSpec:
     pcg_psums: int  # all-reduces expected inside the PCG while body
     donate_leaves: Tuple[int, ...]  # flat params declared donated
     build: Callable[[], object]  # () -> jax.stages.Lowered
+    # Collective kinds this program may emit anywhere (psum lowers to
+    # all-reduce; the 2-D mesh programs additionally carry the
+    # subgroup-stage kinds).  Any other kind is a violation.
+    allowed_kinds: Tuple[str, ...] = ("all_reduce",)
+    # Exact kind -> count census of the PCG while BODY (one CG step),
+    # as (kind, count) pairs.  None = only the all-reduce count above
+    # is pinned (the historical 1-D contract, byte-identical).
+    pcg_body_census: Optional[Tuple[Tuple[str, int], ...]] = None
+    # When True, every collective inside the PCG body must be
+    # SUBGROUP-scoped: its replica groups (permute: its ring cycles)
+    # span strictly fewer than `world` devices.  The 2-D mesh's whole
+    # point — a world-wide reduce sneaking back into the body is
+    # exactly the regression this pins against.
+    pcg_subgroup_only: bool = False
 
 
 @dataclasses.dataclass
@@ -111,9 +145,24 @@ class ProgramAudit:
         ]
 
     # ---- pass 2: collective census -----------------------------------
+    @functools.cached_property
+    def _pcg_body_summary(self) -> Tuple[
+            List[hlo.HloOp], Dict[str, int], float]:
+        return pcg_body_collective_summary(
+            self.compiled_ops, self.spec.world)
+
     def pcg_body_collectives(self) -> List[hlo.HloOp]:
-        return [op for op in self.collectives
-                if op.op_name and PCG_BODY_MARK in op.op_name]
+        return self._pcg_body_summary[0]
+
+    def pcg_body_kind_census(self) -> Dict[str, int]:
+        """kind -> count of the collectives inside the PCG while body."""
+        return self._pcg_body_summary[1]
+
+    def pcg_body_collective_bytes(self) -> float:
+        """Ring-model bytes moved per device per CG step: the sum of
+        `hlo.collective_bytes_moved` over the PCG body's collectives —
+        the budget gate's `collective_bytes_per_sp` axis."""
+        return self._pcg_body_summary[2]
 
     def collective_violations(self) -> List[str]:
         out: List[str] = []
@@ -123,19 +172,49 @@ class ProgramAudit:
                     f"{self.spec.name}: collective in a single-device "
                     f"program — {op.where()}")
             return out
-        non_ar = [op for op in self.collectives if op.kind != "all_reduce"]
-        for op in non_ar:
+        allowed = frozenset(self.spec.allowed_kinds)
+        bad_kind = [op for op in self.collectives if op.kind not in allowed]
+        for op in bad_kind:
             out.append(
-                f"{self.spec.name}: unexpected collective kind (psum is "
-                f"the only prescribed sync) — {op.where()}")
+                f"{self.spec.name}: unexpected collective kind "
+                f"(allowed: {sorted(allowed)}) — {op.where()}")
         pcg = self.pcg_body_collectives()
-        if len(pcg) != self.spec.pcg_psums:
+        n_ar = sum(1 for op in pcg if op.kind == "all_reduce")
+        # Single source of truth for the all-reduce expectation: the
+        # full kind census when the spec pins one (the 2-D program),
+        # the scalar pcg_psums otherwise — never two hand-synced pins.
+        want_ar = (dict(self.spec.pcg_body_census).get("all_reduce", 0)
+                   if self.spec.pcg_body_census is not None
+                   else self.spec.pcg_psums)
+        if n_ar != want_ar:
             ops = "\n".join(f"    {op.where()}" for op in pcg) or "    (none)"
             out.append(
-                f"{self.spec.name}: {len(pcg)} all-reduce(s) inside the "
+                f"{self.spec.name}: {n_ar} all-reduce(s) inside the "
                 f"PCG while body, analytic expectation is "
-                f"{self.spec.pcg_psums} per CG step "
+                f"{want_ar} per CG step "
                 f"(MegBA per-iteration collective pattern):\n{ops}")
+        if self.spec.pcg_body_census is not None:
+            want = dict(self.spec.pcg_body_census)
+            got = self.pcg_body_kind_census()
+            if got != want:
+                out.append(
+                    f"{self.spec.name}: PCG-body collective census "
+                    f"{got} != pinned expectation {want} — the "
+                    "per-iteration communication pattern changed")
+        if self.spec.pcg_subgroup_only:
+            for op in pcg:
+                g = op.group_size(self.spec.world)
+                if g is None:
+                    out.append(
+                        f"{self.spec.name}: PCG-body collective carries "
+                        f"no parseable replica groups (cannot certify "
+                        f"subgroup scope) — {op.where()}")
+                elif g >= self.spec.world:
+                    out.append(
+                        f"{self.spec.name}: WORLD-spanning collective "
+                        f"(group size {g} of world {self.spec.world}) "
+                        f"inside the PCG body — the 2-D mesh contract "
+                        f"is subgroup-scoped stages — {op.where()}")
         return out
 
     # ---- pass 3: dtype census + donation -----------------------------
@@ -191,6 +270,13 @@ class ProgramAudit:
         out["all_reduce_count"] = float(
             sum(1 for op in self.collectives if op.kind == "all_reduce"))
         out["other_collective_count"] = float(len(other))
+        # Bytes-moved-per-iteration axis (ROADMAP item 3): ring-model
+        # bytes each device moves per CG step (the PCG body executes
+        # once per iteration), from per-op operand bytes x replica-group
+        # shape.  Exact-match gated (budget.TOLERANCES) so an overlap /
+        # subgroup win is PINNED, not anecdotal — and a fatter
+        # collective sneaking into the body fails audit --check.
+        out["collective_bytes_per_sp"] = self.pcg_body_collective_bytes()
         return out
 
     def violations(self) -> List[str]:
@@ -204,10 +290,15 @@ class ProgramAudit:
         return {
             "program": self.spec.name,
             "metrics": self.metrics(),
-            "pcg_body_all_reduces": len(pcg),
+            "pcg_body_all_reduces": sum(
+                1 for op in pcg if op.kind == "all_reduce"),
+            "pcg_body_census": self.pcg_body_kind_census(),
             "collectives": [
                 {"kind": op.kind, "elems": op.result_elems,
-                 "dtype": op.result_dtype, "scope": op.op_name}
+                 "dtype": op.result_dtype, "scope": op.op_name,
+                 "group_size": op.group_size(self.spec.world),
+                 "bytes_moved": hlo.collective_bytes_moved(
+                     op, self.spec.world)}
                 for op in self.collectives
             ],
             "violations": self.violations(),
@@ -252,7 +343,7 @@ def _ba_ml_problem():
 
 def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
               guarded: bool = False, twolevel: bool = False,
-              multilevel: bool = False):
+              multilevel: bool = False, mesh2d: bool = False):
     import dataclasses as _dc
 
     from megba_tpu.common import JacobianMode, RobustOption, SolverOption
@@ -263,6 +354,13 @@ def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
     option = _ba_option()
     if world > 1:
         option = _dc.replace(option, world_size=world)
+    if mesh2d:
+        # 2-D mesh canonical program: world 4 factored 2x2 — the
+        # subgroup-collective matvec pipeline on the SAME tiny problem
+        # as the 1-D programs, so the bytes-moved axis is comparable
+        # operand-for-operand.
+        option = _dc.replace(option, solver_option=_dc.replace(
+            option.solver_option, mesh_2d=True, cam_blocks=2))
     if forcing:
         # Inexact-LM canonical program: adaptive Eisenstat-Walker
         # forcing (eta_k a traced while-carry scalar) + warm starts.
@@ -465,6 +563,29 @@ def program_specs() -> Dict[str, ProgramSpec]:
             donate_leaves=_sharded_donation(),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     multilevel=True)),
+        "ba_2d_w4_f32": ProgramSpec(
+            name="ba_2d_w4_f32", float_family="f32", world=4,
+            # 2-D (2 edge shards x 2 camera blocks) mesh: the matvec's
+            # two WORLD all-reduces become subgroup stages — one
+            # psum_scatter over the camera subgroup + one edge-subgroup
+            # psum on the point side, C-1 double-buffered
+            # collective_permutes rotating the point shard, and one
+            # edge-subgroup psum + camera-subgroup all_gather on the
+            # camera side.  Exactly 2 all-reduces remain in the body
+            # (both EDGE-subgroup), every body collective is pinned
+            # subgroup-scoped (group size 2 < world 4), and the
+            # bytes-moved axis must come in strictly below the 1-D
+            # all-reduce scaling law (tests/test_program_audit.py
+            # asserts the comparison against ba_sharded_w2_f32's law).
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            allowed_kinds=("all_reduce", "reduce_scatter", "all_gather",
+                           "collective_permute"),
+            pcg_body_census=(("all_reduce", 2), ("reduce_scatter", 1),
+                             ("all_gather", 1), ("collective_permute", 1)),
+            pcg_subgroup_only=True,
+            build=lambda: _lower_ba(world=4, use_tiled=False,
+                                    mesh2d=True)),
         "ba_batched_b4_f32": ProgramSpec(
             name="ba_batched_b4_f32", float_family="f32", world=1,
             # The batched program is a vmap over a LANE axis on one

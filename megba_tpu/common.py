@@ -141,6 +141,27 @@ class PrecondKind(enum.Enum):
     MULTILEVEL = 3
 
 
+class EdgeOrder(enum.Enum):
+    """Host-side edge-stream ordering applied before lowering.
+
+    NATURAL keeps the caller's edge order (modulo the camera sort the
+    lowering always performs) — byte-identical to every pre-existing
+    program.  COOBS applies the PI-BA co-observation ordering (arXiv
+    1905.02373): edges sorted camera-major, point-minor, so edges that
+    share a camera are contiguous and, within a camera, edges touching
+    nearby points cluster — each gathered camera/point tile is fully
+    consumed before the stream moves on.  Purely a host permutation of
+    the operands (sums reorder, so results agree at solver tolerance,
+    not bitwise); the tiled paths' reuse factor strictly improves on
+    locality-structured scenes (ops/segtiles.edge_stream_reuse).  The
+    2-D mesh lowering applies this ordering inside its own tile plan
+    unconditionally; the knob exposes it to the 1-D paths too.
+    """
+
+    NATURAL = 0
+    COOBS = 1
+
+
 class PreconditionerKind(enum.Enum):
     """Block-Jacobi preconditioner for the Schur PCG.
 
@@ -306,6 +327,25 @@ class SolverOption:
     # cluster-poor (expander-like) camera graphs.  Conventional range
     # (0, 1); ~2/3 is the classical damped-Jacobi choice.
     smooth_omega: float = 0.0
+    # 2-D mesh distribution (parallel/mesh.make_mesh_2d): world_size
+    # factors into edge_shards x cam_blocks (edge_shards = world_size /
+    # cam_blocks), cameras are tiled into cam_blocks contiguous blocks,
+    # and the Schur matvec's two world-wide all-reduces become
+    # subgroup-scoped stages — a psum over the edge subgroup plus a
+    # psum_scatter/all-gather pair over the camera subgroup, with the
+    # per-tile point-shard transfer double-buffered against the tile
+    # contraction (solver/pcg.make_matvec_2d).  OFF by default: the 1-D
+    # path is untouched by construction (every existing program lowers
+    # byte-identically).  `cam_blocks` must divide world_size; 0 = auto
+    # (largest divisor <= sqrt(world_size) — square-ish meshes keep both
+    # subgroups small).  Schur path only; world_size == 1 ignores it.
+    mesh_2d: bool = False
+    cam_blocks: int = 0
+    # Host edge-stream ordering (EdgeOrder): NATURAL = byte-identical
+    # legacy order; COOBS = PI-BA co-observation ordering for the 1-D
+    # paths (the 2-D plan orders its streams co-observation-first
+    # regardless).
+    edge_order: EdgeOrder = EdgeOrder.NATURAL
 
 
 @dataclasses.dataclass(frozen=True)
@@ -441,6 +481,24 @@ def validate_options(option: ProblemOption) -> None:
             "smooth_omega smooths the camera-graph coarse space; it "
             "requires precond=TWO_LEVEL or MULTILEVEL, got "
             f"{option.solver_option.precond.name}")
+    if option.solver_option.cam_blocks < 0:
+        raise ValueError(
+            f"cam_blocks must be >= 0 (0 = auto factorisation), got "
+            f"{option.solver_option.cam_blocks}")
+    if option.solver_option.mesh_2d:
+        if not option.use_schur:
+            raise ValueError(
+                "mesh_2d is only implemented for the Schur solver "
+                "(use_schur=True); the plain full-system path has no "
+                "camera-tiled matvec")
+        cb = option.solver_option.cam_blocks
+        if cb > 0 and (cb > option.world_size
+                       or option.world_size % cb != 0):
+            raise ValueError(
+                f"mesh_2d needs world_size = edge_shards x cam_blocks: "
+                f"cam_blocks={cb} does not divide "
+                f"world_size={option.world_size} (pick a divisor, or 0 "
+                "for the automatic square-ish factorisation)")
     if (not option.use_schur
             and option.solver_option.precond != PrecondKind.JACOBI):
         raise ValueError(

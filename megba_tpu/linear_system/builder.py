@@ -162,9 +162,14 @@ def build_schur_system(
     matmuls).  Without plans, the chunked scatter-add path runs (CPU /
     f64 / sharded mesh).
 
-    `axis_name`: mesh axis to psum over when the edge axis is sharded
+    `axis_name`: mesh axis (or, on the 2-D camera x edge mesh, the
+    (EDGE_AXIS, CAM_AXIS) tuple — `jax.lax.psum` over the tuple reduces
+    over the whole world) to psum over when the edge axis is sharded
     (the reference's ncclAllReduce of Hpp/Hll/g,
-    build_linear_system.cu:403-422); None on a single device.
+    build_linear_system.cu:403-422); None on a single device.  The
+    build runs once per LINEARISATION, so these stay whole-world
+    reductions on both mesh shapes — only the per-PCG-iteration matvec
+    pays for subgroup scoping (solver/pcg.make_matvec_2d).
     `cam_fixed` / `pt_fixed`: optional bool masks; fixed vertices get an
     identity Hessian block and zero gradient so their update is exactly
     zero.

@@ -75,8 +75,11 @@ def emit_verbose_iteration(token, k, cost, accept, pcg_iters,
     if axis_name is None:
         _print(args)
     else:
-        jax.lax.cond(jax.lax.axis_index(axis_name) == 0, _print,
-                     lambda _: None, args)
+        # `axis_name` may be a tuple (the 2-D mesh passes both axes);
+        # shard (0, ..., 0) is the single emitter either way.
+        names = (axis_name,) if isinstance(axis_name, str) else axis_name
+        is_zero = sum(jax.lax.axis_index(n) for n in names) == 0
+        jax.lax.cond(is_zero, _print, lambda _: None, args)
 
 
 def emit_problem_stats(num_cameras, num_points, num_observations,

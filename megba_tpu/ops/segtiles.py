@@ -1208,21 +1208,27 @@ def device_cluster_plan(plan: ClusterPlan) -> DeviceClusterPlan:
     )
 
 
-def cluster_partition_specs(cplan: DeviceClusterPlan):
+def cluster_partition_specs(cplan: DeviceClusterPlan, edge_spec=None):
     """shard_map in_specs tree for a DeviceClusterPlan operand: the
     per-edge `pc_slot` stream and the per-pair ec arrays follow the
     edge shards (the plan builder laid the pairs out in equal-length
     shard groups with shard-local edge ids); the cluster table and
     incidence tables ride replicated (the coarse assembly after the V/G
-    psums is identical tiny work per shard)."""
+    psums is identical tiny work per shard).  `edge_spec` overrides the
+    edge-following spec — the 2-D mesh passes
+    P((EDGE_AXIS, CAM_AXIS)), whose device-block order the plan's
+    world_size shard groups must match (parallel/mesh.py lays both out
+    edge-major, camera-minor)."""
     from jax.sharding import PartitionSpec as P
 
     from megba_tpu.parallel.mesh import EDGE_AXIS
 
+    if edge_spec is None:
+        edge_spec = P(EDGE_AXIS)
     return DeviceClusterPlan(
         num_clusters=cplan.num_clusters, n_pc=cplan.n_pc,
-        cluster=P(), pc_slot=P(EDGE_AXIS), pc_pt=P(),
-        ec_edge=P(EDGE_AXIS), ec_slot=P(EDGE_AXIS), ec_seg=P(EDGE_AXIS))
+        cluster=P(), pc_slot=edge_spec, pc_pt=P(),
+        ec_edge=edge_spec, ec_slot=edge_spec, ec_seg=edge_spec)
 
 
 def build_camera_clusters(
@@ -1473,7 +1479,7 @@ def device_multilevel_plan(plan: MultiLevelPlan) -> DeviceMultiLevelPlan:
     )
 
 
-def multilevel_partition_specs(mplan: DeviceMultiLevelPlan):
+def multilevel_partition_specs(mplan: DeviceMultiLevelPlan, edge_spec=None):
     """shard_map in_specs tree for a DeviceMultiLevelPlan operand: the
     level-1 plan follows `cluster_partition_specs`; the coarse
     assignment tables ride replicated (every level >= 2 is identical
@@ -1481,17 +1487,17 @@ def multilevel_partition_specs(mplan: DeviceMultiLevelPlan):
     from jax.sharding import PartitionSpec as P
 
     return DeviceMultiLevelPlan(
-        base=cluster_partition_specs(mplan.base),
+        base=cluster_partition_specs(mplan.base, edge_spec=edge_spec),
         level_sizes=mplan.level_sizes,
         assign=tuple(P() for _ in mplan.assign),
     )
 
 
-def coarse_plan_partition_specs(plan):
+def coarse_plan_partition_specs(plan, edge_spec=None):
     """Partition specs for either coarse-space plan operand kind."""
     if isinstance(plan, DeviceMultiLevelPlan):
-        return multilevel_partition_specs(plan)
-    return cluster_partition_specs(plan)
+        return multilevel_partition_specs(plan, edge_spec=edge_spec)
+    return cluster_partition_specs(plan, edge_spec=edge_spec)
 
 
 def build_multilevel_plan(
@@ -1574,6 +1580,309 @@ def cached_multilevel_plan(
         world_size=world_size, coarsen_factor=coarsen_factor,
         max_levels=max_levels)
     value = (plan, device_multilevel_plan(plan))
+    _plan_cache_put(key, value)
+    return value, False
+
+
+# ---------------------------------------------------------------------------
+# 2-D camera-tile plan (camera x edge mesh distribution)
+# ---------------------------------------------------------------------------
+#
+# The 2-D mesh lowering (parallel/mesh.make_mesh_2d + SolverOption.
+# mesh_2d) factors the world into edge_shards x cam_blocks and tiles the
+# camera range into cam_blocks contiguous blocks.  This plan is the host
+# half: it assigns every edge to the camera COLUMN owning its camera's
+# tile, orders each column's edges co-observation-first (PI-BA, arXiv
+# 1905.02373: camera-major, point-minor — each fetched point shard is
+# fully consumed before the stream moves to the next), pads columns to a
+# common quantum-aligned length, and lays the device blocks out
+# edge-major/camera-minor — exactly the block order a
+# P(None, (EDGE_AXIS, CAM_AXIS)) shard_map split produces.
+#
+# The device half additionally carries, per device, the point-SHARD
+# buckets of its local edges (slot/point-local/mask triples padded to a
+# common width): the double-buffered matvec tile loop
+# (solver/pcg.make_matvec_2d) contracts bucket s while the collective
+# fetching shard s+1 is already in flight, so the ICI transfer of the
+# next tile overlaps the MXU contraction of the current one.
+
+
+def coobservation_edge_order(cam_idx: np.ndarray,
+                             pt_idx: np.ndarray) -> np.ndarray:
+    """PI-BA co-observation-first edge permutation (camera-major,
+    point-minor, stable).
+
+    Edges sharing a camera become contiguous and, within one camera,
+    edges touching nearby points cluster — the ordering that maximises
+    tile reuse before any transfer (arXiv 1905.02373).  Pure host
+    argsort; applying it only reorders summation (results agree at
+    solver tolerance, never bitwise).
+    """
+    return np.lexsort((np.asarray(pt_idx), np.asarray(cam_idx)))
+
+
+def edge_stream_reuse(cam_idx: np.ndarray,
+                      pt_idx: np.ndarray,
+                      cam_tile: int,
+                      pt_tile: int,
+                      mask: Optional[np.ndarray] = None) -> dict:
+    """Streaming tile-reuse statistics of one edge order.
+
+    Model: a consumer walks the edge stream holding ONE (camera-tile,
+    point-tile) pair resident; every time a consecutive edge needs a
+    different pair it pays a tile transfer.  `switches` counts those
+    transitions (the first edge's fetch included), `reuse_factor` is
+    edges consumed per fetched pair — the quantity the co-observation
+    ordering (EdgeOrder.COOBS) strictly improves on locality-structured
+    scenes, and the honest denominator of the 2-D plan's "each gathered
+    tile fully consumed" claim.
+    """
+    cam_idx = np.asarray(cam_idx, np.int64)
+    pt_idx = np.asarray(pt_idx, np.int64)
+    if mask is not None:
+        keep = np.asarray(mask) > 0
+        cam_idx, pt_idx = cam_idx[keep], pt_idx[keep]
+    n = int(cam_idx.shape[0])
+    if n == 0:
+        return {"edges": 0, "switches": 0, "reuse_factor": 0.0}
+    key = (cam_idx // max(1, int(cam_tile)),
+           pt_idx // max(1, int(pt_tile)))
+    changed = (key[0][1:] != key[0][:-1]) | (key[1][1:] != key[1][:-1])
+    switches = int(changed.sum()) + 1  # first fetch counts
+    return {"edges": n, "switches": switches,
+            "reuse_factor": float(n) / float(switches)}
+
+
+@dataclasses.dataclass(frozen=True)
+class CameraTilePlan:
+    """Host half of the 2-D camera x edge distribution plan.
+
+    The padded edge stream (length `n_edges_padded` =
+    cam_blocks * column_len) is addressed THROUGH `perm`/`mask`:
+    position i of the stream carries caller edge `perm[i]` when
+    `mask[i] > 0` and inert padding otherwise.  Device block b of a
+    P((EDGE_AXIS, CAM_AXIS)) split (b = edge_shard * cam_blocks +
+    cam_block) is the contiguous slice [b*chunk, (b+1)*chunk) — the
+    plan lays columns out so that block b holds edge-shard
+    b // cam_blocks of camera column b % cam_blocks.
+    """
+
+    num_cameras: int
+    num_points: int
+    edge_shards: int  # E
+    cam_blocks: int  # C
+    tile_cams: int  # Tc: cameras per tile (C * Tc >= Nc)
+    shard_points: int  # Sp: points per shard (C * Sp >= Np)
+    n_edges_real: int
+    n_edges_padded: int
+    bucket_width: int  # Lb
+    perm: np.ndarray  # [nE_pad] int64 caller edge per stream slot
+    mask: np.ndarray  # [nE_pad] float64 1=real 0=padding
+    cam_idx: np.ndarray  # [nE_pad] int32 GLOBAL camera per slot
+    pt_idx: np.ndarray  # [nE_pad] int32 GLOBAL point per slot
+    cam_local: np.ndarray  # [nE_pad] int32 tile-LOCAL camera per slot
+    bucket_slot: np.ndarray  # [E*C*C, Lb] int32 device-local edge slot
+    bucket_ptl: np.ndarray  # [E*C*C, Lb] int32 shard-LOCAL point
+    bucket_mask: np.ndarray  # [E*C*C, Lb] int32 1=real pair
+    # Streaming-reuse statistics of the final stream (bench evidence).
+    reuse: dict
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceCameraTilePlan:
+    """Device half: static tile geometry + index streams, registered as
+    a pytree so the whole plan rides jit/shard_map as ONE operand."""
+
+    cam_blocks: int
+    tile_cams: int
+    shard_points: int
+    cam_local: jax.Array  # [nE] int32 (edge axis; device-local slice)
+    bucket_slot: jax.Array  # [C, Lb] int32 per device after the split
+    bucket_ptl: jax.Array  # [C, Lb] int32
+    bucket_mask: jax.Array  # [C, Lb] int32
+
+
+jax.tree_util.register_dataclass(
+    DeviceCameraTilePlan,
+    data_fields=["cam_local", "bucket_slot", "bucket_ptl", "bucket_mask"],
+    meta_fields=["cam_blocks", "tile_cams", "shard_points"],
+)
+
+
+def device_camera_tile_plan(plan: CameraTilePlan) -> DeviceCameraTilePlan:
+    return DeviceCameraTilePlan(
+        cam_blocks=plan.cam_blocks,
+        tile_cams=plan.tile_cams,
+        shard_points=plan.shard_points,
+        cam_local=jnp.asarray(plan.cam_local),
+        bucket_slot=jnp.asarray(plan.bucket_slot),
+        bucket_ptl=jnp.asarray(plan.bucket_ptl),
+        bucket_mask=jnp.asarray(plan.bucket_mask),
+    )
+
+
+def tile_plan_partition_specs(tplan: DeviceCameraTilePlan, edge_spec):
+    """shard_map in_specs tree for a DeviceCameraTilePlan operand: the
+    per-edge cam_local stream follows the 2-D edge split, and the
+    per-device bucket tables split the same way on their leading axis
+    (the builder stacked them in device-block order, cam_blocks rows
+    per device)."""
+    return DeviceCameraTilePlan(
+        cam_blocks=tplan.cam_blocks, tile_cams=tplan.tile_cams,
+        shard_points=tplan.shard_points, cam_local=edge_spec,
+        bucket_slot=edge_spec, bucket_ptl=edge_spec,
+        bucket_mask=edge_spec)
+
+
+def build_camera_tile_plan(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    edge_shards: int,
+    cam_blocks: int,
+    quantum: int = 0,
+) -> CameraTilePlan:
+    """Plan the 2-D camera x edge distribution over a caller edge set.
+
+    Edges land in the camera column owning their camera's tile
+    (contiguous tiles of `tile_cams = ceil(Nc / cam_blocks)` cameras),
+    ordered co-observation-first within each column, and every column
+    is padded to one common length — a multiple of
+    `edge_shards * quantum` (quantum defaults to core.fm.EDGE_QUANTUM,
+    matching the 1-D shard padding) — so each of the E*C device chunks
+    is equal-size and the chunked Schur build's slices stay
+    static-shape.  Padding slots repeat the column's LAST real camera
+    (keeping every per-device stream camera-sorted for the
+    indices_are_sorted scatter promise) and point 0, under mask 0.
+    """
+    from megba_tpu.core.fm import EDGE_QUANTUM
+
+    if quantum <= 0:
+        quantum = EDGE_QUANTUM
+    E = int(edge_shards)
+    C = int(cam_blocks)
+    if E < 1 or C < 1:
+        raise ValueError(
+            f"edge_shards and cam_blocks must be >= 1, got {E} x {C}")
+    cam_idx = np.asarray(cam_idx, np.int64)
+    pt_idx = np.asarray(pt_idx, np.int64)
+    n_real = int(cam_idx.shape[0])
+    Tc = max(1, -(-int(num_cameras) // C))
+    Sp = max(1, -(-int(num_points) // C))
+    col = np.minimum(cam_idx // Tc, C - 1)
+
+    # Column streams: co-observation order (camera-major, point-minor)
+    # inside each column, padded to the common quantum-aligned length.
+    col_ids = []
+    for c in range(C):
+        ids = np.nonzero(col == c)[0]
+        ids = ids[coobservation_edge_order(cam_idx[ids], pt_idx[ids])]
+        col_ids.append(ids)
+    Lc = max(1, max(ids.shape[0] for ids in col_ids))
+    Lc = -(-Lc // (E * quantum)) * (E * quantum)
+    chunk = Lc // E
+
+    perm = np.zeros(C * Lc, np.int64)
+    mask = np.zeros(C * Lc, np.float64)
+    cam_s = np.zeros(C * Lc, np.int32)
+    pt_s = np.zeros(C * Lc, np.int32)
+    cam_l = np.zeros(C * Lc, np.int32)
+    pos = 0
+    # Device-block order: edge-shard-major, camera-minor (the order a
+    # P((EDGE_AXIS, CAM_AXIS)) split hands to device (e, c) = block
+    # e*C + c).
+    for e in range(E):
+        for c in range(C):
+            ids = col_ids[c]
+            seg = ids[e * chunk:(e + 1) * chunk]
+            n = seg.shape[0]
+            sl = slice(pos, pos + chunk)
+            perm[sl][:n] = seg
+            mask[pos:pos + n] = 1.0
+            # Padding cameras: the column's last REAL camera (stream
+            # stays sorted, index stays inside the tile); a column with
+            # no real edges anchors to its tile's first in-range camera.
+            if ids.shape[0]:
+                pad_cam = int(cam_idx[ids[-1]])
+            else:
+                pad_cam = min(c * Tc, max(0, int(num_cameras) - 1))
+            cams = np.full(chunk, pad_cam, np.int32)
+            cams[:n] = cam_idx[seg]
+            pts = np.zeros(chunk, np.int32)
+            pts[:n] = pt_idx[seg]
+            cam_s[sl] = cams
+            pt_s[sl] = pts
+            cam_l[sl] = np.clip(cams - c * Tc, 0, Tc - 1)
+            pos += chunk
+
+    # Per-device point-shard buckets over the REAL local edges.
+    n_dev = E * C
+    rows = []
+    for d in range(n_dev):
+        sl = slice(d * chunk, (d + 1) * chunk)
+        ptd, md = pt_s[sl], mask[sl]
+        rows.append([
+            np.nonzero((ptd // Sp == s) & (md > 0))[0] for s in range(C)
+        ])
+    Lb = max(1, max(max((r.shape[0] for r in dev), default=0)
+                    for dev in rows))
+    b_slot = np.zeros((n_dev * C, Lb), np.int32)
+    b_ptl = np.zeros((n_dev * C, Lb), np.int32)
+    b_mask = np.zeros((n_dev * C, Lb), np.int32)
+    for d, dev in enumerate(rows):
+        for s, sel in enumerate(dev):
+            n = sel.shape[0]
+            b_slot[d * C + s, :n] = sel
+            b_ptl[d * C + s, :n] = pt_s[d * chunk + sel] - s * Sp
+            b_mask[d * C + s, :n] = 1
+
+    # Per-DEVICE streaming stats: every device walks its own chunk and
+    # pays its own first fetch, so the metric is aggregated over the
+    # E*C independent block walks — one concatenated walk would charge
+    # a phantom switch at every device-block boundary.
+    edges_t = switches_t = 0
+    for d in range(n_dev):
+        sl = slice(d * chunk, (d + 1) * chunk)
+        r = edge_stream_reuse(cam_s[sl], pt_s[sl], Tc, Sp, mask=mask[sl])
+        edges_t += r["edges"]
+        switches_t += r["switches"]
+    reuse = {"edges": edges_t, "switches": switches_t,
+             "reuse_factor": float(edges_t) / float(max(switches_t, 1))}
+    return CameraTilePlan(
+        num_cameras=int(num_cameras), num_points=int(num_points),
+        edge_shards=E, cam_blocks=C, tile_cams=Tc, shard_points=Sp,
+        n_edges_real=n_real, n_edges_padded=C * Lc, bucket_width=Lb,
+        perm=perm, mask=mask, cam_idx=cam_s, pt_idx=pt_s,
+        cam_local=cam_l, bucket_slot=b_slot, bucket_ptl=b_ptl,
+        bucket_mask=b_mask, reuse=reuse)
+
+
+def cached_camera_tile_plan(
+    cam_idx: np.ndarray,
+    pt_idx: np.ndarray,
+    num_cameras: int,
+    num_points: int,
+    edge_shards: int,
+    cam_blocks: int,
+    quantum: int = 0,
+):
+    """`build_camera_tile_plan` behind the host plan cache.
+
+    Returns ((CameraTilePlan, DeviceCameraTilePlan), cache_hit), keyed
+    by a blake2b content fingerprint of the index arrays plus EVERY
+    geometry knob, exactly like the tile/cluster plans."""
+    key = ("mesh2d", _array_digest(np.asarray(cam_idx)),
+           _array_digest(np.asarray(pt_idx)),
+           int(num_cameras), int(num_points), int(edge_shards),
+           int(cam_blocks), int(quantum))
+    hit = _plan_cache_get(key)
+    if hit is not None:
+        return hit, True
+    plan = build_camera_tile_plan(
+        cam_idx, pt_idx, num_cameras, num_points, edge_shards,
+        cam_blocks, quantum=quantum)
+    value = (plan, device_camera_tile_plan(plan))
     _plan_cache_put(key, value)
     return value, False
 

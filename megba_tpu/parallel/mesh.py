@@ -55,6 +55,98 @@ except AttributeError:
         return _shard_map_exp(f, check_rep=False, **kwargs)
 
 EDGE_AXIS = "edges"
+# Second mesh axis of the 2-D distribution (make_mesh_2d): camera
+# blocks.  Under a 2-D mesh the edge axis splits over BOTH axes
+# (P((EDGE_AXIS, CAM_AXIS))), cameras tile over CAM_AXIS, and the Schur
+# matvec's reductions become subgroup-scoped (solver/pcg.make_matvec_2d)
+# instead of world-wide.
+CAM_AXIS = "cams"
+
+
+def mesh_axes(mesh: Mesh):
+    """The lm_solve `axis_name` for this mesh: the single edge axis for
+    the 1-D mesh (every historical program, byte-identical), the
+    (edge, camera) tuple for the 2-D mesh — `jax.lax.psum` over the
+    tuple reduces over the whole world, so every existing psum site
+    (cost sums, Schur build, coarse builds) is correct on both meshes
+    without change."""
+    names = tuple(mesh.axis_names)
+    return names if len(names) > 1 else names[0]
+
+
+def factor_mesh_2d(world_size: int, cam_blocks: int = 0):
+    """Resolve (edge_shards, cam_blocks) for a 2-D mesh of `world_size`
+    devices.
+
+    `cam_blocks > 0` must divide world_size (validate_options enforces
+    the same contract); 0 selects the largest divisor <=
+    sqrt(world_size) — the square-ish factorisation that keeps BOTH
+    subgroups small (a 1 x W or W x 1 degenerate mesh reproduces the
+    1-D communication pattern on one of the two stages).
+    """
+    world_size = int(world_size)
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    cam_blocks = int(cam_blocks)
+    if cam_blocks > 0:
+        if world_size % cam_blocks or cam_blocks > world_size:
+            raise ValueError(
+                f"cam_blocks={cam_blocks} does not factor "
+                f"world_size={world_size} into edge_shards x cam_blocks")
+        return world_size // cam_blocks, cam_blocks
+    c = 1
+    d = 1
+    while d * d <= world_size:
+        if world_size % d == 0:
+            c = d
+        d += 1
+    return world_size // c, c
+
+
+def nearest_cam_blocks(world_size: int, cam_blocks: int) -> int:
+    """Largest feasible cam_blocks <= the requested one for this world.
+
+    The elastic shrink-world resume (robustness/elastic.resume_elastic)
+    uses this to re-factor a 2-D solve onto a SMALLER 2-D mesh: the
+    surviving world keeps as much of the camera-block split as it can
+    still factor (degrading to 1 — the 1-D layout — only when the new
+    world size shares no divisor with the old camera split).
+    """
+    world_size = int(world_size)
+    cam_blocks = max(1, int(cam_blocks))
+    best = 1
+    for c in range(1, min(cam_blocks, world_size) + 1):
+        if world_size % c == 0:
+            best = c
+    return best
+
+
+def make_mesh_2d(
+    edge_shards: int,
+    cam_blocks: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the 2-D (edge_shards x cam_blocks) mesh.
+
+    Axis order is (EDGE_AXIS, CAM_AXIS): a P((EDGE_AXIS, CAM_AXIS))
+    edge split hands device (e, c) the contiguous block e*C + c —
+    exactly the block order `ops.segtiles.build_camera_tile_plan` lays
+    the padded edge stream out in.  Device sourcing matches `make_mesh`
+    (local_devices_only scope, loud CPU fallback).
+    """
+    E, C = int(edge_shards), int(cam_blocks)
+    if E < 1 or C < 1:
+        raise ValueError(
+            f"edge_shards and cam_blocks must be >= 1, got {E} x {C}")
+    world = E * C
+    if devices is None:
+        base = make_mesh(world, devices)
+        devices = list(base.devices.reshape(-1))
+    if len(devices) < world:
+        raise ValueError(
+            f"2-D mesh {E}x{C} needs {world} devices, have {len(devices)}")
+    grid = np.asarray(list(devices)[:world]).reshape(E, C)
+    return Mesh(grid, (EDGE_AXIS, CAM_AXIS))
 
 # Elastic shrink-world scope (parallel/multihost + robustness/elastic):
 # after peers are lost/abandoned, `jax.devices()` STILL lists the dead
@@ -175,6 +267,7 @@ def distributed_lm_solve(
     initial_dx=None,
     fault_plan=None,
     cluster_plan=None,
+    tile_plan=None,
     jit_cache: Optional[dict] = None,
     donate: bool = False,
     lower_only: bool = False,
@@ -213,9 +306,24 @@ def distributed_lm_solve(
 
     # Feature-major edge arrays [F, nE] split on the MINOR axis; 1-D
     # index/mask arrays on their only axis; parameters replicated.
-    edge = P(None, EDGE_AXIS)
-    edge1d = P(EDGE_AXIS)
+    # Under the 2-D mesh the edge axis splits over BOTH mesh axes
+    # (edge-shard-major device blocks — the camera-tile plan laid the
+    # stream out in exactly this order) and the matvec operand
+    # (tile_plan) follows the same split.
+    is_2d = len(mesh.axis_names) > 1
+    split = (EDGE_AXIS, CAM_AXIS) if is_2d else EDGE_AXIS
+    edge = P(None, split)
+    edge1d = P(split)
     rep = P()
+    if is_2d and plans is not None:
+        raise ValueError(
+            "the 2-D mesh path does not compose with the Pallas tiled "
+            "plans (DualPlans); lower with use_tiled=False")
+    if is_2d and tile_plan is None:
+        raise ValueError(
+            "a 2-D mesh solve needs the camera-tile plan operand: solve "
+            "through flat_solve (which plans + caches it) or pass "
+            "tile_plan=ops.segtiles.device_camera_tile_plan(...)")
 
     # Optional operands can't be None inside shard_map specs; pass the
     # present ones positionally with matching specs.
@@ -247,7 +355,8 @@ def distributed_lm_solve(
         # scalars and the point mask ride replicated.
         from megba_tpu.robustness.faults import fault_partition_specs
 
-        optional.append(("fault_plan", fault_plan, fault_partition_specs()))
+        optional.append(("fault_plan", fault_plan,
+                         fault_partition_specs(edge_spec=edge1d)))
     if cluster_plan is not None:
         # Coarse-space plan (ops/segtiles.py; two-level OR multilevel):
         # the per-edge pc_slot stream follows the edge shards, the
@@ -257,7 +366,16 @@ def distributed_lm_solve(
         from megba_tpu.ops.segtiles import coarse_plan_partition_specs
 
         optional.append(("cluster_plan", cluster_plan,
-                         coarse_plan_partition_specs(cluster_plan)))
+                         coarse_plan_partition_specs(cluster_plan,
+                                                     edge_spec=edge1d)))
+    if tile_plan is not None:
+        # 2-D matvec operand: the per-edge cam_local stream and the
+        # per-device point-shard bucket tables follow the 2-D edge
+        # split (ops/segtiles.tile_plan_partition_specs).
+        from megba_tpu.ops.segtiles import tile_plan_partition_specs
+
+        optional.append(("tile_plan", tile_plan,
+                         tile_plan_partition_specs(tile_plan, edge1d)))
     keys = tuple(k for k, v, _ in optional if v is not None)
     args += [v for _, v, _ in optional if v is not None]
     in_specs += [spec for _, v, spec in optional if v is not None]
@@ -303,6 +421,8 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
                          cam_sorted=False, donate=False):
     """Build the jitted shard_map'ed solve (uncached)."""
 
+    axes = mesh_axes(mesh)
+
     def fn(cameras, points, obs, cam_idx, pt_idx, mask, init_region, init_v,
            verbose_token, *extras):
         kwargs = dict(zip(keys, extras))
@@ -314,16 +434,19 @@ def _build_sharded_solve(residual_jac_fn, mesh, option, keys, in_specs, verbose,
             kwargs["plans"] = squeeze_plans(kwargs["plans"])
         return lm_solve(
             residual_jac_fn, cameras, points, obs, cam_idx, pt_idx, mask,
-            option, axis_name=EDGE_AXIS, verbose=verbose, cam_sorted=cam_sorted,
+            option, axis_name=axes, verbose=verbose, cam_sorted=cam_sorted,
             initial_region=init_region,
             initial_v=init_v, verbose_token=verbose_token,
             **kwargs)
 
     # `traced`: retrace sentinel hook (analysis/retrace.py) — one count
     # per compilation of this SPMD program; zero cost once compiled.
+    # The static world tag carries the mesh SHAPE, not just its size: a
+    # 4-device 1-D mesh and a 2x2 2-D mesh are different programs.
+    world_tag = "world" + "x".join(str(n) for n in mesh.devices.shape)
     fn = traced(
         "mesh.sharded", fn,
-        static=static_key(residual_jac_fn, f"world{mesh.devices.size}",
+        static=static_key(residual_jac_fn, world_tag,
                           option, keys, verbose, cam_sorted, donate))
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P())
     # Donate the replicated parameter blocks only when the caller opted

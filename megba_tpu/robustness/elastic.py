@@ -609,6 +609,14 @@ def resume_elastic(
     an interrupted world-W solve resumed at world W' matches the
     uninterrupted world-W run at the sharded-parity tolerance (rtol
     1e-6 on final cost and parameters, equal `SolveStatus`).
+
+    A 2-D solve (SolverOption.mesh_2d) resumes onto a SMALLER 2-D
+    mesh: the world factorisation is recomputed
+    (parallel.mesh.nearest_cam_blocks — the largest camera-block count
+    the surviving world still factors), the camera-tile plan is
+    re-planned, and the same single-recompile/parity contract holds
+    (tests/test_mesh2d.py's resume_elastic stub-world tests pin the
+    refactorisation, incl. the prime-world 1-D degrade).
     """
     import dataclasses as _dc
 
@@ -622,6 +630,26 @@ def resume_elastic(
         world_size = len(jax.local_devices())
     old_world = option.world_size
     option = _dc.replace(option, world_size=int(world_size))
+    if option.solver_option.mesh_2d:
+        # 2-D solve resuming onto a smaller world: RE-FACTOR the mesh
+        # instead of falling back to the 1-D layout — the surviving
+        # world keeps the largest camera-block split it can still
+        # factor (parallel.mesh.nearest_cam_blocks; degrading to
+        # cam_blocks=1 — 1-D communication on a 2-D program — only when
+        # no divisor survives).  The re-lowering below is one new
+        # compile either way (world size AND mesh shape are static in
+        # the program), and the camera-tile plan is re-planned for the
+        # new factorisation by flat_solve's 2-D lowering.
+        from megba_tpu.parallel.mesh import nearest_cam_blocks
+
+        old_cb = option.solver_option.cam_blocks
+        if old_cb <= 0:
+            from megba_tpu.parallel.mesh import factor_mesh_2d
+
+            _, old_cb = factor_mesh_2d(max(old_world, 1), 0)
+        new_cb = nearest_cam_blocks(int(world_size), old_cb)
+        option = _dc.replace(option, solver_option=_dc.replace(
+            option.solver_option, cam_blocks=new_cb))
     if monitor is not None:
         monitor.record_reshard(old_world, world_size)
         monitor.record_resume()

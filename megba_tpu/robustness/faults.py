@@ -209,14 +209,18 @@ def poison_system(system, plan: FaultPlan, k):
         system, Hll=system.Hll * scale[None, :].astype(system.Hll.dtype))
 
 
-def fault_partition_specs():
+def fault_partition_specs(edge_spec=None):
     """shard_map in_specs tree for a FaultPlan operand (edge axis only
-    on `edge_nan`; everything else replicated)."""
+    on `edge_nan`; everything else replicated).  `edge_spec` overrides
+    the edge-following spec — the 2-D mesh passes its
+    P((EDGE_AXIS, CAM_AXIS)) split."""
     from jax.sharding import PartitionSpec as P
 
     from megba_tpu.parallel.mesh import EDGE_AXIS
 
-    return FaultPlan(edge_nan=P(EDGE_AXIS), point_crush=P(),
+    if edge_spec is None:
+        edge_spec = P(EDGE_AXIS)
+    return FaultPlan(edge_nan=edge_spec, point_crush=P(),
                      window=P(), offset=P())
 
 

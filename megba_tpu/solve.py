@@ -300,11 +300,85 @@ def flat_solve(
                 f"for a problem with {n_edges_raw} edges")
 
     ws = option.world_size
+    mesh2d = bool(ws > 1 and option.use_schur
+                  and option.solver_option.mesh_2d)
+    if mesh2d:
+        if use_tiled:
+            raise ValueError(
+                "mesh_2d does not compose with the Pallas tiled plans "
+                "(use_tiled=True); the 2-D lowering has its own "
+                "camera-tile plan — pass use_tiled=False/None")
+        use_tiled = False
     if use_tiled is None:
         use_tiled = default_use_tiled(dtype)
 
+    from megba_tpu.common import EdgeOrder
+
+    if (option.solver_option.edge_order == EdgeOrder.COOBS and not mesh2d):
+        # PI-BA co-observation ordering for the 1-D paths (the 2-D plan
+        # orders its own streams co-observation-first regardless): a
+        # pure host pre-permutation of the caller's edge set — the later
+        # camera sorts are stable, so the point-minor order survives
+        # into every lowering.  Results agree at solver tolerance (sums
+        # reorder), never bitwise; NATURAL keeps every existing program
+        # byte-identical.
+        from megba_tpu.ops.segtiles import coobservation_edge_order
+
+        with timer.phase("sort"):
+            operm = coobservation_edge_order(cam_idx, pt_idx)
+            cam_idx, pt_idx, obs = cam_idx[operm], pt_idx[operm], obs[operm]
+            if sqrt_info is not None:
+                sqrt_info = np.asarray(sqrt_info)[operm]
+            if em is not None:
+                em = em[operm]
+            if fault_edge is not None:
+                fault_edge = fault_edge[operm]
+
     plans = None
-    if use_tiled and ws > 1:
+    tile_plan_j = None
+    if mesh2d:
+        # 2-D camera x edge lowering: the cached camera-tile plan
+        # assigns every edge to its camera tile's column, orders each
+        # column co-observation-first, and lays the padded stream out
+        # in the P((EDGE_AXIS, CAM_AXIS)) device-block order; the
+        # device half rides the program as a pytree operand exactly
+        # like the cluster plans, so toggling mesh_2d never bakes
+        # indices into a compiled program.
+        from megba_tpu.ops.segtiles import (
+            cached_camera_tile_plan,
+            plan_cache_evictions,
+        )
+        from megba_tpu.parallel.mesh import factor_mesh_2d
+
+        n_shards, n_blocks = factor_mesh_2d(
+            ws, option.solver_option.cam_blocks)
+        with timer.phase("plan"):
+            evict0 = plan_cache_evictions()
+            (tplan, tile_plan_j), plan_hit = cached_camera_tile_plan(
+                cam_idx, pt_idx, cameras.shape[0], points.shape[0],
+                n_shards, n_blocks)
+            if plan_hit:
+                timer.count_event("plan_cache_hit")
+            evicted = plan_cache_evictions() - evict0
+            if evicted:
+                timer.count_event("plan_cache_evict", evicted)
+            perm, pmask = tplan.perm, tplan.mask
+            obs = obs[perm] * pmask[:, None].astype(dtype)
+            cam_idx = tplan.cam_idx
+            pt_idx = tplan.pt_idx
+            mask = pmask.astype(dtype)
+            if em is not None:
+                # Padding slots repeat caller edge 0 under pmask 0, so
+                # the soft-delete mask multiplies in exactly.
+                mask = mask * em[perm]
+            if sqrt_info is not None:
+                sqrt_info = np.asarray(sqrt_info)[perm]
+            if fault_edge is not None:
+                from megba_tpu.robustness.faults import lower_edge_vector
+
+                fault_edge = lower_edge_vector(fault_edge, perm, pmask)
+            n_padded = obs.shape[0]
+    elif use_tiled and ws > 1:
         # Sharded tiled lowering: contiguous per-shard edge chunks, each
         # with its own dual plans; the concatenated per-shard slot
         # streams form the edge axis (equal shard sizes by construction).
@@ -502,9 +576,16 @@ def flat_solve(
         "num_edges_padded": int(n_padded),
         "world_size": ws,
     }
+    if mesh2d:
+        problem_shape["mesh"] = f"{n_shards}x{n_blocks}"
 
     if ws > 1:
-        mesh = make_mesh(ws)
+        if mesh2d:
+            from megba_tpu.parallel.mesh import make_mesh_2d
+
+            mesh = make_mesh_2d(n_shards, n_blocks)
+        else:
+            mesh = make_mesh(ws)
         with timer.phase("dispatch"):
             result = distributed_lm_solve(
                 residual_jac_fn, cameras_fm, points_fm,
@@ -515,7 +596,7 @@ def flat_solve(
                 verbose=verbose, cam_sorted=True, plans=plans,
                 initial_region=initial_region, initial_v=initial_v,
                 initial_dx=initial_dx_j, fault_plan=fault_j,
-                cluster_plan=cluster_plan_j,
+                cluster_plan=cluster_plan_j, tile_plan=tile_plan_j,
                 jit_cache=jit_cache, donate=True, lower_only=lower_only)
         if lower_only:
             return result

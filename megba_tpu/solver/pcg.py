@@ -101,6 +101,49 @@ def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
     return comp_dot(a, b)
 
 
+# Per-edge coupling contractions shared by the 1-D hlp/hpl closures and
+# the 2-D tiled matvec (make_matvec_2d steps 1 and 4): ONE copy of each
+# W / Jc-Jp block-row layout (EXPLICIT rows W[a*pd+b]; Jacobian-mode
+# rows Jc[o*cd+a], Jp[o*pd+b]) so a layout change cannot silently land
+# on only one path.  `up` is the caller's mixed-precision upcast.
+
+
+def _edge_cam_to_pt_explicit(W, pe, cd, pd, up):
+    """W^T applied per edge: [cd, nE] camera rows -> [pd, nE]."""
+    return jnp.stack([
+        sum(up(W[a * pd + b]) * pe[a] for a in range(cd))
+        for b in range(pd)
+    ])
+
+
+def _edge_pt_to_cam_explicit(W, qe, cd, pd, up):
+    """W applied per edge: [pd, nE] point rows -> [cd, nE]."""
+    return jnp.stack([
+        sum(up(W[a * pd + b]) * qe[b] for b in range(pd))
+        for a in range(cd)
+    ])
+
+
+def _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up):
+    """Jp^T (Jc p) per edge via the [od] residual components."""
+    u = [sum(up(Jc[o * cd + a]) * pe[a] for a in range(cd))
+         for o in range(od)]
+    return jnp.stack([
+        sum(up(Jp[o * pd + b]) * u[o] for o in range(od))
+        for b in range(pd)
+    ])
+
+
+def _edge_pt_to_cam_fwd(Jc, Jp, qe, cd, pd, od, up):
+    """Jc^T (Jp q) per edge via the [od] residual components."""
+    u = [sum(up(Jp[o * pd + b]) * qe[b] for b in range(pd))
+         for o in range(od)]
+    return jnp.stack([
+        sum(up(Jc[o * cd + a]) * u[o] for o in range(od))
+        for a in range(cd)
+    ])
+
+
 def make_coupling_matvecs(
     W: Optional[jax.Array],
     Jc: jax.Array,
@@ -152,10 +195,7 @@ def make_coupling_matvecs(
                 cd = p_cam.shape[0]
                 pd = cdpd // cd
                 pe = seg_expand(p_cam, plans.cam, uk)  # [cd, nCamSlots]
-                te = jnp.stack([
-                    sum(up(W[a * pd + b]) * pe[a] for a in range(cd))
-                    for b in range(pd)
-                ])  # [pd, nCamSlots]
+                te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up)
                 return psum(seg_reduce(plans.to_pt(te), plans.pt, uk))
 
             def hpl(q_pt: jax.Array) -> jax.Array:
@@ -163,10 +203,7 @@ def make_coupling_matvecs(
                 cd = cdpd // pd
                 qe = plans.to_cam(
                     seg_expand(q_pt, plans.pt, uk))  # [pd, nCamSlots]
-                te = jnp.stack([
-                    sum(up(W[a * pd + b]) * qe[b] for b in range(pd))
-                    for a in range(cd)
-                ])
+                te = _edge_pt_to_cam_explicit(W, qe, cd, pd, up)
                 return psum(seg_reduce(te, plans.cam, uk))
 
         else:
@@ -207,20 +244,14 @@ def make_coupling_matvecs(
             cd = p_cam.shape[0]
             pd = cdpd // cd
             pe = gather_fm(p_cam, cam_idx)  # [cd, nE]
-            te = jnp.stack([
-                sum(up(W[a * pd + b]) * pe[a] for a in range(cd))
-                for b in range(pd)
-            ])
+            te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up)
             return psum(segsum_fm(te, pt_idx, num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
             pd = q_pt.shape[0]
             cd = cdpd // pd
             qe = gather_fm(q_pt, pt_idx)  # [pd, nE]
-            te = jnp.stack([
-                sum(up(W[a * pd + b]) * qe[b] for b in range(pd))
-                for a in range(cd)
-            ])
+            te = _edge_pt_to_cam_explicit(W, qe, cd, pd, up)
             return psum(segsum_fm(te, cam_idx, num_cameras,
                                   indices_are_sorted=cam_sorted))
 
@@ -232,12 +263,7 @@ def make_coupling_matvecs(
             od = ocd // cd
             pd = opd // od
             pe = gather_fm(p_cam, cam_idx)
-            u = [sum(up(Jc[o * cd + a]) * pe[a] for a in range(cd))
-                 for o in range(od)]  # Jc p, per residual component
-            te = jnp.stack([
-                sum(up(Jp[o * pd + b]) * u[o] for o in range(od))
-                for b in range(pd)
-            ])  # Jp^T (Jc p)
+            te = _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up)
             return psum(segsum_fm(te, pt_idx, num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
@@ -245,16 +271,157 @@ def make_coupling_matvecs(
             od = opd // pd
             cd = ocd // od
             qe = gather_fm(q_pt, pt_idx)
-            u = [sum(up(Jp[o * pd + b]) * qe[b] for b in range(pd))
-                 for o in range(od)]  # Jp q
-            te = jnp.stack([
-                sum(up(Jc[o * cd + a]) * u[o] for o in range(od))
-                for a in range(cd)
-            ])  # Jc^T (Jp q)
+            te = _edge_pt_to_cam_fwd(Jc, Jp, qe, cd, pd, od, up)
             return psum(segsum_fm(te, cam_idx, num_cameras,
                                   indices_are_sorted=cam_sorted))
 
     return hpl, hlp
+
+
+def make_matvec_2d(
+    W: Optional[jax.Array],
+    Jc: Optional[jax.Array],
+    Jp: Optional[jax.Array],
+    tile_plan,
+    pt_idx: jax.Array,
+    Hpp_d: jax.Array,
+    Hll_inv: jax.Array,
+    num_cameras: int,
+    num_points: int,
+    compute_kind: ComputeKind,
+    axis_name,
+    mixed_precision: bool = False,
+):
+    """Build the fused 2-D Schur matvec S·p (camera x edge mesh).
+
+    The 1-D matvec's two WORLD-wide psums (solver/pcg.s_matvec via
+    make_coupling_matvecs) become subgroup-scoped stages on the
+    (EDGE_AXIS, CAM_AXIS) mesh, with the point-shard transfer
+    double-buffered against the tile contraction:
+
+      1. camera gather — LOCAL: every edge of device (e, c) touches a
+         camera inside tile c (the camera-tile plan routed it there),
+         so Jc·p / W·p reads this device's own tile slice of the
+         replicated p.  Zero bytes.
+      2. point reduction — psum_scatter over CAM_AXIS (each camera
+         column takes ownership of one point shard of the partial
+         scatter), then psum over the EDGE subgroup: the full [pd, Np]
+         all-reduce of the 1-D path shrinks to one (C-1)/C scatter plus
+         a 1/C-sized subgroup reduce.
+      3. Hll⁻¹ — applied to the OWNED shard only (replicated rows,
+         local slice).
+      4. tile loop with DOUBLE BUFFERING: the owned point shard rotates
+         around the CAM_AXIS ring (C-1 collective_permutes); at step j
+         the ppermute fetching shard j+1 is issued BEFORE the
+         contraction of shard j (the plan's co-observation-ordered
+         bucket of edges touching it), so the ICI transfer of the next
+         tile overlaps the MXU contraction of the current one.
+      5. camera reduction — psum over the EDGE subgroup of the [cd, Tc]
+         tile partials (1/C of the 1-D payload), then one all_gather
+         over CAM_AXIS re-replicates the result.
+
+    Every collective of THIS matvec is subgroup-scoped (replica groups
+    of size E or C, never E*C — the `ba_2d_w4_f32` canonical program
+    pins the census; TWO_LEVEL/MULTILEVEL coarse-correction psums in
+    precond_apply still span the full axis tuple — see ARCHITECTURE),
+    and the per-iteration bytes moved are strictly below the 1-D
+    all-reduce scaling law (analysis/hlo.collective_bytes_moved is the
+    model; the budget gate's `collective_bytes_per_sp` axis pins it).
+    The CG scalars read replicated values and stay collective-free, as
+    on the 1-D mesh.
+
+    Returns a replicated-in/replicated-out `s_matvec(p)` — drop-in for
+    the 1-D closure, so guards, forcing, warm starts and every
+    preconditioner family compose unchanged.  Under `mixed_precision`
+    the contract matches the 1-D path (bf16 edge rows upcast before
+    every product, f32 Krylov vectors and accumulation — `p` is f32 by
+    construction), but agreement with the 1-D result is only at the
+    accuracy of the bf16-rounded operator (~1e-3 on ill-conditioned
+    scenes): the per-column summation grouping differs, and a PCG run
+    to stagnation resolves the operator's own rounding, not the
+    grouping (tests/test_mesh2d.py compose test pins this at 1e-2).
+    """
+    edge_axis, cam_axis = axis_name
+    C = tile_plan.cam_blocks
+    Tc = tile_plan.tile_cams
+    Sp = tile_plan.shard_points
+    nc_pad = C * Tc
+    np_pad = C * Sp
+    cdpd = None if W is None else W.shape[0]
+    ocd = None if Jc is None else Jc.shape[0]
+    opd = None if Jp is None else Jp.shape[0]
+
+    def up(x):
+        return x.astype(jnp.float32) if mixed_precision else x
+
+    # Replicated solve quantities, padded once to the tile geometry so
+    # tile/shard slices are static-shape.  Zero padding is inert: padded
+    # cameras/points have zero coupling rows and are never gathered by a
+    # real (unmasked) edge.
+    Hpp_pad = jnp.pad(Hpp_d, ((0, nc_pad - num_cameras), (0, 0), (0, 0)))
+    Hll_inv_pad = jnp.pad(Hll_inv, ((0, 0), (0, np_pad - num_points)))
+    ring = [(i, (i - 1) % C) for i in range(C)]
+
+    @jax.named_scope("megba.matvec_2d")
+    def s_matvec(p: jax.Array) -> jax.Array:
+        cd = p.shape[0]
+        ci = jax.lax.axis_index(cam_axis)
+        p_pad = jnp.pad(p, ((0, 0), (0, nc_pad - num_cameras)))
+        p_t = jax.lax.dynamic_slice_in_dim(p_pad, ci * Tc, Tc, axis=1)
+        # (1) local camera gather + per-edge coupling product.
+        pe = gather_fm(p_t, tile_plan.cam_local)  # [cd, nE_loc]
+        if compute_kind == ComputeKind.EXPLICIT:
+            pd = cdpd // cd
+            te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up)  # [pd, nE_loc]
+        else:
+            od = ocd // cd
+            pd = opd // od
+            te = _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up)
+        # (2) point reduction: scatter over CAM, reduce over EDGE.
+        t_part = segsum_fm(te, pt_idx, np_pad)
+        t_sh = jax.lax.psum_scatter(t_part, cam_axis,
+                                    scatter_dimension=1, tiled=True)
+        t_sh = jax.lax.psum(t_sh, edge_axis)  # [pd, Sp] owned shard
+        # (3) Hll^-1 on the owned shard.
+        hll_sh = jax.lax.dynamic_slice_in_dim(
+            Hll_inv_pad, ci * Sp, Sp, axis=1)
+        cur = block_matvec_fm(hll_sh, t_sh)
+        # (4) double-buffered tile loop: issue the fetch of shard j+1,
+        # THEN contract shard j's co-observation bucket.
+        acc = jnp.zeros((cd, Tc), p.dtype)
+        for j in range(C):
+            nxt = (jax.lax.ppermute(cur, cam_axis, perm=ring)
+                   if j < C - 1 else cur)
+            s = (ci + j) % C  # j, C static ints: stays the index dtype
+            slot = jax.lax.dynamic_slice_in_dim(
+                tile_plan.bucket_slot, s, 1, axis=0)[0]
+            ptl = jax.lax.dynamic_slice_in_dim(
+                tile_plan.bucket_ptl, s, 1, axis=0)[0]
+            mk = jax.lax.dynamic_slice_in_dim(
+                tile_plan.bucket_mask, s, 1, axis=0)[0].astype(p.dtype)
+            qe = gather_fm(cur, ptl) * mk  # [pd, Lb]
+            if compute_kind == ComputeKind.EXPLICIT:
+                Wg = up(jnp.take(W, slot, axis=1))
+                contrib = _edge_pt_to_cam_explicit(
+                    Wg, qe, cd, pd, lambda x: x)
+            else:
+                Jcg = up(jnp.take(Jc, slot, axis=1))
+                Jpg = up(jnp.take(Jp, slot, axis=1))
+                contrib = _edge_pt_to_cam_fwd(
+                    Jcg, Jpg, qe, cd, pd, od, lambda x: x)
+            cl = jnp.take(tile_plan.cam_local, slot)
+            acc = acc + segsum_fm(contrib.astype(p.dtype), cl, Tc)
+            cur = nxt
+        # (5) camera reduction: EDGE-subgroup psum of the tile, one
+        # all_gather over CAM re-replicates.
+        hpl_t = jax.lax.psum(acc, edge_axis)
+        y_t = cam_block_matvec(
+            jax.lax.dynamic_slice_in_dim(Hpp_pad, ci * Tc, Tc, axis=0),
+            p_t) - hpl_t
+        y = jax.lax.all_gather(y_t, cam_axis, axis=1, tiled=True)
+        return y[:, :num_cameras]
+
+    return s_matvec
 
 
 # named_scope: the PCG while_loop (body traced inside this call) carries
@@ -502,6 +669,7 @@ def plain_pcg_solve(
     cluster_plan=None,
     cam_fixed=None,
     smooth_omega: float = 0.0,
+    tile_plan=None,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -591,6 +759,7 @@ def schur_pcg_solve(
     cluster_plan=None,
     cam_fixed=None,
     smooth_omega: float = 0.0,
+    tile_plan=None,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -686,10 +855,24 @@ def schur_pcg_solve(
         cam_sorted=cam_sorted, plans=plans,
     )
 
-    def s_matvec(p: jax.Array) -> jax.Array:
-        # S p = Hpp_d p - Hpl Hll_d^-1 Hlp p     [2 psums]
-        t = block_matvec_fm(Hll_inv, hlp(p))
-        return cam_block_matvec(Hpp_d, p) - hpl(t)
+    if tile_plan is not None:
+        # 2-D mesh: the SINGLE matvec site becomes the fused tiled
+        # pipeline with subgroup collectives + double-buffered
+        # point-shard rotation (make_matvec_2d).  Everything OUTSIDE
+        # the PCG body — the reduced RHS, the warm-start residual
+        # priming, the back-substitution, the coarse-space builds —
+        # keeps the plain hpl/hlp products above (world psums, one per
+        # PCG solve, not per iteration), so the preconditioner family
+        # and the guards compose unchanged.
+        s_matvec = make_matvec_2d(
+            W, Jc, Jp, tile_plan, pt_idx, Hpp_d, Hll_inv,
+            num_cameras, num_points, compute_kind, axis_name,
+            mixed_precision=mixed_precision)
+    else:
+        def s_matvec(p: jax.Array) -> jax.Array:
+            # S p = Hpp_d p - Hpl Hll_d^-1 Hlp p     [2 psums]
+            t = block_matvec_fm(Hll_inv, hlp(p))
+            return cam_block_matvec(Hpp_d, p) - hpl(t)
 
     # Preconditioner operator family (solver/precond.py).  The
     # correction/coarse rows are always accumulated in full precision
