@@ -390,6 +390,8 @@ def lm_solve(
                 tol_relative=tol_rel,
                 compute_kind=compute_kind, axis_name=axis_name,
                 mixed_precision=option.mixed_precision_pcg,
+                bf16=solver_opt.bf16,
+                bf16_collectives=solver_opt.bf16_collectives,
                 cam_sorted=cam_sorted,
                 preconditioner=solver_opt.preconditioner, plans=plans,
                 x0=s["dx0"] if warm_start else None,

@@ -390,6 +390,147 @@ def collective_ops(ops: Iterable[HloOp]) -> List[HloOp]:
     return [op for op in ops if op.kind in COLLECTIVE_KINDS]
 
 
+def _walk_stablehlo_lines(text: str):
+    """Yield (lineno, raw, kind-or-None, while_depth, brace_depth) for
+    every line of a StableHLO module.
+
+    The ONE copy of the while-region/brace state machine shared by the
+    bf16 scanners below (`parse_stablehlo_ops` predates it and keeps
+    its own in-loop copy — its op-recording behaviour is pinned by the
+    existing census baselines, so it is not re-threaded here).
+    `brace_depth` is the depth at the START of the line; `while_depth`
+    counts enclosing `stablehlo.while` regions, with the same
+    deferred-open handling as `parse_stablehlo_ops` (the regions open
+    on later lines; the generic one-line self-contained form is never
+    pushed).
+    """
+    depth = 0
+    while_stack: List[List] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = _STRING_RE.sub('""', raw)
+        m = _SHLO_OP_RE.search(raw)
+        kind = m.group(1) if m else None
+        yield lineno, raw, kind, len(while_stack), depth
+        if kind == "while":
+            opens, closes = line.count("{"), line.count("}")
+            if not (opens and opens == closes):
+                while_stack.append([depth, opens > closes])
+        depth += line.count("{") - line.count("}")
+        while while_stack:
+            threshold, opened = while_stack[-1]
+            if not opened:
+                if depth > threshold:
+                    while_stack[-1][1] = True
+                break
+            if depth <= threshold:
+                while_stack.pop()
+            else:
+                break
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectivePayload:
+    """One StableHLO collective with its DECLARED payload type.
+
+    Region-bearing collectives (all_reduce, reduce_scatter) print
+    their type signature on the REGION-CLOSING line (`}) : (...) ->
+    tensor<...>`), not the op line — `stablehlo_collective_payloads`
+    stitches the two; regionless kinds (all_gather,
+    collective_permute) carry it inline."""
+
+    kind: str
+    line: int
+    result_dtype: Optional[str]
+    result_elems: Optional[int]
+    while_depth: int
+
+
+def stablehlo_collective_payloads(text: str) -> List[CollectivePayload]:
+    """Every StableHLO collective op with its declared result payload.
+
+    The declared payload is what the byte model prices for bf16-
+    collective programs (analysis/program_audit): the compiled
+    executable's payload dtype is backend-normalized (XLA:CPU promotes
+    bf16 collectives to f32), while the StableHLO records what the
+    program asked the wire to carry.  `while_depth` >= 2 marks the PCG
+    while body (the LM loop is depth 1).
+    """
+    out: List[CollectivePayload] = []
+    # (kind, lineno, while_depth, brace depth at open) of region-form
+    # collectives whose type signature is still pending.
+    pending: List[Tuple[str, int, int, int]] = []
+    for lineno, raw, kind, wdepth, depth in _walk_stablehlo_lines(text):
+        if kind in COLLECTIVE_KINDS:
+            matches = _TENSOR_RE.findall(
+                raw.split("->")[-1]) if "->" in raw else []
+            if matches:
+                # Inline form: the full signature is on the op line.
+                dims, dt = matches[-1]
+                out.append(CollectivePayload(
+                    kind=kind, line=lineno, result_dtype=dt,
+                    result_elems=_dims_elems(dims), while_depth=wdepth))
+            else:
+                pending.append((kind, lineno, wdepth, depth))
+        elif (pending and "->" in raw and kind is None
+              and depth + (s := _STRING_RE.sub('""', raw)).count("{")
+              - s.count("}") <= pending[-1][3]):
+            # Region-closing signature line of the innermost pending
+            # collective: `}) : (tensor<..>) -> tensor<..>`.
+            k, ln, wd, _ = pending.pop()
+            matches = _TENSOR_RE.findall(raw.split("->")[-1])
+            if matches:
+                dims, dt = matches[-1]
+                out.append(CollectivePayload(
+                    kind=k, line=ln, result_dtype=dt,
+                    result_elems=_dims_elems(dims), while_depth=wd))
+            else:
+                out.append(CollectivePayload(
+                    kind=k, line=ln, result_dtype=None,
+                    result_elems=None, while_depth=wd))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Bf16Op:
+    """One StableHLO op line that touches a bf16 tensor (operand or
+    result) — the unit of the allowed-bf16-surface pass
+    (analysis/program_audit.Bf16Surface)."""
+
+    kind: str  # stablehlo mnemonic, e.g. "multiply", "convert"
+    line: int
+    text: str  # the stripped source line (truncated for reporting)
+    dtypes: Tuple[str, ...]  # every tensor element type on the line
+    result_dtype: Optional[str]  # last tensor token = the result
+    result_scalar: bool  # result tensor has no dims (rank 0)
+    while_depth: int
+
+
+def bf16_stablehlo_ops(text: str) -> List[Bf16Op]:
+    """Every StableHLO op line carrying a bf16 tensor, with the FULL
+    dtype tuple of the line (operands + result).
+
+    Scans raw lines (not the truncated `HloOp.text`) so a long line's
+    trailing result type cannot be cut out of the census; block-
+    argument and function-signature lines (no `stablehlo.` op) are
+    types, not ops, and are skipped.  While-region nesting is tracked
+    exactly as in `parse_stablehlo_ops` so the surface pass can tell
+    in-body ops from build-time ones.
+    """
+    out: List[Bf16Op] = []
+    for lineno, raw, kind, wdepth, _ in _walk_stablehlo_lines(text):
+        if kind is None or "bf16" not in raw:
+            continue
+        matches = _TENSOR_RE.findall(raw)
+        dtypes = tuple(dt for _, dt in matches)
+        if "bf16" not in dtypes:
+            continue
+        out.append(Bf16Op(
+            kind=kind, line=lineno, text=raw.strip()[:200],
+            dtypes=dtypes, result_dtype=matches[-1][1],
+            result_scalar=matches[-1][0] == "", while_depth=wdepth))
+    return out
+
+
 def dtype_census(text: str) -> Dict[str, int]:
     """tensor element-type -> occurrence count over a StableHLO module."""
     census: Dict[str, int] = {}

@@ -82,6 +82,62 @@ _WRONG_FAMILY = {
     "f64": ("f32", "bf16", "f16"),
 }
 
+# StableHLO op kinds a declared bf16 surface may carry bf16 tensors in:
+# storage/movement ops (converts, gathers, slices, layout shuffles),
+# the bf16 multiply itself, and dot_general (whose RESULT must still be
+# f32 — checked separately).  Collective kinds are allowed only when
+# the surface declares `collectives=True`.  Accumulation kinds (add /
+# subtract / reduce) are NEVER allowed on non-scalar bf16 tensors —
+# f32 accumulation is the contract — except the rank-0 adds inside a
+# declared collective's reduction region (the wire-payload sum the
+# collective gate explicitly buys).
+BF16_ALLOWED_KINDS: Tuple[str, ...] = (
+    "convert", "multiply", "dot_general",
+    "gather", "dynamic_slice", "slice", "dynamic_update_slice",
+    "reshape", "transpose", "broadcast_in_dim", "concatenate",
+    "select", "pad", "constant", "optimization_barrier", "return",
+    "custom_call",
+    # jax's while lowering threads closure arrays (the bf16 coupling
+    # rows / M⁻¹ copy) through the loop as invariant carries, so the
+    # while op's own signature legitimately names bf16 tensors.
+    "while",
+)
+
+_BF16_ACCUM_KINDS = frozenset({"add", "subtract", "reduce", "dot_general"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Bf16Surface:
+    """The DECLARED bf16 surface of one canonical program.
+
+    A program spec carrying one of these opts into the bf16 audit pass
+    (`ProgramAudit.bf16_surface_violations`) instead of the blanket
+    "bf16 is a wrong-family dtype" rule:
+
+    - every StableHLO op touching a bf16 tensor must be of an
+      `allowed_kinds` kind (collective kinds additionally need
+      `collectives=True`) — a bf16 op leaking outside the declared
+      surface fails the audit naming the op;
+    - NO accumulation may produce a bf16 result: an add/subtract/
+      reduce with a non-scalar bf16 result, or a dot_general whose
+      result is bf16 (preferred_element_type dropped), is exactly the
+      "accumulation not f32" regression this pass exists to catch.
+      Rank-0 bf16 adds are the reduction regions of the declared
+      collectives and are allowed iff `collectives=True`;
+    - converts may only cross between bf16 and f32 — a bf16<->f64
+      convert is a family leak;
+    - at least `min_compute_ops` bf16 multiplies / bf16-operand
+      dot_generals must EXIST: a refactor that silently upcasts the
+      operands before every product (the compiler or a well-meaning
+      edit) leaves a program that still carries bf16 tensors but runs
+      f32 math — the win evaporates while the census stays green, so
+      its absence is a violation, not a shrug.
+    """
+
+    allowed_kinds: Tuple[str, ...] = BF16_ALLOWED_KINDS
+    collectives: bool = False
+    min_compute_ops: int = 1
+
 
 @dataclasses.dataclass(frozen=True)
 class ProgramSpec:
@@ -107,6 +163,17 @@ class ProgramSpec:
     # point — a world-wide reduce sneaking back into the body is
     # exactly the regression this pins against.
     pcg_subgroup_only: bool = False
+    # Declared bf16 surface (None = any bf16 occurrence is a
+    # wrong-family dtype leak, the historical rule).  With
+    # `collectives=True` the PCG-body byte model additionally prices
+    # the DECLARED (StableHLO) payload dtype instead of the compiled
+    # one: probed on this jaxlib (0.4.36, XLA:CPU), the CPU backend's
+    # float-normalization pass promotes bf16 collectives back to f32
+    # in the compiled executable, so the CPU audit lane would price
+    # wire bytes the program never asked to move — a TPU lowering
+    # (native bf16 collectives) moves the declared payload, and the
+    # surface pass pins that the declaration exists.
+    bf16_surface: Optional[Bf16Surface] = None
 
 
 @dataclasses.dataclass
@@ -134,6 +201,12 @@ class ProgramAudit:
     def collectives(self) -> List[hlo.HloOp]:
         return hlo.collective_ops(self.compiled_ops)
 
+    @functools.cached_property
+    def declared_collective_payloads(self) -> List[hlo.CollectivePayload]:
+        """StableHLO-declared collective payloads, parsed once (the
+        byte repricing and the bf16 surface pass both read them)."""
+        return hlo.stablehlo_collective_payloads(self.stablehlo)
+
     # ---- pass 1: transfer freedom ------------------------------------
     def transfer_violations(self) -> List[str]:
         bad = hlo.transfer_ops(self.stablehlo_ops,
@@ -148,8 +221,48 @@ class ProgramAudit:
     @functools.cached_property
     def _pcg_body_summary(self) -> Tuple[
             List[hlo.HloOp], Dict[str, int], float]:
-        return pcg_body_collective_summary(
+        body, census, bytes_moved = pcg_body_collective_summary(
             self.compiled_ops, self.spec.world)
+        surf = self.spec.bf16_surface
+        if surf is not None and surf.collectives and body:
+            repriced = self._declared_payload_bytes(body)
+            if repriced is not None:
+                bytes_moved = repriced
+        return body, census, bytes_moved
+
+    def _declared_payload_bytes(self, body) -> Optional[float]:
+        """Ring-model PCG-body bytes priced at the DECLARED (StableHLO)
+        payload dtype, replica-group structure from the compiled op.
+
+        Pairs every compiled in-body collective with a StableHLO
+        collective at while depth >= 2 (the PCG while body — the LM
+        loop is depth 1) by (kind, element count).  Returns None when
+        the pairing is incomplete — `bf16_surface_violations` raises
+        that as an explicit violation, so the byte axis can never
+        silently fall back to a mis-priced payload.  Why this exists:
+        XLA:CPU's float normalization promotes bf16 collectives to f32
+        in the compiled executable (probed — see ProgramSpec), so the
+        compiled dtype on the audit lane is not the payload a bf16-
+        capable backend moves.
+        """
+        declared = [op for op in self.declared_collective_payloads
+                    if op.while_depth >= 2]
+        pool: Dict[Tuple[str, Optional[int]], list] = {}
+        for op in declared:
+            pool.setdefault((op.kind, op.result_elems), []).append(op)
+        total = 0.0
+        for cop in body:
+            cand = pool.get((cop.kind, cop.result_elems))
+            if not cand:
+                return None
+            dop = cand.pop()
+            b = (float(cop.result_elems or 0)
+                 * hlo.DTYPE_BYTES.get(dop.result_dtype or "", 0))
+            total += hlo.collective_bytes_moved(
+                dataclasses.replace(cop, result_bytes=b,
+                                    result_dtype=dop.result_dtype),
+                self.spec.world)
+        return total
 
     def pcg_body_collectives(self) -> List[hlo.HloOp]:
         return self._pcg_body_summary[0]
@@ -217,11 +330,16 @@ class ProgramAudit:
                         f"is subgroup-scoped stages — {op.where()}")
         return out
 
-    # ---- pass 3: dtype census + donation -----------------------------
+    # ---- pass 3: dtype census + donation + bf16 surface --------------
     def dtype_violations(self) -> List[str]:
         census = hlo.dtype_census(self.stablehlo)
         out: List[str] = []
-        for wrong in _WRONG_FAMILY[self.spec.float_family]:
+        wrongs = _WRONG_FAMILY[self.spec.float_family]
+        if self.spec.bf16_surface is not None:
+            # bf16 is the declared surface, not a leak; f64/f16 stay
+            # wrong, and the surface pass polices WHERE bf16 appears.
+            wrongs = tuple(w for w in wrongs if w != "bf16")
+        for wrong in wrongs:
             n = census.get(wrong, 0)
             if not n:
                 continue
@@ -231,6 +349,111 @@ class ProgramAudit:
             out.append(
                 f"{self.spec.name}: {n} {wrong} tensor occurrence(s) in "
                 f"a {self.spec.float_family} solve (dtype leak):\n{where}")
+        return out
+
+    def bf16_surface_violations(self) -> List[str]:
+        """The allowed-bf16-surface pass (specs with `bf16_surface`).
+
+        Without a declared surface this pass is empty — any bf16 then
+        already fails the wrong-family census above.  With one, four
+        contracts are enforced (Bf16Surface docstring): kind
+        allow-list, f32 accumulation, converts confined to bf16<->f32,
+        and the presence of actual bf16 compute (the silent-upcast
+        guard).  Under `collectives=True` the declared in-body
+        payloads must ALSO all be bf16 and pair 1:1 with the compiled
+        census — otherwise the halved `collective_bytes_per_sp` the
+        budget pins would be priced off a payload the program never
+        declared.
+        """
+        surf = self.spec.bf16_surface
+        if surf is None:
+            return []
+        name = self.spec.name
+        allowed = frozenset(surf.allowed_kinds)
+        out: List[str] = []
+        compute = 0
+        # Collectives are detected through the payload scanner, NOT the
+        # per-line bf16 scan: a region-form all_reduce's op line does
+        # not carry its payload type (it sits on the region-closing
+        # line), so a line scan would see only the scalar region add.
+        for p in self.declared_collective_payloads:
+            if p.result_dtype == "bf16" and not surf.collectives:
+                out.append(
+                    f"{name}: bf16 collective payload without a "
+                    f"declared bf16_collectives surface — line {p.line}")
+        for op in hlo.bf16_stablehlo_ops(self.stablehlo):
+            if op.kind in hlo.COLLECTIVE_KINDS:
+                continue  # payload-checked above
+            if op.kind == "add" and op.result_scalar and surf.collectives:
+                continue  # a declared collective's reduction region
+            if op.kind in _BF16_ACCUM_KINDS:
+                if op.kind == "dot_general":
+                    if op.result_dtype == "bf16":
+                        out.append(
+                            f"{name}: dot_general ACCUMULATES in bf16 "
+                            f"(preferred_element_type dropped?) — line "
+                            f"{op.line}: {op.text[:120]}")
+                    else:
+                        compute += 1
+                    continue
+                out.append(
+                    f"{name}: bf16 accumulation ({op.kind}) — the "
+                    f"surface contract is bf16 storage with f32 "
+                    f"accumulation — line {op.line}: {op.text[:120]}")
+                continue
+            if op.kind == "convert":
+                # Only FLOAT-family crossings are leaks (f64/f16 would
+                # smuggle a different precision family in); an integer
+                # operand cast to bf16 (the 2-D tile masks) is exact.
+                bad = [d for d in op.dtypes
+                       if d not in ("bf16", "f32")
+                       and (d.startswith("f") or d.startswith("c"))]
+                if bad:
+                    out.append(
+                        f"{name}: convert crosses bf16<->{bad[0]} "
+                        f"(family leak; only bf16<->f32 is on the "
+                        f"surface) — line {op.line}: {op.text[:120]}")
+                continue
+            if op.kind not in allowed:
+                out.append(
+                    f"{name}: bf16 tensor in op kind {op.kind!r} "
+                    f"outside the declared surface — line {op.line}: "
+                    f"{op.text[:120]}")
+                continue
+            if op.kind == "multiply" and op.result_dtype == "bf16":
+                compute += 1
+        if compute < surf.min_compute_ops:
+            out.append(
+                f"{name}: declared bf16 surface carries only {compute} "
+                f"bf16 compute op(s) (< {surf.min_compute_ops}) — the "
+                "operands were silently upcast and the program runs "
+                "f32 math under a bf16 flag")
+        if surf.collectives:
+            body = self.pcg_body_collectives()
+            if body and self._declared_payload_bytes(body) is None:
+                out.append(
+                    f"{name}: compiled PCG-body collectives could not "
+                    "be paired with declared StableHLO payloads — the "
+                    "byte axis cannot certify the bf16 wire payload")
+            # Every in-body declared payload must be bf16.  SCOPE NOTE:
+            # this assumes the edge-local preconditioner families
+            # (JACOBI/NEUMANN/SCHUR_DIAG — the current bf16 canonical
+            # programs), where every in-body collective belongs to the
+            # compressed S·p matvec.  A future bf16 canonical program
+            # with a TWO_LEVEL/MULTILEVEL precond would carry
+            # legitimate FULL-precision coarse-correction psums in the
+            # body (the documented contract — solver/pcg.py): scope
+            # this check to the matvec's census before declaring such
+            # a spec, or it will flag the f32 coarse payloads.
+            declared = [op for op in self.declared_collective_payloads
+                        if op.while_depth >= 2]
+            for op in declared:
+                if op.result_dtype != "bf16":
+                    out.append(
+                        f"{name}: in-body collective declares a "
+                        f"{op.result_dtype} payload under a "
+                        f"bf16-collectives surface (compression "
+                        f"dropped) — line {op.line}")
         return out
 
     def donation_violations(self) -> List[str]:
@@ -281,7 +504,8 @@ class ProgramAudit:
 
     def violations(self) -> List[str]:
         return (self.transfer_violations() + self.collective_violations()
-                + self.dtype_violations() + self.donation_violations())
+                + self.dtype_violations() + self.bf16_surface_violations()
+                + self.donation_violations())
 
     def summary(self) -> Dict[str, object]:
         """JSON-able per-program audit summary (for bench.py and
@@ -343,7 +567,8 @@ def _ba_ml_problem():
 
 def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
               guarded: bool = False, twolevel: bool = False,
-              multilevel: bool = False, mesh2d: bool = False):
+              multilevel: bool = False, mesh2d: bool = False,
+              bf16: bool = False):
     import dataclasses as _dc
 
     from megba_tpu.common import JacobianMode, RobustOption, SolverOption
@@ -389,6 +614,12 @@ def _lower_ba(world: int, use_tiled: bool, forcing: bool = False,
         option = _dc.replace(option, solver_option=_dc.replace(
             option.solver_option, precond=PrecondKind.MULTILEVEL,
             coarsen_factor=2.0, max_levels=3))
+    if bf16:
+        # bf16 MXU pipeline canonical programs: storage + collective
+        # gates BOTH on — the full rung, so the allowed-surface pass
+        # and the halved bytes axis are pinned together.
+        option = _dc.replace(option, solver_option=_dc.replace(
+            option.solver_option, bf16=True, bf16_collectives=True))
     f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
     return flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx, s.pt_idx,
                       option, use_tiled=use_tiled, lower_only=True)
@@ -586,6 +817,43 @@ def program_specs() -> Dict[str, ProgramSpec]:
             pcg_subgroup_only=True,
             build=lambda: _lower_ba(world=4, use_tiled=False,
                                     mesh2d=True)),
+        "ba_bf16_w2_f32": ProgramSpec(
+            name="ba_bf16_w2_f32", float_family="f32", world=2,
+            # The bf16 MXU pipeline on the 1-D mesh: per-edge products
+            # on bf16 operands with f32 accumulation, bf16 M⁻¹ apply,
+            # and bf16 in-body collective payloads.  The body census
+            # stays exactly two all-reduces per S·p (the textbook-
+            # recurrence body has the same matvec-only collective
+            # site); the allowed-surface pass pins bf16 to the
+            # declared op kinds with f32 accumulation, and the budget
+            # entry pins `collective_bytes_per_sp` at exactly HALF
+            # ba_sharded_w2_f32's (tests/test_program_audit.py asserts
+            # the ratio) — priced at the DECLARED payload (see
+            # bf16_surface field note).
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            bf16_surface=Bf16Surface(collectives=True),
+            build=lambda: _lower_ba(world=2, use_tiled=False,
+                                    bf16=True)),
+        "ba_bf16_2d_w4_f32": ProgramSpec(
+            name="ba_bf16_2d_w4_f32", float_family="f32", world=4,
+            # The bf16 pipeline composed with PR 14's 2-D mesh: the
+            # same subgroup-scoped five-collective census as
+            # ba_2d_w4_f32, every payload bf16 on the wire — the
+            # budget entry pins the bytes axis at exactly half the f32
+            # 2-D program's.  This is the pod-scale configuration the
+            # rung exists for: subgroup scoping divides the payload by
+            # the mesh factor, bf16 halves what remains.
+            pcg_psums=2,
+            donate_leaves=_sharded_donation(),
+            allowed_kinds=("all_reduce", "reduce_scatter", "all_gather",
+                           "collective_permute"),
+            pcg_body_census=(("all_reduce", 2), ("reduce_scatter", 1),
+                             ("all_gather", 1), ("collective_permute", 1)),
+            pcg_subgroup_only=True,
+            bf16_surface=Bf16Surface(collectives=True),
+            build=lambda: _lower_ba(world=4, use_tiled=False,
+                                    mesh2d=True, bf16=True)),
         "ba_batched_b4_f32": ProgramSpec(
             name="ba_batched_b4_f32", float_family="f32", world=1,
             # The batched program is a vmap over a LANE axis on one

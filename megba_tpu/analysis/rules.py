@@ -10,6 +10,7 @@ Rule ids are kebab-case; suppress one finding with an inline
 | implicit-dtype | `jnp.zeros/ones/empty/full/arange/eye/linspace/identity` must state a dtype (keyword or the documented positional slot); `jnp.array`/`jnp.asarray` of pure Python literals too — the f32 default silently breaks the f64/f32 parity evidence (DOUBLE_PARITY.json) |
 | scalar-promotion | no strongly-typed scalar constructors (`np.float64(x)`, `jnp.int32(k)`, ...) as operands of array arithmetic in jit-reachable code — unlike weak Python scalars they promote the whole expression's dtype |
 | donated-reuse | an argument passed at a `donate_argnums` position of a locally-built `jax.jit` program must not be read after the call — the buffer is deleted by the call |
+| weak-literal | no BARE float literal as a `jnp.where` branch or `jnp.clip` bound in jit-reachable code — probed on this jaxlib: under x64 those positions materialise a `tensor<f64>` constant (plus a convert) in f32 programs, the dtype-census leak hand-fixed in PRs 3 and 6 (`jnp.where(safe, θ², 1.0)`, `jnp.where(..., 0.0, ...)`); use `zeros_like`/`ones_like`/`jnp.asarray(c, x.dtype)`.  Plain arithmetic (`2.0 * x`) and `jnp.maximum/minimum` literals promote weakly and are clean — the rule matches only the probed leaky positions |
 """
 
 from __future__ import annotations
@@ -58,6 +59,7 @@ ALL_RULES = (
     "implicit-dtype",
     "scalar-promotion",
     "donated-reuse",
+    "weak-literal",
 )
 
 
@@ -222,6 +224,56 @@ def rule_scalar_promotion(index: PackageIndex) -> Iterator[Finding]:
                         "jnp.asarray(x, arr.dtype) instead")
 
 
+def _float_literal(node: ast.AST) -> bool:
+    """A bare Python float literal (optionally signed) — the weak
+    scalar that materialises as a wide constant in the leaky call
+    positions."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        return _float_literal(node.operand)
+    return False
+
+
+# call tail -> the positional argument slots whose bare float literals
+# leak (jnp.where branches; jnp.clip bounds) + the keyword spellings of
+# the same slots.
+_WEAK_LITERAL_SLOTS = {
+    "where": ((1, 2), ("x", "y")),
+    "clip": ((1, 2), ("a_min", "a_max", "min", "max")),
+}
+
+
+def rule_weak_literal(index: PackageIndex) -> Iterator[Finding]:
+    # ALL functions, not just the jit-reachable set: the leak class was
+    # found in the ANALYTICAL Jacobian chain (ops/geo.py), which is
+    # jitted through an engine reference the call graph cannot follow —
+    # exactly the blind spot that let it survive PR 3's census fixes.
+    for qual, info in sorted(index.functions.items()):
+        mod = index.modules[info.module]
+        for node in _own_nodes(info):
+            if not isinstance(node, ast.Call):
+                continue
+            full = _alias_target(mod, _dotted(node.func)) or ""
+            head, _, tail = full.rpartition(".")
+            if head not in _JNP_HEADS or tail not in _WEAK_LITERAL_SLOTS:
+                continue
+            slots, kwnames = _WEAK_LITERAL_SLOTS[tail]
+            hits = [node.args[p] for p in slots if p < len(node.args)
+                    and _float_literal(node.args[p])]
+            hits += [kw.value for kw in node.keywords
+                     if kw.arg in kwnames and _float_literal(kw.value)]
+            for h in hits:
+                yield Finding(
+                    mod.path, h.lineno, h.col_offset, "weak-literal",
+                    f"bare float literal as a `jnp.{tail}` "
+                    f"{'branch' if tail == 'where' else 'bound'} "
+                    "materialises a wide (f64-under-x64) constant "
+                    "tensor in f32 programs (dtype-census leak); use "
+                    "zeros_like/ones_like or jnp.asarray(c, x.dtype)")
+
+
 def rule_donated_reuse(index: PackageIndex) -> Iterator[Finding]:
     for qual, info in sorted(index.functions.items()):
         mod = index.modules[info.module]
@@ -311,4 +363,5 @@ RULES = {
     "implicit-dtype": rule_implicit_dtype,
     "scalar-promotion": rule_scalar_promotion,
     "donated-reuse": rule_donated_reuse,
+    "weak-literal": rule_weak_literal,
 }

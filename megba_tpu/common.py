@@ -346,6 +346,34 @@ class SolverOption:
     # paths (the 2-D plan orders its streams co-observation-first
     # regardless).
     edge_order: EdgeOrder = EdgeOrder.NATURAL
+    # bf16 MXU pipeline (the precision-ladder rung below
+    # ProblemOption.mixed_precision_pcg — ARCHITECTURE.md "Precision
+    # ladder").  `bf16=True` stores the EQUILIBRATED per-edge coupling
+    # operands (W or Jc/Jp rows) AND the block-diagonal preconditioner
+    # in bfloat16 and feeds them to the products AS bf16 — per-edge
+    # multiplies run on bf16 operands (the MXU operand format) with
+    # every accumulation upcast to float32 first (the f32-accumulated
+    # bf16-contraction discipline of the TPU distributed-linear-algebra
+    # playbook, arXiv 2112.09017), where the mixed rung upcasts the
+    # stored rows BEFORE multiplying.  Krylov vectors, CG scalars
+    # (compensated dots), the Hessian build, the reduced RHS /
+    # back-substitution and every coarse-space build stay float32: the
+    # allowed-bf16 surface is exactly the census the HLO auditor pins
+    # (analysis/program_audit.Bf16Surface).  f32 problems only (refused
+    # typed on f64); Schur path only; forces the non-tiled XLA
+    # lowering (flat_solve).
+    bf16: bool = False
+    # Separately gated second half of the rung: cast the IN-BODY
+    # collective payloads (the two S·p psums on the 1-D mesh; the
+    # psum_scatter / psum / permute / all_gather stages of the 2-D
+    # matvec) to bf16 on the wire — halving `collective_bytes_per_sp`,
+    # the budget-gate axis that dominates pod-scale iteration time.
+    # The cross-shard reduction then accumulates in bf16 (unlike the
+    # on-device f32 sums), which is why it is its own gate: requires
+    # bf16=True, and the once-per-solve psums (Schur build, reduced
+    # RHS, coarse builds, back-substitution) always stay full
+    # precision.
+    bf16_collectives: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -521,5 +549,30 @@ def validate_options(option: ProblemOption) -> None:
         raise ValueError(
             "mixed_precision_pcg is only implemented for the Schur solver "
             "(use_schur=True)")
+    if option.solver_option.bf16:
+        if not option.use_schur:
+            raise ValueError(
+                "SolverOption.bf16 is only implemented for the Schur "
+                "solver (use_schur=True); the plain full-system path has "
+                "no equilibrated coupling operands to halve")
+        if np.dtype(option.dtype) != np.float32:
+            raise ValueError(
+                "SolverOption.bf16 runs the float32 pipeline with bf16 "
+                "coupling storage; a float64 problem asking for bf16 "
+                "operands would silently discard the precision it asked "
+                f"for — got dtype={np.dtype(option.dtype).name} (solve "
+                "f64 without bf16, or cast the problem to f32)")
+        if option.mixed_precision_pcg:
+            raise ValueError(
+                "SolverOption.bf16 and ProblemOption.mixed_precision_pcg "
+                "are different rungs of the same precision ladder (bf16 "
+                "multiplies in bf16 with f32 accumulation; mixed upcasts "
+                "the stored rows before multiplying) — pick one")
+    if option.solver_option.bf16_collectives and not option.solver_option.bf16:
+        raise ValueError(
+            "bf16_collectives compresses the in-body collective payloads "
+            "of the bf16 matvec pipeline; it requires SolverOption."
+            "bf16=True (the storage rung) — enabling it alone would halve "
+            "wire traffic of products that never went bf16")
     if np.dtype(option.dtype) not in DTYPE_TO_JAX:
         raise ValueError(f"unsupported dtype {option.dtype}")

@@ -134,6 +134,12 @@ class FactorSpec:
     point_coupled: bool = True
     triage: Optional[FactorTriage] = None
     description: str = ""
+    # Per-factor PCG refuse_ratio default (None = the SolverOption
+    # class default applies).  A family whose block structure makes
+    # the preconditioned residual energy legitimately NON-monotone
+    # names its own guard band here, so callers need not know the
+    # stall exists — see `resolve_refuse_ratio`.
+    refuse_ratio: Optional[float] = None
 
     kind = "schur"
 
@@ -162,6 +168,14 @@ class PoseFactorSpec:
     residual_dim: int
     residual_fn: Callable
     description: str = ""
+    # Per-factor PCG refuse_ratio default — the PR 13 measured finding
+    # institutionalised: the reference's refuse_ratio=1.0 stalls 7-dof
+    # sim(3) inner solves on their FIRST iteration (mixed rot/trans/
+    # log-scale blocks make preconditioned rho non-monotone, the refuse
+    # guard restores dx=0 and LM flatlines ~10x above the optimum);
+    # the sim3 spec declares 16.0 so the DEFAULT configuration solves,
+    # instead of requiring every caller to rediscover the stall.
+    refuse_ratio: Optional[float] = None
 
     kind = "pose_graph"
 
@@ -238,6 +252,48 @@ def require_pose_graph(spec: AnySpec, where: str) -> PoseFactorSpec:
             "family; solve it with megba_tpu.solve.flat_solve / "
             "solve_many(factor=...), not the pose-graph driver")
     return spec  # type: ignore[return-value]
+
+
+def resolve_refuse_ratio(spec: AnySpec, solver_option) -> float:
+    """The effective PCG refuse_ratio for a solve of `spec`.
+
+    The factor's declared default (`spec.refuse_ratio`) applies exactly
+    when the caller left `SolverOption.refuse_ratio` at its CLASS
+    default (the reference's 1.0) — an explicitly configured value
+    always wins, including an explicit 1.0-via-replace (indistinguish-
+    able from the default by design: the class default IS the
+    reference semantics, and a caller who needs literal 1.0 on a
+    factor that declares its own band is overriding a measured stall —
+    they can pass 1.0 + epsilon or any other value to make the intent
+    unambiguous).  Factors with no declared default change nothing.
+    """
+    declared = getattr(spec, "refuse_ratio", None)
+    if declared is None:
+        return solver_option.refuse_ratio
+    from megba_tpu.common import SolverOption
+
+    class_default = dataclasses.fields(SolverOption)
+    default_value = next(f.default for f in class_default
+                         if f.name == "refuse_ratio")
+    if solver_option.refuse_ratio == default_value:
+        return float(declared)
+    return solver_option.refuse_ratio
+
+
+def apply_factor_solver_defaults(spec: AnySpec, option):
+    """Fold a factor's solver defaults into a ProblemOption.
+
+    Returns the option unchanged (same OBJECT — jit/program caches keep
+    their keys) when nothing resolves differently; otherwise a
+    dataclasses.replace'd copy.  Called by the driver seams
+    (models/pgo.solve_pgo, solve.flat_solve) after the spec resolves.
+    """
+    rr = resolve_refuse_ratio(spec, option.solver_option)
+    if rr == option.solver_option.refuse_ratio:
+        return option
+    return dataclasses.replace(
+        option, solver_option=dataclasses.replace(
+            option.solver_option, refuse_ratio=rr))
 
 
 def validate_factor_arrays(spec: FactorSpec, cameras, points, obs,

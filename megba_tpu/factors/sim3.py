@@ -61,6 +61,14 @@ SPEC = PoseFactorSpec(
     residual_fn=sim3_between_residual,
     description="scale-aware sim(3) PGO: pose [aa(3), t(3), log-scale], "
                 "error [log_SO3, t, dlog-scale]",
+    # PR 13 measured finding as a DEFAULT: the reference's
+    # refuse_ratio=1.0 fires on sim(3)'s first inner iteration (mixed
+    # rot/trans/log-scale blocks make preconditioned rho non-monotone),
+    # silently returning dx=0 and stalling LM ~10x above the optimum;
+    # 16 reaches machine-zero cost in 5 LM iterations with exact scale
+    # recovery.  Resolved by registry.resolve_refuse_ratio — an
+    # explicit caller setting still wins.
+    refuse_ratio=16.0,
 )
 
 

@@ -237,9 +237,17 @@ def solve_pgo(
     # Registry dispatch (lazy import: factors/pose_graph.py imports
     # THIS module at registration time).
     from megba_tpu.factors import get_factor
-    from megba_tpu.factors.registry import require_pose_graph
+    from megba_tpu.factors.registry import (
+        apply_factor_solver_defaults,
+        require_pose_graph,
+    )
 
     spec = require_pose_graph(get_factor(factor), "solve_pgo")
+    # Per-factor solver defaults (sim(3)'s refuse_ratio=16 — the PR 13
+    # stall finding): resolved BEFORE the program cache key is formed,
+    # so the default and an equivalent explicit setting share one
+    # compiled program.
+    option = apply_factor_solver_defaults(spec, option)
     pd, md, rd = spec.pose_dim, spec.meas_dim, spec.residual_dim
     if int(poses0.shape[1]) != pd:
         raise ValueError(

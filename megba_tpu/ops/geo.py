@@ -220,7 +220,7 @@ def drotated_dangle_axis(angle_axis: jnp.ndarray, pt: jnp.ndarray) -> jnp.ndarra
     """
     theta2 = jnp.dot(angle_axis, angle_axis)
     safe = theta2 > _SMALL_ANGLE
-    theta2_safe = jnp.where(safe, theta2, 1.0)
+    theta2_safe = jnp.where(safe, theta2, jnp.ones_like(theta2))
     R = angle_axis_to_rotation_matrix(angle_axis)
     W = skew(angle_axis)
     X = skew(pt)

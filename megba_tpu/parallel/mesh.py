@@ -63,6 +63,51 @@ EDGE_AXIS = "edges"
 CAM_AXIS = "cams"
 
 
+def collective_payload_cast(enabled: bool, compute_dtype=None):
+    """(down, up) casts around IN-BODY collective payloads.
+
+    The bf16-collective half of the bf16 MXU pipeline
+    (SolverOption.bf16_collectives): `down` casts a partial-sum payload
+    to bfloat16 just before it goes on the wire, `up` restores the f32
+    compute dtype on the reduced result — halving the bytes every
+    in-body psum / psum_scatter / ppermute / all_gather moves, the
+    `collective_bytes_per_sp` budget axis.  With `enabled=False` both
+    are identity functions that emit NO ops, so every existing program
+    lowers byte-identically.
+
+    The cross-shard reduction itself then runs on bf16 values (the
+    payload is summed as transmitted); the once-per-solve reductions
+    (Schur build, reduced RHS, coarse builds, back-substitution) never
+    ride this cast — solver/pcg.py scopes it to the S·p matvec the PCG
+    while body dispatches.
+
+    Probed hazard (jaxlib 0.4.36, XLA:CPU): the CPU backend's float
+    normalization pass promotes bf16 collectives back to f32 in the
+    compiled executable (the convert pair is fused across the
+    all-reduce), so on the CPU lane the wire payload this cast DECLARES
+    is not the payload that moves — the HLO auditor therefore prices
+    the declared (StableHLO) payload and pins it structurally
+    (analysis/program_audit.py), which is what a TPU lowering (native
+    bf16 collectives) executes.
+    """
+    if not enabled:
+        ident = _payload_identity
+        return ident, ident
+    cd = jnp.float32 if compute_dtype is None else compute_dtype
+
+    def down(x):
+        return x.astype(jnp.bfloat16)
+
+    def up(x):
+        return x.astype(cd)
+
+    return down, up
+
+
+def _payload_identity(x):
+    return x
+
+
 def mesh_axes(mesh: Mesh):
     """The lm_solve `axis_name` for this mesh: the single edge axis for
     the 1-D mesh (every historical program, byte-identical), the

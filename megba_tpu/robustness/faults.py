@@ -188,7 +188,8 @@ def fault_active(plan: FaultPlan, k) -> jax.Array:
 def poison_residuals(r: jax.Array, plan: FaultPlan, k) -> jax.Array:
     """Add the (window-gated) edge poison to the [od, nE] residual rows."""
     active = fault_active(plan, k)
-    poison = jnp.where(active, plan.edge_nan, 0.0).astype(r.dtype)
+    poison = jnp.where(active, plan.edge_nan,
+                       jnp.zeros_like(plan.edge_nan)).astype(r.dtype)
     return r + poison[None, :]
 
 
@@ -204,9 +205,11 @@ def poison_system(system, plan: FaultPlan, k):
         # system transform entirely — no dead multiply in the program.
         return system
     active = fault_active(plan, k)
-    scale = jnp.where(active & (plan.point_crush > 0), _CRUSH, 1.0)
+    dt = system.Hll.dtype
+    scale = jnp.where(active & (plan.point_crush > 0),
+                      jnp.asarray(_CRUSH, dt), jnp.asarray(1.0, dt))
     return dataclasses.replace(
-        system, Hll=system.Hll * scale[None, :].astype(system.Hll.dtype))
+        system, Hll=system.Hll * scale[None, :])
 
 
 def fault_partition_specs(edge_spec=None):

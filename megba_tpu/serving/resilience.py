@@ -171,6 +171,10 @@ class EscalationPolicy:
                 option, robust_option=dataclasses.replace(
                     option.robust_option, guards=True))
         if rung >= 2:
+            # Conservative rung: every precision shortcut off — the
+            # mixed rung AND the bf16 MXU pipeline (its collective
+            # compression rides along; bf16_collectives without bf16 is
+            # refused by validate_options).
             option = dataclasses.replace(
                 option, mixed_precision_pcg=False,
                 solver_option=dataclasses.replace(
@@ -178,6 +182,7 @@ class EscalationPolicy:
                     precond=PrecondKind.JACOBI,
                     preconditioner=PreconditionerKind.HPP,
                     forcing=False, warm_start=False,
+                    bf16=False, bf16_collectives=False,
                     max_iter=2 * option.solver_option.max_iter))
         if rung >= 3:
             option = dataclasses.replace(option, dtype=np.float64)

@@ -223,6 +223,13 @@ def flat_solve(
         factor_spec = require_schur(get_factor(factor), "flat_solve")
         validate_factor_arrays(factor_spec, cameras, points, obs,
                                where="flat_solve")
+        # Per-factor solver defaults (registry.resolve_refuse_ratio):
+        # no built-in Schur family declares one today, but a custom
+        # 7-dof-style factor that does gets the same treatment the
+        # sim(3) PGO family gets in solve_pgo.
+        from megba_tpu.factors.registry import apply_factor_solver_defaults
+
+        option = apply_factor_solver_defaults(factor_spec, option)
         if (option.robust_kind != RobustKind.NONE
                 and not factor_spec.robust_ok):
             raise FactorError(
@@ -308,6 +315,18 @@ def flat_solve(
                 "mesh_2d does not compose with the Pallas tiled plans "
                 "(use_tiled=True); the 2-D lowering has its own "
                 "camera-tile plan — pass use_tiled=False/None")
+        use_tiled = False
+    if option.use_schur and option.solver_option.bf16:
+        # The bf16 MXU pipeline rides the XLA lowering: the tiled
+        # coupling kernels (ops/segtiles) have no bf16 operand path, so
+        # the default-tiled TPU lane silently measuring f32 kernels
+        # would defeat the rung.  Explicit use_tiled=True is refused;
+        # the default resolves to the chunked build.
+        if use_tiled:
+            raise ValueError(
+                "SolverOption.bf16 does not compose with the tiled "
+                "plans (use_tiled=True); the bf16 coupling products "
+                "ride the XLA lowering — pass use_tiled=False/None")
         use_tiled = False
     if use_tiled is None:
         use_tiled = default_use_tiled(dtype)

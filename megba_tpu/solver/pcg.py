@@ -63,6 +63,17 @@ HI = jax.lax.Precision.HIGHEST
 # Absolute floor for the relative PCG threshold (guards rho0 == 0).
 _TINY_RHO = 1e-30
 
+# Relative-energy floor for the bf16 MXU pipeline's inner solve: the
+# bf16-operand matvec resolves residual NORMS down to ~several
+# eps_bf16 (eps_bf16 = 2⁻⁸ ≈ 3.9e-3, conditioning-amplified); energies
+# are norms squared, so relative thresholds below ~1e-3 (norm ~3e-2 —
+# still a conventional inexact-Newton forcing term) ask the inner
+# solve for digits the operator does not carry and spin it at its
+# noise floor until the breakdown guard fires.  Applied only under
+# tol_relative (schur_pcg_solve); measured on small noised BA systems
+# 1e-4 still stagnates, 1e-3 runs guard-clean.
+_BF16_TOL_FLOOR = 1e-3
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -105,41 +116,85 @@ def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
 # the 2-D tiled matvec (make_matvec_2d steps 1 and 4): ONE copy of each
 # W / Jc-Jp block-row layout (EXPLICIT rows W[a*pd+b]; Jacobian-mode
 # rows Jc[o*cd+a], Jp[o*pd+b]) so a layout change cannot silently land
-# on only one path.  `up` is the caller's mixed-precision upcast.
+# on only one path.  The three precision hooks come from
+# `_edge_precision`: `up` is applied to every stored row before it is
+# multiplied (the mixed-precision upcast), `acc` to every per-edge
+# PRODUCT before it enters a sum (the bf16 pipeline's f32-accumulation
+# upcast), `vec` to the gathered Krylov-vector rows / intermediates
+# (the bf16 pipeline's operand downcast).  In f32 and mixed modes `acc`
+# and `vec` are identities that emit no ops, so every pre-bf16 program
+# lowers byte-identically.
 
 
-def _edge_cam_to_pt_explicit(W, pe, cd, pd, up):
+def _ident(x):
+    return x
+
+
+def _edge_precision(mixed_precision: bool, bf16_ops: bool):
+    """(up, vec, acc) casts for the per-edge coupling products.
+
+    f32 (default):  multiply f32 x f32, accumulate f32 — all identity.
+    mixed:          stored rows are bf16; upcast BEFORE multiplying
+                    (f32 x f32 products — the PR-era mixed rung).
+    bf16 pipeline:  stored rows stay bf16, the gathered vector rows are
+                    downcast to bf16 (`vec`), products run bf16 x bf16
+                    (the MXU operand format) and every product is
+                    upcast to f32 (`acc`) before the tiny row sums and
+                    the edge-axis segment reductions accumulate it —
+                    bf16 storage, f32 accumulation.
+    """
+    if bf16_ops:
+        def vec(x):
+            return x.astype(jnp.bfloat16)
+
+        def acc(x):
+            return x.astype(jnp.float32)
+
+        return _ident, vec, acc
+    if mixed_precision:
+        def up(x):
+            return x.astype(jnp.float32)
+
+        return up, _ident, _ident
+    return _ident, _ident, _ident
+
+
+def _edge_cam_to_pt_explicit(W, pe, cd, pd, up, acc=_ident):
     """W^T applied per edge: [cd, nE] camera rows -> [pd, nE]."""
     return jnp.stack([
-        sum(up(W[a * pd + b]) * pe[a] for a in range(cd))
+        sum(acc(up(W[a * pd + b]) * pe[a]) for a in range(cd))
         for b in range(pd)
     ])
 
 
-def _edge_pt_to_cam_explicit(W, qe, cd, pd, up):
+def _edge_pt_to_cam_explicit(W, qe, cd, pd, up, acc=_ident):
     """W applied per edge: [pd, nE] point rows -> [cd, nE]."""
     return jnp.stack([
-        sum(up(W[a * pd + b]) * qe[b] for b in range(pd))
+        sum(acc(up(W[a * pd + b]) * qe[b]) for b in range(pd))
         for a in range(cd)
     ])
 
 
-def _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up):
-    """Jp^T (Jc p) per edge via the [od] residual components."""
-    u = [sum(up(Jc[o * cd + a]) * pe[a] for a in range(cd))
+def _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up, acc=_ident, vec=_ident):
+    """Jp^T (Jc p) per edge via the [od] residual components.
+
+    `vec` re-downcasts the f32-accumulated [od] intermediate before the
+    second product under the bf16 pipeline (bf16 operands throughout,
+    f32 sums only)."""
+    u = [vec(sum(acc(up(Jc[o * cd + a]) * pe[a]) for a in range(cd)))
          for o in range(od)]
     return jnp.stack([
-        sum(up(Jp[o * pd + b]) * u[o] for o in range(od))
+        sum(acc(up(Jp[o * pd + b]) * u[o]) for o in range(od))
         for b in range(pd)
     ])
 
 
-def _edge_pt_to_cam_fwd(Jc, Jp, qe, cd, pd, od, up):
+def _edge_pt_to_cam_fwd(Jc, Jp, qe, cd, pd, od, up, acc=_ident, vec=_ident):
     """Jc^T (Jp q) per edge via the [od] residual components."""
-    u = [sum(up(Jp[o * pd + b]) * qe[b] for b in range(pd))
+    u = [vec(sum(acc(up(Jp[o * pd + b]) * qe[b]) for b in range(pd)))
          for o in range(od)]
     return jnp.stack([
-        sum(up(Jc[o * cd + a]) * u[o] for o in range(od))
+        sum(acc(up(Jc[o * cd + a]) * u[o]) for o in range(od))
         for a in range(cd)
     ])
 
@@ -157,6 +212,8 @@ def make_coupling_matvecs(
     mixed_precision: bool = False,
     cam_sorted: bool = False,
     plans: Optional[DualPlans] = None,
+    bf16_ops: bool = False,
+    bf16_collectives: bool = False,
 ) -> Tuple[Callable[[jax.Array], jax.Array], Callable[[jax.Array], jax.Array]]:
     """Build hpl(q_pt [pd,Np])->[cd,Nc] and hlp(p_cam [cd,Nc])->[pd,Np].
 
@@ -178,12 +235,35 @@ def make_coupling_matvecs(
     computed after upcast to float32, so only the stored rows — the PCG's
     bandwidth-dominant traffic — are halved, while Krylov vectors,
     reductions and the preconditioner stay float32.
+
+    `bf16_ops` (SolverOption.bf16) is the rung below: the stored rows
+    stay bf16 THROUGH the multiply and the gathered Krylov-vector rows
+    are downcast to match — bf16 x bf16 products (the MXU operand
+    format, and half the HBM traffic of the edge-expanded transients)
+    with every accumulation upcast to f32 first (`_edge_precision`).
+    The segment reductions and psums still run on the f32-accumulated
+    rows unless `bf16_collectives` ALSO compresses the wire payload to
+    bf16 (parallel/mesh.collective_payload_cast) — schur_pcg_solve
+    builds the compressed pair only for the S·p matvec the PCG body
+    dispatches, never for the once-per-solve RHS/back-substitution
+    products.  Requires the XLA (plans=None) lowering.
     """
-    def up(x):
-        return x.astype(jnp.float32) if mixed_precision else x
+    up, vec, acc = _edge_precision(mixed_precision, bf16_ops)
+    if bf16_ops and plans is not None and compute_kind != ComputeKind.EXPLICIT:
+        raise NotImplementedError(
+            "SolverOption.bf16 does not compose with the tiled "
+            "coupling kernels in IMPLICIT mode (ops/segtiles."
+            "coupling_expand has no bf16 operand path); lower with "
+            "use_tiled=False — flat_solve does this automatically")
+    from megba_tpu.parallel.mesh import collective_payload_cast
+
+    wire_down, wire_up = collective_payload_cast(
+        bf16_collectives and axis_name is not None)
 
     def psum(x):
-        return jax.lax.psum(x, axis_name) if axis_name is not None else x
+        if axis_name is None:
+            return x
+        return wire_up(jax.lax.psum(wire_down(x), axis_name))
 
     if plans is not None:
         uk = plans.use_kernels
@@ -194,16 +274,16 @@ def make_coupling_matvecs(
             def hlp(p_cam: jax.Array) -> jax.Array:
                 cd = p_cam.shape[0]
                 pd = cdpd // cd
-                pe = seg_expand(p_cam, plans.cam, uk)  # [cd, nCamSlots]
-                te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up)
+                pe = vec(seg_expand(p_cam, plans.cam, uk))  # [cd, nCamSlots]
+                te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up, acc)
                 return psum(seg_reduce(plans.to_pt(te), plans.pt, uk))
 
             def hpl(q_pt: jax.Array) -> jax.Array:
                 pd = q_pt.shape[0]
                 cd = cdpd // pd
-                qe = plans.to_cam(
-                    seg_expand(q_pt, plans.pt, uk))  # [pd, nCamSlots]
-                te = _edge_pt_to_cam_explicit(W, qe, cd, pd, up)
+                qe = vec(plans.to_cam(
+                    seg_expand(q_pt, plans.pt, uk)))  # [pd, nCamSlots]
+                te = _edge_pt_to_cam_explicit(W, qe, cd, pd, up, acc)
                 return psum(seg_reduce(te, plans.cam, uk))
 
         else:
@@ -243,15 +323,15 @@ def make_coupling_matvecs(
         def hlp(p_cam: jax.Array) -> jax.Array:
             cd = p_cam.shape[0]
             pd = cdpd // cd
-            pe = gather_fm(p_cam, cam_idx)  # [cd, nE]
-            te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up)
+            pe = vec(gather_fm(p_cam, cam_idx))  # [cd, nE]
+            te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up, acc)
             return psum(segsum_fm(te, pt_idx, num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
             pd = q_pt.shape[0]
             cd = cdpd // pd
-            qe = gather_fm(q_pt, pt_idx)  # [pd, nE]
-            te = _edge_pt_to_cam_explicit(W, qe, cd, pd, up)
+            qe = vec(gather_fm(q_pt, pt_idx))  # [pd, nE]
+            te = _edge_pt_to_cam_explicit(W, qe, cd, pd, up, acc)
             return psum(segsum_fm(te, cam_idx, num_cameras,
                                   indices_are_sorted=cam_sorted))
 
@@ -262,16 +342,16 @@ def make_coupling_matvecs(
             cd = p_cam.shape[0]
             od = ocd // cd
             pd = opd // od
-            pe = gather_fm(p_cam, cam_idx)
-            te = _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up)
+            pe = vec(gather_fm(p_cam, cam_idx))
+            te = _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up, acc, vec)
             return psum(segsum_fm(te, pt_idx, num_points))
 
         def hpl(q_pt: jax.Array) -> jax.Array:
             pd = q_pt.shape[0]
             od = opd // pd
             cd = ocd // od
-            qe = gather_fm(q_pt, pt_idx)
-            te = _edge_pt_to_cam_fwd(Jc, Jp, qe, cd, pd, od, up)
+            qe = vec(gather_fm(q_pt, pt_idx))
+            te = _edge_pt_to_cam_fwd(Jc, Jp, qe, cd, pd, od, up, acc, vec)
             return psum(segsum_fm(te, cam_idx, num_cameras,
                                   indices_are_sorted=cam_sorted))
 
@@ -291,6 +371,8 @@ def make_matvec_2d(
     compute_kind: ComputeKind,
     axis_name,
     mixed_precision: bool = False,
+    bf16_ops: bool = False,
+    bf16_collectives: bool = False,
 ):
     """Build the fused 2-D Schur matvec S·p (camera x edge mesh).
 
@@ -340,6 +422,17 @@ def make_matvec_2d(
     scenes): the per-column summation grouping differs, and a PCG run
     to stagnation resolves the operator's own rounding, not the
     grouping (tests/test_mesh2d.py compose test pins this at 1e-2).
+
+    `bf16_ops` / `bf16_collectives` are the bf16 MXU pipeline
+    (SolverOption.bf16 / .bf16_collectives): the per-edge coupling
+    products run on bf16 operands with f32 accumulation
+    (`_edge_precision`, the same discipline as the 1-D closures), and
+    the collective gate additionally casts EVERY payload of this
+    matvec's in-body collectives — the camera psum_scatter, both
+    edge-subgroup psums, the C-1 ring permutes and the final camera
+    all_gather — to bf16 on the wire, halving the already-subgroup-
+    scoped `collective_bytes_per_sp` once more.  Both gates off lower
+    byte-identically to the PR 14 pipeline.
     """
     edge_axis, cam_axis = axis_name
     C = tile_plan.cam_blocks
@@ -351,8 +444,10 @@ def make_matvec_2d(
     ocd = None if Jc is None else Jc.shape[0]
     opd = None if Jp is None else Jp.shape[0]
 
-    def up(x):
-        return x.astype(jnp.float32) if mixed_precision else x
+    up, vec, pacc = _edge_precision(mixed_precision, bf16_ops)
+    from megba_tpu.parallel.mesh import collective_payload_cast
+
+    wire_down, wire_up = collective_payload_cast(bf16_collectives)
 
     # Replicated solve quantities, padded once to the tile geometry so
     # tile/shard slices are static-shape.  Zero padding is inert: padded
@@ -369,26 +464,33 @@ def make_matvec_2d(
         p_pad = jnp.pad(p, ((0, 0), (0, nc_pad - num_cameras)))
         p_t = jax.lax.dynamic_slice_in_dim(p_pad, ci * Tc, Tc, axis=1)
         # (1) local camera gather + per-edge coupling product.
-        pe = gather_fm(p_t, tile_plan.cam_local)  # [cd, nE_loc]
+        pe = vec(gather_fm(p_t, tile_plan.cam_local))  # [cd, nE_loc]
         if compute_kind == ComputeKind.EXPLICIT:
             pd = cdpd // cd
-            te = _edge_cam_to_pt_explicit(W, pe, cd, pd, up)  # [pd, nE_loc]
+            te = _edge_cam_to_pt_explicit(
+                W, pe, cd, pd, up, pacc)  # [pd, nE_loc]
         else:
             od = ocd // cd
             pd = opd // od
-            te = _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up)
-        # (2) point reduction: scatter over CAM, reduce over EDGE.
+            te = _edge_cam_to_pt_fwd(Jc, Jp, pe, cd, pd, od, up, pacc, vec)
+        # (2) point reduction: scatter over CAM, reduce over EDGE — the
+        # wire casts compress both stage payloads to bf16 under the
+        # collective gate (the shard stays compressed between the two).
         t_part = segsum_fm(te, pt_idx, np_pad)
-        t_sh = jax.lax.psum_scatter(t_part, cam_axis,
+        t_sh = jax.lax.psum_scatter(wire_down(t_part), cam_axis,
                                     scatter_dimension=1, tiled=True)
-        t_sh = jax.lax.psum(t_sh, edge_axis)  # [pd, Sp] owned shard
+        t_sh = wire_up(jax.lax.psum(t_sh, edge_axis))  # [pd, Sp] owned shard
         # (3) Hll^-1 on the owned shard.
         hll_sh = jax.lax.dynamic_slice_in_dim(
             Hll_inv_pad, ci * Sp, Sp, axis=1)
         cur = block_matvec_fm(hll_sh, t_sh)
         # (4) double-buffered tile loop: issue the fetch of shard j+1,
-        # THEN contract shard j's co-observation bucket.
-        acc = jnp.zeros((cd, Tc), p.dtype)
+        # THEN contract shard j's co-observation bucket.  Under the
+        # collective gate the rotating point shard rides the ring as
+        # bf16 (each permute moves half the bytes); the contraction
+        # consumes it through the same bf16-operand policy as step 1.
+        cur = wire_down(cur)
+        tile_acc = jnp.zeros((cd, Tc), p.dtype)
         for j in range(C):
             nxt = (jax.lax.ppermute(cur, cam_axis, perm=ring)
                    if j < C - 1 else cur)
@@ -398,27 +500,30 @@ def make_matvec_2d(
             ptl = jax.lax.dynamic_slice_in_dim(
                 tile_plan.bucket_ptl, s, 1, axis=0)[0]
             mk = jax.lax.dynamic_slice_in_dim(
-                tile_plan.bucket_mask, s, 1, axis=0)[0].astype(p.dtype)
-            qe = gather_fm(cur, ptl) * mk  # [pd, Lb]
+                tile_plan.bucket_mask, s, 1, axis=0)[0]
+            cur_g = vec(gather_fm(cur, ptl))
+            qe = cur_g * mk.astype(cur_g.dtype)  # [pd, Lb]
             if compute_kind == ComputeKind.EXPLICIT:
                 Wg = up(jnp.take(W, slot, axis=1))
                 contrib = _edge_pt_to_cam_explicit(
-                    Wg, qe, cd, pd, lambda x: x)
+                    Wg, qe, cd, pd, _ident, pacc)
             else:
                 Jcg = up(jnp.take(Jc, slot, axis=1))
                 Jpg = up(jnp.take(Jp, slot, axis=1))
                 contrib = _edge_pt_to_cam_fwd(
-                    Jcg, Jpg, qe, cd, pd, od, lambda x: x)
+                    Jcg, Jpg, qe, cd, pd, od, _ident, pacc, vec)
             cl = jnp.take(tile_plan.cam_local, slot)
-            acc = acc + segsum_fm(contrib.astype(p.dtype), cl, Tc)
+            tile_acc = tile_acc + segsum_fm(contrib.astype(p.dtype), cl, Tc)
             cur = nxt
         # (5) camera reduction: EDGE-subgroup psum of the tile, one
-        # all_gather over CAM re-replicates.
-        hpl_t = jax.lax.psum(acc, edge_axis)
+        # all_gather over CAM re-replicates (both payloads wire-cast
+        # under the collective gate).
+        hpl_t = wire_up(jax.lax.psum(wire_down(tile_acc), edge_axis))
         y_t = cam_block_matvec(
             jax.lax.dynamic_slice_in_dim(Hpp_pad, ci * Tc, Tc, axis=0),
             p_t) - hpl_t
-        y = jax.lax.all_gather(y_t, cam_axis, axis=1, tiled=True)
+        y = wire_up(jax.lax.all_gather(wire_down(y_t), cam_axis,
+                                       axis=1, tiled=True))
         return y[:, :num_cameras]
 
     return s_matvec
@@ -428,7 +533,7 @@ def make_matvec_2d(
 # a navigable label in profiler traces — see observability/__init__.py.
 @jax.named_scope("megba.pcg_core")
 def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
-              x0=None, guard=False, max_restarts=0):
+              x0=None, guard=False, max_restarts=0, fused=True):
     """Preconditioned CG over an arbitrary pytree "vector".
 
     One implementation of the reference's stopping + refuse semantics
@@ -473,6 +578,24 @@ def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
     would therefore either exit spuriously after 0 iterations or
     over-solve relative to an already-tiny baseline.  For x0=None the
     two anchors coincide bitwise (r0 = b).
+
+    `fused=False` selects the TEXTBOOK-recurrence body (the bf16 MXU
+    pipeline's body): the Chronopoulos-Gear fusion carries s = A·p by
+    LINEARITY (s ← w + beta s), and a bf16-operand matvec is slightly
+    nonlinear in its input (the vector is rounded to bf16 per apply),
+    so the carried s drifts from the true A·p by ~eps_bf16 per
+    iteration — measured on small BA systems the fused recurrence
+    collapses (negative gamma/delta, garbage iterates) within ~20
+    iterations.  The textbook body recomputes s = A·p FRESH each
+    iteration: same per-iteration op counts (one matvec, one precond
+    apply, two compensated dots) and the matvec stays the only
+    collective site (2 all-reduces per S·p in the body — the
+    `ba_bf16_w2_f32` canonical program pins it), the dots are merely
+    sequential instead of back-to-back.  Warm starts, refuse, guards
+    and restarts keep their semantics; a guarded restart costs ONE
+    body iteration here (classic CG restarts by refreshing r = b - A x
+    and re-seeding p = M⁻¹ r — there is no auxiliary recurrence to
+    re-prime).
     """
     tm = jax.tree_util.tree_map
 
@@ -523,6 +646,12 @@ def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
                     jnp.asarray(_TINY_RHO, rho0.dtype))
         if tol_relative else tol
     )
+
+    if not fused:
+        return _pcg_core_classic(
+            matvec, precond, b, max_iter, threshold, refuse_ratio,
+            x_init, r0, u0, rho0, rhs_energy, r0_ratio,
+            guard, max_restarts, tdot, axpy, select)
 
     # Prime the Chronopoulos-Gear recurrence: p0 = u0, s0 = A p0,
     # alpha0 = rho0 / <p0, A p0> — exactly classic CG's first alpha.
@@ -644,6 +773,142 @@ def _pcg_core(matvec, precond, b, max_iter, tol, refuse_ratio, tol_relative,
             restarts, broken)
 
 
+def _pcg_core_classic(matvec, precond, b, max_iter, threshold, refuse_ratio,
+                      x_init, r0, u0, rho0, rhs_energy, r0_ratio,
+                      guard, max_restarts, tdot, axpy, select):
+    """The textbook-recurrence PCG body (`_pcg_core(fused=False)`).
+
+    Iterates are textbook PCG: p ← u + beta p, s = A p computed FRESH,
+    alpha = rho / <p, s>.  Same per-iteration op census as the fused
+    body (one matvec — the only collective site — one precond apply,
+    two compensated dots); no priming matvec is needed (there is no
+    auxiliary recurrence), so the matvec count is exactly k (+1 per
+    warm start / restart refresh).  Stopping, refuse-best-iterate,
+    breakdown-guard and restart semantics mirror the fused body; a
+    guarded restart is ONE iteration whose matvec slot computes A x
+    for the residual refresh r = b - A x, p = M⁻¹ r.
+
+    This body exists for the bf16 MXU pipeline, whose operand-rounded
+    matvec is nonlinear at the bf16-eps scale — see _pcg_core's
+    docstring for why the fused recurrence collapses there.
+
+    STAGNATION-EXIT semantics (the precision-aware part): a FINITE
+    sign flip in the SPD scalars (gamma = <r, M⁻¹r> < 0 or
+    delta = <p, A p> < 0) is not treated as a recurrence fault — on a
+    bf16-operand operator it is the signature of the iterate reaching
+    the operator's resolution (the quadratic forms of an eps_bf16-
+    nonlinear apply go indefinite exactly when the residual
+    concentrates in directions the rounding can no longer resolve;
+    measured: restart-and-retry at that point re-breaks within a few
+    iterations and escalates into LM recoveries on perfectly clean
+    solves).  The solve instead restores the BEST iterate and exits —
+    the same restore-and-stop contract as the reference's refuse
+    guard, extended from "rho grew" to "rho left the SPD cone".
+    Non-finite scalars (actual poison) keep the full breakdown /
+    restart / broken ladder under `guard`.
+    """
+    def safe_div(num, den):
+        return num / jnp.where(den == 0, jnp.ones_like(den), den)
+
+    if not guard:
+        state0 = (jnp.int32(0), x_init, r0, u0, rho0,
+                  jnp.abs(rho0), x_init, jnp.bool_(False))
+
+        def cond(state):
+            k, _, _, _, rho, _, _, refused = state
+            return (k < max_iter) & (jnp.abs(rho) >= threshold) & (~refused)
+
+        def body(state):
+            k, x, r, p, rho, rho_min, x_best, refused = state
+            s = matvec(p)
+            delta = tdot(p, s)
+            alpha = safe_div(rho, delta)
+            x = axpy(alpha, p, x)
+            r = axpy(-alpha, s, r)
+            u = precond(r)
+            rho_new = tdot(r, u)
+            beta = safe_div(rho_new, rho)
+            p = axpy(beta, p, u)  # u + beta p
+            stall = (rho_new < 0) | (delta < 0)
+            refused = stall | (jnp.abs(rho_new) > refuse_ratio * rho_min)
+            improved = (~stall) & (jnp.abs(rho_new) < rho_min)
+            rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
+            x_best = select(improved, x, x_best)
+            return (k + 1, x, r, p, rho_new, rho_min, x_best, refused)
+
+        (k, x, _, _, rho, _, x_best, refused) = jax.lax.while_loop(
+            cond, body, state0)
+        return (select(~refused, x, x_best), k, rho, r0_ratio,
+                jnp.int32(0), jnp.bool_(False))
+
+    # ---- guarded classic body -------------------------------------------
+    # Two phases: 0 = normal step, 1 = restart refresh (the matvec slot
+    # computes A x; r := b - A x, p := M⁻¹ r — classic CG carries no
+    # auxiliary direction, so one refresh iteration fully restarts the
+    # recurrence).  Same census per iteration as the unguarded body; a
+    # phase-0 run with no breakdown selects the unguarded values.
+    threshold_arr = jnp.asarray(threshold, rho0.dtype)
+    keepalive = jnp.maximum(jnp.abs(rhs_energy), threshold_arr) * 2.0 + 1.0
+    minus_one = jnp.asarray(-1.0, rho0.dtype)
+
+    state0 = (jnp.int32(0), x_init, r0, u0, rho0,
+              jnp.abs(rho0), x_init, jnp.bool_(False),
+              jnp.int32(0), jnp.int32(0), jnp.bool_(False))
+
+    def cond(state):
+        k, _, _, _, rho, _, _, refused, _, _, broken = state
+        return ((k < max_iter) & (jnp.abs(rho) >= threshold)
+                & (~refused) & (~broken))
+
+    def body(state):
+        (k, x, r, p, rho, rho_min, x_best, refused,
+         phase, restarts, broken) = state
+        advancing = phase == 0
+        refresh = phase == 1
+        # The one matvec: A p normally, A x during the refresh.
+        w = matvec(select(refresh, x, p))
+        delta = tdot(p, w)  # garbage during refresh: masked below
+        alpha = safe_div(rho, delta)
+        step = jnp.where(advancing, alpha, jnp.zeros_like(alpha))
+        x_new = axpy(step, p, x)
+        r_new = select(refresh, axpy(minus_one, w, b),  # b - A x
+                       axpy(-step, w, r))
+        u = precond(r_new)
+        rho_new = tdot(r_new, u)
+        # Two distinct failure signatures (docstring): a FINITE sign
+        # flip of the SPD scalars is the bf16 operator's resolution
+        # floor — restore-best-and-stop via the refuse exit, no guard
+        # event; non-finite scalars are actual poison and ride the
+        # breakdown/restart/broken ladder.  A refresh iteration's
+        # delta is stale, but its r/u/rho_new are REAL (the refreshed
+        # residual) — so only advancing iterations classify.
+        finite = jnp.isfinite(rho_new) & jnp.isfinite(delta)
+        stall = advancing & finite & ((rho_new < 0) | (delta < 0))
+        breakdown = advancing & ~finite
+        enter = breakdown & (restarts < max_restarts)
+        broken = broken | (breakdown & (restarts >= max_restarts))
+        restarts = restarts + enter.astype(jnp.int32)
+        phase_next = jnp.where(enter, jnp.int32(1), jnp.int32(0))
+        ok_adv = advancing & ~breakdown & ~stall
+        x = select(ok_adv, x_new, x)
+        r = select(ok_adv | refresh, r_new, r)
+        beta = safe_div(rho_new, rho)
+        p = select(refresh, u, select(ok_adv, axpy(beta, p, u), p))
+        rho_next = jnp.where(enter, keepalive, rho_new)
+        refused = stall | (ok_adv
+                           & (jnp.abs(rho_new) > refuse_ratio * rho_min))
+        improved = ok_adv & (jnp.abs(rho_new) < rho_min)
+        rho_min = jnp.where(improved, jnp.abs(rho_new), rho_min)
+        x_best = select(improved, x, x_best)
+        return (k + 1, x, r, p, rho_next, rho_min, x_best, refused,
+                phase_next, restarts, broken)
+
+    (k, x, _, _, rho, _, x_best, refused, _, restarts,
+     broken) = jax.lax.while_loop(cond, body, state0)
+    return (select(~refused & ~broken, x, x_best), k, rho, r0_ratio,
+            restarts, broken)
+
+
 def plain_pcg_solve(
     system: SchurSystem,
     Jc: jax.Array,
@@ -670,6 +935,8 @@ def plain_pcg_solve(
     cam_fixed=None,
     smooth_omega: float = 0.0,
     tile_plan=None,
+    bf16: bool = False,
+    bf16_collectives: bool = False,
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -705,6 +972,10 @@ def plain_pcg_solve(
     if mixed_precision:
         raise NotImplementedError(
             "mixed_precision is only implemented for the Schur solver")
+    if bf16 or bf16_collectives:
+        raise NotImplementedError(
+            "SolverOption.bf16 is only implemented for the Schur solver "
+            "(validate_options refuses it with use_schur=False)")
 
     Hpp_d = damp_blocks(system.Hpp, region)
     Hll_d = damp_rows_fm(system.Hll, region)
@@ -760,6 +1031,8 @@ def schur_pcg_solve(
     cam_fixed=None,
     smooth_omega: float = 0.0,
     tile_plan=None,
+    bf16: bool = False,
+    bf16_collectives: bool = False,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -786,13 +1059,25 @@ def schur_pcg_solve(
     ops/segtiles.cached_multilevel_plan).  `smooth_omega` > 0 smooths
     the level-1 prolongator (smoothed aggregation) for both
     coarse-space kinds.
+
+    `bf16` / `bf16_collectives` (SolverOption.bf16 / .bf16_collectives)
+    select the bf16 MXU pipeline: the SAME Jacobi equilibration as
+    `mixed_precision` (bf16 needs well-ranged operands either way),
+    but the bf16 rows are fed to the products AS bf16 with f32
+    accumulation (`_edge_precision`), the block-diagonal preconditioner
+    apply runs on a bf16 copy of M⁻¹ with f32 accumulation
+    (solver/precond.py), and the collective gate compresses the S·p
+    matvec's in-body wire payloads to bf16 — while the reduced RHS,
+    the back-substitution and every coarse-space build keep
+    full-precision collectives (their hpl/hlp closures are built
+    uncompressed below).
     """
     # Retrace sentinel hook (analysis/retrace.py): counts only under an
     # active jax trace — eager calls are not compilations.
     note_trace("solver.schur_pcg", system.g_cam, system.g_pt, Jc, Jp,
                static=static_key(compute_kind, axis_name, mixed_precision,
                                  preconditioner, precond, neumann_order,
-                                 smooth_omega))
+                                 smooth_omega, bf16, bf16_collectives))
     num_cameras = system.Hpp.shape[0]
     num_points = system.Hll.shape[1]
     pd = int(round(system.Hll.shape[0] ** 0.5))
@@ -802,8 +1087,12 @@ def schur_pcg_solve(
     g_cam, g_pt = system.g_cam, system.g_pt
     W = system.W
 
+    # Both precision rungs equilibrate + bf16-cast the stored rows; they
+    # differ only in WHERE the upcast happens (before vs after the
+    # multiply — _edge_precision).
+    equil = mixed_precision or bf16
     d_cam = d_pt = None
-    if mixed_precision:
+    if equil:
         # Jacobi (scale-then-cast) equilibration: BA Jacobian columns span
         # ~6 orders of magnitude (rotation vs focal), far beyond bf16's
         # dynamic range.  Solve the symmetrically scaled system
@@ -852,7 +1141,7 @@ def schur_pcg_solve(
     hpl, hlp = make_coupling_matvecs(
         W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
         compute_kind, axis_name, mixed_precision=mixed_precision,
-        cam_sorted=cam_sorted, plans=plans,
+        cam_sorted=cam_sorted, plans=plans, bf16_ops=bf16,
     )
 
     if tile_plan is not None:
@@ -867,17 +1156,37 @@ def schur_pcg_solve(
         s_matvec = make_matvec_2d(
             W, Jc, Jp, tile_plan, pt_idx, Hpp_d, Hll_inv,
             num_cameras, num_points, compute_kind, axis_name,
-            mixed_precision=mixed_precision)
+            mixed_precision=mixed_precision, bf16_ops=bf16,
+            bf16_collectives=bf16_collectives)
     else:
+        if bf16_collectives and axis_name is not None:
+            # Compressed coupling pair for the S·p matvec ONLY: the
+            # in-body psums carry bf16 payloads while the reduced RHS /
+            # back-substitution products below keep the full-precision
+            # hpl/hlp (their psums run once per solve, not per
+            # iteration — compressing them buys nothing and costs
+            # accuracy exactly where the solution is assembled).
+            hpl_c, hlp_c = make_coupling_matvecs(
+                W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
+                compute_kind, axis_name, mixed_precision=mixed_precision,
+                cam_sorted=cam_sorted, plans=plans, bf16_ops=bf16,
+                bf16_collectives=True,
+            )
+        else:
+            hpl_c, hlp_c = hpl, hlp
+
         def s_matvec(p: jax.Array) -> jax.Array:
             # S p = Hpp_d p - Hpl Hll_d^-1 Hlp p     [2 psums]
-            t = block_matvec_fm(Hll_inv, hlp(p))
-            return cam_block_matvec(Hpp_d, p) - hpl(t)
+            t = block_matvec_fm(Hll_inv, hlp_c(p))
+            return cam_block_matvec(Hpp_d, p) - hpl_c(t)
 
     # Preconditioner operator family (solver/precond.py).  The
     # correction/coarse rows are always accumulated in full precision
-    # (any bf16 operands are upcast inside the builds), so no precision
-    # flag is threaded through.  JACOBI reproduces the historical
+    # (any bf16 operands are upcast inside the builds); the only
+    # precision flag threaded through is the bf16 pipeline's
+    # block-diagonal APPLY (bf16 M⁻¹ copy, f32-accumulated einsum —
+    # the coarse cycles smooth with it but assemble/solve their coarse
+    # systems in f32).  JACOBI reproduces the historical
     # solver bitwise; `precond_fallback` is the enum-coded per-level
     # fallback count (two-level -> block-Jacobi, SCHUR_DIAG block ->
     # Hpp).
@@ -886,24 +1195,35 @@ def schur_pcg_solve(
         cam_idx, pt_idx, num_cameras, compute_kind, axis_name,
         cam_sorted, neumann_order=neumann_order, plans=plans,
         cluster_plan=cluster_plan, cam_fixed=cam_fixed,
-        s_matvec=s_matvec, smooth_omega=smooth_omega)
+        s_matvec=s_matvec, smooth_omega=smooth_omega, bf16=bf16)
 
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
     v = g_cam - hpl(block_matvec_fm(Hll_inv, g_pt))
 
-    if x0 is not None and mixed_precision:
+    if x0 is not None and equil:
         # The CG runs in the symmetrically scaled variables x~ = x / d;
         # bring the (original-variable) warm start over.
         x0 = x0 / d_cam
 
+    if bf16 and tol_relative:
+        # Attainable-accuracy floor: a bf16-operand operator cannot
+        # resolve relative preconditioned-residual energies below
+        # ~eps_bf16² — an Eisenstat-Walker eta driven under the floor
+        # (eta_min defaults to 1e-6) would spin the inner solve at its
+        # noise floor for the full budget.  Clamp the RELATIVE
+        # threshold only; an absolute `tol` has no scale to clamp
+        # against (the refuse guard handles stagnation there).
+        tol = jnp.maximum(jnp.asarray(tol, v.dtype),
+                          jnp.asarray(_BF16_TOL_FLOOR, v.dtype))
+
     x, k, rho, r0_ratio, restarts, broken = _pcg_core(
         s_matvec, precond_apply, v,
         max_iter, tol, refuse_ratio, tol_relative, x0=x0,
-        guard=guard, max_restarts=max_restarts)
+        guard=guard, max_restarts=max_restarts, fused=not bf16)
 
     # Back-substitute the point update       [1 psum]
     dx_pt = block_matvec_fm(Hll_inv, g_pt - hlp(x))
-    if mixed_precision:
+    if equil:
         x = x * d_cam  # unscale back to the original variables
         dx_pt = dx_pt * d_pt
     return PCGResult(dx_cam=x, dx_pt=dx_pt, iterations=k, rho=rho,

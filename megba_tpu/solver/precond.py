@@ -209,6 +209,23 @@ def cam_block_matvec(H: jax.Array, x: jax.Array) -> jax.Array:
     return jnp.einsum("nij,jn->in", H, x, precision=HI)
 
 
+def cam_block_matvec_bf16(H_bf16: jax.Array, x: jax.Array) -> jax.Array:
+    """The bf16-MXU-pipeline block apply: bf16 blocks x bf16 rows with
+    f32 accumulation.
+
+    `H_bf16` is a bfloat16 copy of the (equilibrated, unit-diagonal —
+    well-ranged by construction) inverted block diagonal; `x` is the
+    f32 residual, downcast at the operand boundary.  The contraction
+    dtype is forced to float32 via `preferred_element_type` — on TPU
+    this is EXACTLY the native MXU contract (bf16 operands, f32
+    accumulator); default precision, not HIGHEST: a multi-pass
+    bf16_3x decomposition would re-spend the bandwidth the bf16
+    storage just saved.  Returns f32 rows.
+    """
+    return jnp.einsum("nij,jn->in", H_bf16, x.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
 def block_inv(H: jax.Array) -> jax.Array:
     """Batched inverse of SPD camera blocks [N, d, d] via Cholesky.
 
@@ -874,6 +891,7 @@ def make_schur_preconditioner(
     cam_fixed=None,
     s_matvec: Optional[Callable[[jax.Array], jax.Array]] = None,
     smooth_omega: float = 0.0,
+    bf16: bool = False,
 ) -> Tuple[Callable[[jax.Array], jax.Array], jax.Array]:
     """Build the reduced-system preconditioner apply for one solve.
 
@@ -887,6 +905,17 @@ def make_schur_preconditioner(
     `cluster_plan` is a DeviceClusterPlan for TWO_LEVEL, a
     DeviceMultiLevelPlan for MULTILEVEL; `smooth_omega` > 0 turns on
     the smoothed-aggregation prolongator for both coarse-space kinds.
+
+    `bf16` (SolverOption.bf16) stores the inverted block diagonal as a
+    bfloat16 copy and applies it through `cam_block_matvec_bf16` (bf16
+    operands, f32 accumulation via preferred_element_type) — the base
+    apply is the per-iteration bandwidth-heavy operand of every family
+    (Nc·cd² block bytes per CG step), and the equilibrated M⁻¹ is
+    unit-scale, well inside bf16's range.  The block diagonal itself
+    (and the SCHUR_DIAG correction, the coarse Galerkin builds, and
+    every coarse solve) is still COMPUTED in f32; only the apply's
+    stored operand narrows — the allowed-surface contract the HLO
+    auditor pins.
     """
     if block_kind == PreconditionerKind.SCHUR_DIAG:
         Minv, n_bad = _schur_diag_precond(
@@ -896,8 +925,14 @@ def make_schur_preconditioner(
         Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
         n_bad = jnp.int32(0)
 
-    def base_apply(r):
-        return cam_block_matvec(Minv, r)
+    if bf16:
+        Minv_bf16 = Minv.astype(jnp.bfloat16)
+
+        def base_apply(r):
+            return cam_block_matvec_bf16(Minv_bf16, r)
+    else:
+        def base_apply(r):
+            return cam_block_matvec(Minv, r)
 
     if kind == PrecondKind.JACOBI:
         return base_apply, encode_precond_fallback(n_bad)
@@ -973,6 +1008,7 @@ __all__ = [
     "build_multilevel_coarse",
     "build_two_level_coarse",
     "cam_block_matvec",
+    "cam_block_matvec_bf16",
     "decode_precond_fallback",
     "decode_precond_fallback_levels",
     "encode_precond_fallback",

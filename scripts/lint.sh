@@ -1,7 +1,7 @@
 #!/bin/bash
 # Static-analysis + sanitizer lane (megba_tpu/analysis/).
 #
-# Four gates, all required (scripts/run_tests.sh invokes this, so
+# Five gates, all required (scripts/run_tests.sh invokes this, so
 # tier-1 cannot pass with a violation in any of them):
 #
 #   1. the JAX-contract linter runs CLEAN on the package;
@@ -12,8 +12,15 @@
 #   4. the compiled-program auditor: AOT-lower + compile the canonical
 #      solver programs on CPU and audit the emitted HLO for host
 #      transfers, the per-PCG-iteration collective pattern, dtype
-#      leaks, materialised donation, and FLOP/byte drift against the
-#      committed ANALYSIS_BUDGET.json (no solver execution involved).
+#      leaks, the allowed-bf16 surface, materialised donation, and
+#      FLOP/byte drift against the committed ANALYSIS_BUDGET.json (no
+#      solver execution involved);
+#   5. the weak-literal dtype-leak lane: the AST rule for the bug class
+#      hand-fixed in PRs 3 and 6 (bare float literals in jnp.where
+#      branches / jnp.clip bounds materialise f64 constants under x64)
+#      run standalone over the package — gate 1 includes it, but this
+#      lane keeps the dtype-surface story visible as its own step
+#      beside gate 4's bf16 surface census.
 set -e -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -35,5 +42,8 @@ JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 python -m megba_tpu.analysis.strict_dtype
 
 echo "[lint] compiled-program audit (HLO census + AOT budget gate)"
 python -m megba_tpu.analysis.audit --check
+
+echo "[lint] weak-literal dtype-leak lane (lane 5)"
+python -m megba_tpu.analysis.lint --rule weak-literal megba_tpu/
 
 echo "lint lane OK"

@@ -56,12 +56,15 @@ echo "observability smoke OK"
 # solved as a serial flat_solve loop vs one batched solve_many pass
 # (serving layer) — steady-state batched problems/sec must strictly
 # beat the serial loop and every lane must report a terminal
-# SolveStatus.
+# SolveStatus.  MEGBA_BENCH_BF16=1 rides the same run too: the bf16
+# MXU pipeline head-to-head (cost band + guard cleanliness + halved
+# bytes axes; asserted below, certified in BENCH_bf16.json).
 FORCING_OUT=$(mktemp /tmp/megba_forcing_smoke.XXXXXX.json)
 trap 'rm -f "$SMOKE" "$FORCING_OUT"' EXIT
 JAX_PLATFORMS=cpu MEGBA_BENCH_CONFIG=venice MEGBA_BENCH_SCALE=0.1 \
 MEGBA_BENCH_CONVERGENCE=0 MEGBA_BENCH_FORCING=1 MEGBA_BENCH_FLEET=16 \
 MEGBA_BENCH_PRECOND=neumann MEGBA_BENCH_NEUMANN_ORDER=1 \
+MEGBA_BENCH_BF16=1 \
   python bench.py > "$FORCING_OUT"
 python - "$FORCING_OUT" <<'PY'
 import json
@@ -113,8 +116,38 @@ assert fl["max_cost_rel_gap"] <= 5e-2, (
 assert fl["problems_per_sec_batched"] > fl["problems_per_sec_serial"], (
     f"batched {fl['problems_per_sec_batched']} problems/s did not beat "
     f"the serial loop at {fl['problems_per_sec_serial']} problems/s")
+
+# bf16 MXU pipeline smoke (ISSUE 15): the SAME venice-10% run solved
+# f32 vs bf16 under the inexact-LM config with PR 5's guards ARMED —
+# the bf16 candidate must converge within the documented cost-gap band
+# with ZERO guard/recovery/breakdown events (a clean bf16 run must not
+# lean on the containment machinery), and the auditor's
+# collective_bytes_per_sp axis must come out at exactly HALF the f32
+# program's, live (re-audited in-process) and committed
+# (ANALYSIS_BUDGET.json).  Certified in BENCH_bf16.json.
+bf = json.loads(line)["extra"]["bf16"]
+print("bf16 smoke:", json.dumps({k: bf[k] for k in (
+    "cost_rel_gap", "cost_gap_band", "pcg_iters_delta",
+    "guard_events_bf16", "committed_bytes_per_sp")}))
+assert bf["cost_rel_gap"] <= bf["cost_gap_band"], (
+    f"bf16 final cost drifted {bf['cost_rel_gap']:.2e} from the f32 "
+    f"control (> {bf['cost_gap_band']:.0e} documented band)")
+assert bf["guard_events_bf16"] == 0, (
+    f"bf16 tripped {bf['guard_events_bf16']} guard/recovery event(s) "
+    "on a clean run")
+assert bf["bf16"]["status"] in TERMINAL and bf["bf16"]["recoveries"] == 0
+for cand, ctrl in (("ba_bf16_w2_f32", "ba_sharded_w2_f32"),
+                   ("ba_bf16_2d_w4_f32", "ba_2d_w4_f32")):
+    c = bf["committed_bytes_per_sp"]
+    assert c[cand] == 0.5 * c[ctrl], (
+        f"{cand} bytes/sp {c[cand]} is not half of {ctrl}'s {c[ctrl]}")
+live = bf["audited_live"]
+if live:
+    assert live["ba_bf16_w2_f32"]["collective_bytes_per_sp"] == \
+        0.5 * live["ba_sharded_w2_f32"]["collective_bytes_per_sp"], live
+    assert not any(v["violations"] for v in live.values()), live
 PY
-echo "inexact-LM + fleet smoke OK"
+echo "inexact-LM + fleet + bf16 smoke OK"
 
 # Locality-scene multilevel smoke (ISSUE 11): the venice-10% bench on
 # a RING-locality scene (banded camera co-observation — the structure

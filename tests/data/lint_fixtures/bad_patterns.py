@@ -62,3 +62,13 @@ def donated_then_reused(cameras, points, obs):
     # donated-reuse: cameras' buffer was deleted by the call above
     leak = cameras + 1.0
     return out_c, out_p, leak
+
+
+def weak_literal_leaks(x, cond):
+    # weak-literal: bare float literals in jnp.where branches / clip
+    # bounds materialise f64-under-x64 constant tensors in f32 programs
+    a = jnp.where(cond, x, 0.0)
+    b = jnp.where(cond, 1.0, x)
+    c = jnp.clip(x, 0.0, 1.0)
+    d = jnp.where(cond, x * x, -1.0)
+    return a, b, c, d

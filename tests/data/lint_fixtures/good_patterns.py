@@ -75,3 +75,16 @@ def donate_multiline_call(cameras, points, obs):
         cameras,
         points, obs)
     return out_c, out_p
+
+
+def weak_literal_done_right(x, cond, lo, hi):
+    # the blessed alternatives: *_like constructors / dtype-pinned
+    # scalars in the leaky positions; plain arithmetic literals and
+    # jnp.maximum/minimum literals promote weakly and are NOT flagged
+    a = jnp.where(cond, x, jnp.zeros_like(x))
+    b = jnp.where(cond, jnp.ones_like(x), x)
+    c = jnp.clip(x, jnp.asarray(0.0, x.dtype), jnp.asarray(1.0, x.dtype))
+    d = jnp.where(cond, x, x * 2.0)  # literal in arithmetic: weak, fine
+    e = jnp.maximum(x, 1e-30)  # probed clean (no wide constant)
+    f = jnp.clip(x, lo, hi)
+    return a, b, c, d, e, f

@@ -625,17 +625,19 @@ def test_pose_prior_solve_recovers_exact_priors():
 
 @pytest.mark.slow
 def test_sim3_pgo_corrects_scale_drift():
-    """Noise-free sim(3) loop closing solves to the exact graph.
+    """Noise-free sim(3) loop closing solves to the exact graph — with
+    the DEFAULT refuse_ratio.
 
-    refuse_ratio is RELAXED here: the reference's rho-monotonicity
-    refuse (refuse_ratio=1.0, schur_pcg_solver.cu:288-296) fires on the
-    sim(3) system's very first PCG iteration — the mixed
-    rotation/translation/log-scale block makes the preconditioned
-    residual energy non-monotone even though CG is converging in
-    A-norm — silently returning dx=0 and stalling LM at a 10x cost
-    drop.  With the refuse relaxed the same solve reaches machine-zero
-    cost in 5 LM iterations and recovers the scale trail exactly (see
-    ARCHITECTURE.md "Factor registry").
+    The reference's rho-monotonicity refuse (refuse_ratio=1.0,
+    schur_pcg_solver.cu:288-296) fires on the sim(3) system's very
+    first PCG iteration — the mixed rotation/translation/log-scale
+    block makes the preconditioned residual energy non-monotone even
+    though CG is converging in A-norm — silently returning dx=0 and
+    stalling LM at a 10x cost drop.  ISSUE 15 wired the PR 13 finding
+    as a PER-FACTOR DEFAULT (PoseFactorSpec.refuse_ratio=16 on the
+    sim3 spec, registry.resolve_refuse_ratio): this test deliberately
+    does NOT set refuse_ratio, regression-testing that a caller who
+    has never heard of the stall gets the working configuration.
     """
     from megba_tpu.models.pgo import solve_pgo
 
@@ -643,7 +645,6 @@ def test_sim3_pgo_corrects_scale_drift():
                                   scale_drift=0.05)
     opt = _opt(algo_option=AlgoOption(max_iter=25, epsilon1=1e-8),
                solver_option=SolverOption(max_iter=80, tol=1e-10,
-                                          refuse_ratio=16.0,
                                           tol_relative=True))
     r = solve_pgo(g.poses0, g.edge_i, g.edge_j, g.meas, opt,
                   factor="sim3_between")
@@ -745,3 +746,84 @@ def test_mixed_fleet_batchmates_bitwise_vs_per_factor_controls():
             assert np.array_equal(m.cameras, r.cameras), p.name
             assert np.array_equal(m.points, r.points), p.name
             assert m.cost == r.cost, p.name
+
+
+# ---------------------------------------------------------------------------
+# Per-factor solver defaults (ISSUE 15 satellite): the PR 13 sim(3)
+# refuse stall institutionalised as a spec default
+# ---------------------------------------------------------------------------
+
+def test_refuse_ratio_default_resolution():
+    from megba_tpu.factors.registry import (
+        apply_factor_solver_defaults,
+        resolve_refuse_ratio,
+    )
+
+    sim3 = get_factor("sim3_between")
+    se3 = get_factor("se3_between")
+    so = SolverOption()
+    # 7-dof family declares its band; the caller's class default yields
+    # it without the caller knowing the stall exists.
+    assert sim3.refuse_ratio == 16.0
+    assert resolve_refuse_ratio(sim3, so) == 16.0
+    # An explicit caller setting always wins.
+    assert resolve_refuse_ratio(
+        sim3, dataclasses.replace(so, refuse_ratio=4.0)) == 4.0
+    assert resolve_refuse_ratio(
+        sim3, dataclasses.replace(so, refuse_ratio=1e30)) == 1e30
+    # Families without a declared default change nothing.
+    assert se3.refuse_ratio is None
+    assert resolve_refuse_ratio(se3, so) == so.refuse_ratio
+
+
+def test_apply_factor_solver_defaults_object_identity():
+    """No resolution difference -> the SAME option object comes back
+    (jit/program caches keyed on the option must not split); a
+    resolved default -> a replaced copy carrying it."""
+    from megba_tpu.factors.registry import apply_factor_solver_defaults
+
+    opt = _opt()
+    assert apply_factor_solver_defaults(get_factor("se3_between"),
+                                        opt) is opt
+    # sim3 at an explicit refuse: also unchanged (caller wins).
+    explicit = _opt(solver_option=SolverOption(refuse_ratio=8.0))
+    assert apply_factor_solver_defaults(get_factor("sim3_between"),
+                                        explicit) is explicit
+    resolved = apply_factor_solver_defaults(get_factor("sim3_between"),
+                                            opt)
+    assert resolved is not opt
+    assert resolved.solver_option.refuse_ratio == 16.0
+    # everything else untouched
+    assert dataclasses.replace(
+        resolved, solver_option=opt.solver_option) == opt
+
+
+def test_schur_factor_defaults_resolve_in_flat_solve():
+    """A Schur-family spec carrying a refuse default gets the same
+    treatment at the flat_solve seam: validated by registering a
+    throwaway factor and checking the typed validation path still
+    resolves (no solve — the wrong-width arrays are refused AFTER the
+    spec resolves, proving dispatch reaches the resolver)."""
+    from megba_tpu.factors.registry import (
+        FactorError,
+        register_factor,
+        resolve_refuse_ratio,
+        unregister_factor,
+    )
+
+    spec = FactorSpec(
+        name="_test_refuse_default", cam_dim=9, pt_dim=3, obs_dim=2,
+        residual_dim=2, residual_fn=lambda c, p, o: o,
+        refuse_ratio=32.0)
+    register_factor(spec)
+    try:
+        assert resolve_refuse_ratio(spec, SolverOption()) == 32.0
+        with pytest.raises(FactorError, match="width"):
+            flat_solve(None, np.zeros((2, 5), np.float32),
+                       np.zeros((2, 3), np.float32),
+                       np.zeros((4, 2), np.float32),
+                       np.zeros(4, np.int32), np.zeros(4, np.int32),
+                       _opt(dtype=np.float32),
+                       factor="_test_refuse_default")
+    finally:
+        unregister_factor("_test_refuse_default")

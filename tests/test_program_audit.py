@@ -42,7 +42,11 @@ FACTOR_PROGRAMS = frozenset({
 # same reason: a fresh world-4 SPMD trace per tier-1 run is exactly the
 # compile volume the budget can't absorb.  Like the factor programs it
 # is still audited on every full run (lint gate 4 + the slow test).
-SLOW_PROGRAMS = FACTOR_PROGRAMS | {"ba_2d_w4_f32"}
+# The bf16 MXU pipeline programs (ISSUE 15) join them: two more SPMD
+# traces (world 2 + world 4) the tier-1 budget can't absorb — audited
+# every full run by lint gate 4 and the slow bf16 test below.
+SLOW_PROGRAMS = FACTOR_PROGRAMS | {
+    "ba_2d_w4_f32", "ba_bf16_w2_f32", "ba_bf16_2d_w4_f32"}
 
 
 @pytest.fixture(scope="module")
@@ -138,6 +142,199 @@ def test_mesh2d_program_subgroup_census_and_bytes_law():
     b1d = audits["ba_sharded_w2_f32"].pcg_body_collective_bytes()
     b2d = a2d.pcg_body_collective_bytes()
     assert b2d < b1d * 2.0 * (4 - 1) / 4, (b2d, b1d)
+
+
+def test_bf16_machinery_off_census_is_clean(audits):
+    """Dtype-census regression (ISSUE 15 satellite): with the bf16
+    machinery merged but OFF, every historical canonical program's
+    StableHLO carries ZERO bf16 tensors — identical census to the
+    pre-merge tree (the committed ANALYSIS_BUDGET entries, compared
+    byte-for-byte by test_clean_tree_matches_committed_budget + lint
+    gate 4, pin the rest of the byte-identity claim)."""
+    for name, audit in audits.items():
+        census = hlo.dtype_census(audit.stablehlo)
+        assert "bf16" not in census, (name, census)
+        assert "bf16" not in audit.stablehlo, name
+
+
+@pytest.mark.slow
+def test_bf16_programs_clean_halved_bytes_and_real_bf16_compute():
+    """The ISSUE 15 acceptance pin: both bf16 canonical programs are
+    green on every pass (incl. the allowed-surface census), sit on
+    their committed budgets, price `collective_bytes_per_sp` at
+    EXACTLY half their f32 counterparts', and actually carry bf16
+    compute (multiplies / f32-accumulating dot_generals) — the
+    silent-upcast guard measured live, not just structurally."""
+    audits = program_audit.audit_all(
+        ["ba_bf16_w2_f32", "ba_bf16_2d_w4_f32"])
+    baseline = budget_mod.load_baseline()
+    for name, audit in audits.items():
+        assert audit.violations() == [], (name, audit.violations())
+        assert budget_mod.compare(
+            {name: baseline[name]}, {name: audit.metrics()}) == []
+    # Exactly half the committed f32 counterparts — the halved-wire
+    # acceptance criterion, against the SAME committed numbers the
+    # budget gate enforces.
+    for cand, ctrl in (("ba_bf16_w2_f32", "ba_sharded_w2_f32"),
+                       ("ba_bf16_2d_w4_f32", "ba_2d_w4_f32")):
+        assert (baseline[cand]["collective_bytes_per_sp"]
+                == 0.5 * baseline[ctrl]["collective_bytes_per_sp"]), (
+            cand, ctrl)
+        assert (audits[cand].pcg_body_collective_bytes()
+                == baseline[cand]["collective_bytes_per_sp"])
+    # Live bf16-compute presence + the declared in-body payloads.
+    for name, audit in audits.items():
+        ops = hlo.bf16_stablehlo_ops(audit.stablehlo)
+        n_mul = sum(1 for op in ops
+                    if op.kind == "multiply" and op.result_dtype == "bf16")
+        n_dot = sum(1 for op in ops if op.kind == "dot_general")
+        assert n_mul >= 1, name  # bf16-operand products exist
+        assert n_dot >= 1, name  # the bf16 M⁻¹ apply exists
+        assert all(op.result_dtype != "bf16" for op in ops
+                   if op.kind == "dot_general"), name  # f32 accumulation
+        declared = [op for op in hlo.stablehlo_collective_payloads(
+            audit.stablehlo) if op.while_depth >= 2]
+        assert declared and all(
+            op.result_dtype == "bf16" for op in declared), (name, declared)
+    # The 2-D bf16 program keeps the subgroup contract on top.
+    body = audits["ba_bf16_2d_w4_f32"].pcg_body_collectives()
+    assert body and all(op.group_size(4) < 4 for op in body)
+
+
+# ---------------------------------------------------------------------------
+# bf16 surface pass units (pure text — no lowering)
+# ---------------------------------------------------------------------------
+
+def _surface_audit(stablehlo, **surface_kw):
+    spec = _fake_spec(bf16_surface=program_audit.Bf16Surface(**surface_kw))
+    return program_audit.ProgramAudit(
+        spec=spec, stablehlo=stablehlo, compiled_text="",
+        flops=-1.0, bytes_accessed=-1.0, peak_temp_bytes=-1.0,
+        argument_bytes=-1.0, output_bytes=-1.0)
+
+
+_CLEAN_BF16 = """\
+func.func public @main(%arg0: tensor<4xf32>) -> tensor<4xf32> {
+  %0 = stablehlo.convert %arg0 : (tensor<4xf32>) -> tensor<4xbf16>
+  %1 = stablehlo.multiply %0, %0 : tensor<4xbf16>
+  %2 = stablehlo.convert %1 : (tensor<4xbf16>) -> tensor<4xf32>
+  return %2 : tensor<4xf32>
+}
+"""
+
+
+def test_bf16_surface_clean_program_passes():
+    assert _surface_audit(_CLEAN_BF16).bf16_surface_violations() == []
+
+
+def test_bf16_surface_none_means_wrong_family():
+    # Without a declared surface the historical rule applies: bf16 in
+    # an f32 program is a dtype leak (pass 3), and the surface pass
+    # stays silent rather than double-reporting.
+    a = program_audit.ProgramAudit(
+        spec=_fake_spec(), stablehlo=_CLEAN_BF16, compiled_text="",
+        flops=-1.0, bytes_accessed=-1.0, peak_temp_bytes=-1.0,
+        argument_bytes=-1.0, output_bytes=-1.0)
+    assert a.bf16_surface_violations() == []
+    assert any("bf16" in v for v in a.dtype_violations())
+
+
+def test_bf16_surface_flags_disallowed_kind():
+    bad = _CLEAN_BF16.replace(
+        "stablehlo.multiply %0, %0 : tensor<4xbf16>",
+        "stablehlo.exponential %0 : tensor<4xbf16>")
+    out = _surface_audit(bad).bf16_surface_violations()
+    assert any("outside the declared surface" in v for v in out), out
+
+
+def test_bf16_surface_flags_bf16_accumulation():
+    bad = _CLEAN_BF16.replace(
+        "stablehlo.multiply %0, %0 : tensor<4xbf16>",
+        "stablehlo.add %0, %0 : tensor<4xbf16>")
+    out = _surface_audit(bad).bf16_surface_violations()
+    assert any("bf16 accumulation" in v for v in out), out
+
+
+def test_bf16_surface_flags_bf16_dot_result():
+    bad = _CLEAN_BF16.replace(
+        "stablehlo.multiply %0, %0 : tensor<4xbf16>",
+        "stablehlo.dot_general %0, %0, contracting_dims = [0] x [0] "
+        ": (tensor<4xbf16>, tensor<4xbf16>) -> tensor<bf16>")
+    out = _surface_audit(bad).bf16_surface_violations()
+    assert any("ACCUMULATES in bf16" in v for v in out), out
+
+
+def test_bf16_surface_flags_f64_convert():
+    bad = _CLEAN_BF16.replace(
+        "stablehlo.convert %1 : (tensor<4xbf16>) -> tensor<4xf32>",
+        "stablehlo.convert %1 : (tensor<4xbf16>) -> tensor<4xf64>")
+    out = _surface_audit(bad).bf16_surface_violations()
+    assert any("family leak" in v for v in out), out
+
+
+def test_bf16_surface_flags_silent_upcast():
+    # All-convert program: bf16 tensors exist but every product was
+    # upcast away — zero bf16 compute ops must FAIL, not pass quietly.
+    quiet = _CLEAN_BF16.replace(
+        "stablehlo.multiply %0, %0 : tensor<4xbf16>",
+        "stablehlo.reshape %0 : (tensor<4xbf16>) -> tensor<4xbf16>")
+    out = _surface_audit(quiet).bf16_surface_violations()
+    assert any("silently upcast" in v for v in out), out
+
+
+def test_bf16_surface_collective_gate():
+    coll = """\
+func.func public @main(%arg0: tensor<4xf32>) -> tensor<4xf32> {
+  %0 = stablehlo.convert %arg0 : (tensor<4xf32>) -> tensor<4xbf16>
+  %1 = stablehlo.multiply %0, %0 : tensor<4xbf16>
+  %2 = "stablehlo.all_reduce"(%1) <{replica_groups = dense<[[0, 1]]> : tensor<1x2xi64>}> ({
+  ^bb0(%a: tensor<bf16>, %b: tensor<bf16>):
+    %s = stablehlo.add %a, %b : tensor<bf16>
+    stablehlo.return %s : tensor<bf16>
+  }) : (tensor<4xbf16>) -> tensor<4xbf16>
+  %3 = stablehlo.convert %2 : (tensor<4xbf16>) -> tensor<4xf32>
+  return %3 : tensor<4xf32>
+}
+"""
+    # Undeclared collectives: both the payload and (implicitly) the
+    # scalar region add are flagged.
+    out = _surface_audit(coll).bf16_surface_violations()
+    assert any("without a declared bf16_collectives" in v
+               for v in out), out
+    # Declared: the payload and its rank-0 reduction add are the
+    # contract, not a violation (no compiled body here, so only the
+    # text checks run).
+    out2 = _surface_audit(coll, collectives=True).bf16_surface_violations()
+    assert not any("without a declared" in v or "accumulation" in v
+                   for v in out2), out2
+
+
+def test_stablehlo_collective_payload_parser_forms():
+    payloads = hlo.stablehlo_collective_payloads(
+        """\
+func.func public @main(%arg0: tensor<8xf32>) -> tensor<8xf32> {
+  %0 = "stablehlo.all_gather"(%arg0) <{all_gather_dim = 0 : i64}> : (tensor<4xbf16>) -> tensor<8xbf16>
+  %1 = stablehlo.while(%iterArg = %arg0) : tensor<8xf32>
+   cond {
+    stablehlo.return %c : tensor<i1>
+  } do {
+    %2 = "stablehlo.all_reduce"(%iterArg) <{replica_groups = dense<[[0, 1]]> : tensor<1x2xi64>}> ({
+    ^bb0(%a: tensor<bf16>, %b: tensor<bf16>):
+      %s = stablehlo.add %a, %b : tensor<bf16>
+      stablehlo.return %s : tensor<bf16>
+    }) : (tensor<8xbf16>) -> tensor<8xbf16>
+    stablehlo.return %2 : tensor<8xf32>
+  }
+  return %1 : tensor<8xf32>
+}
+""")
+    by_kind = {p.kind: p for p in payloads}
+    ag = by_kind["all_gather"]  # inline form, outside any while
+    assert (ag.result_dtype, ag.result_elems, ag.while_depth) == (
+        "bf16", 8, 0)
+    ar = by_kind["all_reduce"]  # region form, inside the while body
+    assert (ar.result_dtype, ar.result_elems) == ("bf16", 8)
+    assert ar.while_depth == 1
 
 
 def test_collective_census_matches_analytic_expectation(audits):
