@@ -11,6 +11,7 @@ Rule ids are kebab-case; suppress one finding with an inline
 | scalar-promotion | no strongly-typed scalar constructors (`np.float64(x)`, `jnp.int32(k)`, ...) as operands of array arithmetic in jit-reachable code — unlike weak Python scalars they promote the whole expression's dtype |
 | donated-reuse | an argument passed at a `donate_argnums` position of a locally-built `jax.jit` program must not be read after the call — the buffer is deleted by the call |
 | weak-literal | no BARE float literal as a `jnp.where` branch or `jnp.clip` bound in jit-reachable code — probed on this jaxlib: under x64 those positions materialise a `tensor<f64>` constant (plus a convert) in f32 programs, the dtype-census leak hand-fixed in PRs 3 and 6 (`jnp.where(safe, θ², 1.0)`, `jnp.where(..., 0.0, ...)`); use `zeros_like`/`ones_like`/`jnp.asarray(c, x.dtype)`.  Plain arithmetic (`2.0 * x`) and `jnp.maximum/minimum` literals promote weakly and are clean — the rule matches only the probed leaky positions |
+| raw-clock | no raw `time.time()` / `time.perf_counter()` outside the sanctioned clock homes (`utils/timing.py`, `observability/`) — scattered raw reads fragment the timing story the observability plane narrates (PhaseTimer phases, span timestamps, report `created_unix` all flow from ONE seam); use `utils.timing.monotonic_s()` for durations and `utils.timing.wall_unix()` for epoch stamps.  `time.monotonic()` deadline arithmetic and `time.sleep` are clean — the rule bans the two reads that LOOK interchangeable but are not |
 """
 
 from __future__ import annotations
@@ -60,7 +61,13 @@ ALL_RULES = (
     "scalar-promotion",
     "donated-reuse",
     "weak-literal",
+    "raw-clock",
 )
+
+# Fully-resolved call targets the raw-clock rule bans (time.monotonic,
+# time.sleep etc. stay legal — only the two reads that masquerade as
+# each other are fenced into the clock homes).
+_RAW_CLOCK_TARGETS = {"time.time", "time.perf_counter"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -274,6 +281,31 @@ def rule_weak_literal(index: PackageIndex) -> Iterator[Finding]:
                     "zeros_like/ones_like or jnp.asarray(c, x.dtype)")
 
 
+def _is_clock_home(mod: ModuleInfo) -> bool:
+    parts = mod.name.split(".")
+    return "observability" in parts or mod.name.endswith("utils.timing")
+
+
+def rule_raw_clock(index: PackageIndex) -> Iterator[Finding]:
+    for mod in index.modules.values():
+        if _is_clock_home(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            full = _alias_target(mod, dotted)
+            if full in _RAW_CLOCK_TARGETS:
+                helper = ("wall_unix()" if full == "time.time"
+                          else "monotonic_s()")
+                yield Finding(
+                    mod.path, node.lineno, node.col_offset, "raw-clock",
+                    f"raw `{dotted}()` outside the clock homes "
+                    "(utils/timing.py, observability/): use "
+                    f"megba_tpu.utils.timing.{helper} so durations and "
+                    "epoch stamps flow from one seam")
+
+
 def rule_donated_reuse(index: PackageIndex) -> Iterator[Finding]:
     for qual, info in sorted(index.functions.items()):
         mod = index.modules[info.module]
@@ -364,4 +396,5 @@ RULES = {
     "scalar-promotion": rule_scalar_promotion,
     "donated-reuse": rule_donated_reuse,
     "weak-literal": rule_weak_literal,
+    "raw-clock": rule_raw_clock,
 }

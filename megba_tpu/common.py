@@ -427,6 +427,13 @@ class ProblemOption:
     # solve.flat_solve strips it before program build, so it never
     # fragments the jit caches or changes the compiled program.
     telemetry: Optional[str] = None
+    # Opt-in metrics plane (observability/metrics.py): arms the
+    # process-local counter/gauge/histogram registry for this solve —
+    # equivalent to setting MEGBA_METRICS; either being set arms it.
+    # Host-side only and stripped before program build exactly like
+    # `telemetry`, so the knob never splits a jit/program/artifact cache
+    # and the compiled programs stay byte-identical (HLO-audit-pinned).
+    metrics: bool = False
 
     def __post_init__(self) -> None:
         from megba_tpu.ops.robust import RobustKind

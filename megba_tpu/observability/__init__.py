@@ -18,10 +18,28 @@ is a wall-clock print per LM iteration, lm_algo.cu:141-162):
 verbose per-iteration line and the problem-stats block), so stdout and
 telemetry can never drift apart.
 
-This `__init__` stays import-light on purpose: `report` and `summarize`
-load lazily, so a telemetry-off solve never imports the sink machinery
-(tested by tests/test_observability.py).
+The observability PLANE (PR 16) adds three service-tier pillars, all
+host-side and all off by default:
+
+- `metrics`: process-local counter/gauge/histogram registry with
+  Prometheus text exposition + JSON snapshots (`MEGBA_METRICS=1` or
+  `ProblemOption.metrics=True`; `FleetRouter.metrics_snapshot()` merges
+  worker snapshots over the RPC).
+- `spans`: request-scoped spans with trace/span ids propagated in the
+  router->worker RPC frames, exported as Chrome/Perfetto trace-event
+  JSON (`MEGBA_TRACE=<path>`).
+- `flight`: a bounded ring-buffer flight recorder dumped on worker
+  death/crash (`MEGBA_FLIGHT=<path>`).
+
+Consumers go through the three `*_registry`/`*_recorder` gate functions
+below: an env-dict lookup when the plane is off, a lazy import when on.
+
+This `__init__` stays import-light on purpose: `report`, `summarize`,
+`metrics`, `spans` and `flight` load lazily, so a telemetry-off solve
+never imports the sink machinery (tested by tests/test_observability.py).
 """
+
+import os
 
 from megba_tpu.observability.emit import (
     emit_problem_stats,
@@ -37,7 +55,10 @@ __all__ = [
     "build_report",
     "emit_problem_stats",
     "emit_verbose_iteration",
+    "flight_recorder",
+    "metrics_registry",
     "next_verbose_token",
+    "span_recorder",
     "trace_to_dict",
 ]
 
@@ -52,3 +73,36 @@ def __getattr__(name):
 
         return getattr(report, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def metrics_registry(enabled: bool = False):
+    """The process-default MetricsRegistry, or None when the plane is off.
+
+    Armed by `MEGBA_METRICS` (any non-empty value) or an explicit
+    `enabled=True` (the resolved `ProblemOption.metrics` knob).  The off
+    path is one env lookup and never imports `metrics` — the same lazy
+    posture as the telemetry sink.
+    """
+    if not (enabled or os.environ.get("MEGBA_METRICS")):
+        return None
+    from megba_tpu.observability import metrics
+
+    return metrics.default_registry()
+
+
+def span_recorder(enabled: bool = False):
+    """The process-default SpanRecorder, or None (armed by MEGBA_TRACE)."""
+    if not (enabled or os.environ.get("MEGBA_TRACE")):
+        return None
+    from megba_tpu.observability import spans
+
+    return spans.default_recorder()
+
+
+def flight_recorder(enabled: bool = False):
+    """The process-default FlightRecorder, or None (armed by MEGBA_FLIGHT)."""
+    if not (enabled or os.environ.get("MEGBA_FLIGHT")):
+        return None
+    from megba_tpu.observability import flight
+
+    return flight.default_recorder()

@@ -28,7 +28,13 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-SCHEMA = "megba_tpu.solve_report/v1"
+# Schema v2 (PR 16): adds request-scoped identity — `trace_id`/`span_id`
+# (the active span context when MEGBA_TRACE is armed) and `worker` (the
+# MEGBA_FEDERATION_WORKER tag, promoted from the fleet block to a
+# first-class field so multi-worker JSONL aggregation doesn't need to
+# dig).  All three are optional and `from_json` filters to known fields,
+# so v1 lines load unchanged (MIGRATION.md notes the bump).
+SCHEMA = "megba_tpu.solve_report/v2"
 
 
 def _status_name(code) -> str:
@@ -119,6 +125,15 @@ class SolveReport:
     # problems never emit a report (zero dispatch): their count rides
     # the fleet stats embedded in later reports, like sheds.
     health: Optional[Dict[str, Any]] = None
+    # Request-scoped identity (schema v2, observability plane): the
+    # active trace/span context this solve ran under (None when tracing
+    # is off) and the federation worker id that produced the line (None
+    # outside a worker process).  Lets `summarize --fleet` stitch one
+    # fleet solve's reports across N worker JSONL files and lets the
+    # trace-event export cross-reference report lines by span id.
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    worker: Optional[str] = None
     schema: str = SCHEMA
     created_unix: float = 0.0
 
@@ -187,12 +202,7 @@ def build_report(option, result, phases: Dict[str, Any],
 
     iterations = int(result.iterations)
     trace = getattr(result, "trace", None)
-    return SolveReport(
-        problem=problem,
-        config=config_to_dict(option),
-        backend=backend_topology(),
-        phases=phases,
-        result={
+    result_block = {
             "initial_cost": float(result.initial_cost),
             "final_cost": float(result.cost),
             "iterations": iterations,
@@ -217,13 +227,28 @@ def build_report(option, result, phases: Dict[str, Any],
             # "coarse" = two-level coarse factors degraded to
             # block-Jacobi.  None without a trace.
             "precond_fallback": _decode_fallback_totals(trace, iterations),
-        },
+    }
+    span_ctx = None
+    from megba_tpu import observability as _obs
+
+    recorder = _obs.span_recorder()
+    if recorder is not None:
+        span_ctx = recorder.context()
+    return SolveReport(
+        problem=problem,
+        config=config_to_dict(option),
+        backend=backend_topology(),
+        phases=phases,
+        result=result_block,
         trace=None if trace is None else trace_to_dict(trace, iterations),
         memory=device_memory_stats(),
         program_audit=audit,
         fleet=fleet,
         elastic=elastic,
         health=health,
+        trace_id=None if span_ctx is None else span_ctx["trace_id"],
+        span_id=None if span_ctx is None else span_ctx["span_id"],
+        worker=os.environ.get("MEGBA_FEDERATION_WORKER") or None,
         created_unix=time.time(),
     )
 

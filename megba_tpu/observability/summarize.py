@@ -1,6 +1,7 @@
 """Render recorded SolveReports as convergence tables + phase breakdowns.
 
-Usage: python -m megba_tpu.observability.summarize [--aggregate] <report.jsonl> [...]
+Usage: python -m megba_tpu.observability.summarize \
+    [--aggregate | --fleet] [--metrics <snapshot.json>] <report.jsonl> [...]
 
 Reads JSONL files written by the `MEGBA_TELEMETRY` sink (one SolveReport
 per line) and prints, per report: a header (problem shape, backend,
@@ -25,6 +26,18 @@ distribution context (`SolveReport.elastic`, robustness/elastic.py)
 add an elastic line: workers lost, collective timeouts, reshards,
 resumes, and time-to-detection p50/max (last snapshot per monitor,
 summed across monitors).
+
+`--fleet` is the observability plane's multi-worker view: one
+per-bucket table over ALL given JSONL files (solves, workers serving
+the bucket, LM/PCG iteration mean+max, latency p50/p95/max), with a
+per-worker totals line under it.  Worker attribution reads the v2
+schema's `worker` field (router workers stamp it from
+`MEGBA_FEDERATION_WORKER`) and falls back to `fleet.worker`, so mixed
+v1/v2 streams still tabulate — v1 lines just land in the `-` worker
+row.  `--metrics <snapshot.json>` (usable with either mode, or alone)
+renders a metrics-registry snapshot — `FleetRouter.metrics_snapshot()`
+merged output or a single process's `snapshot_to_json` — as a
+counter/gauge/histogram table.
 """
 
 from __future__ import annotations
@@ -340,6 +353,99 @@ def aggregate_reports(reports: List[SolveReport]) -> str:
     return "\n".join(lines)
 
 
+def fleet_table(reports: List[SolveReport]) -> str:
+    """Per-bucket iteration/latency stats across a multi-worker fleet.
+
+    Buckets come from the serving layer's `fleet.bucket` context
+    (reports without one — standalone solves — group under
+    "unbatched"); worker attribution prefers the v2 `worker` field and
+    falls back to `fleet.worker` so v1 lines still land in the table.
+    """
+    if not reports:
+        return "no reports"
+    rows: dict = {}
+    by_worker: dict = {}
+    for rep in reports:
+        fleet = rep.fleet or {}
+        bucket = fleet.get("bucket") or "unbatched"
+        worker = (getattr(rep, "worker", None)
+                  or fleet.get("worker") or "-")
+        row = rows.setdefault(
+            bucket, {"n": 0, "workers": set(), "lm": [], "pcg": [],
+                     "lat": []})
+        row["n"] += 1
+        row["workers"].add(worker)
+        by_worker[worker] = by_worker.get(worker, 0) + 1
+        r = rep.result or {}
+        if r.get("iterations") is not None:
+            row["lm"].append(int(r["iterations"]))
+        if r.get("pcg_iterations") is not None:
+            row["pcg"].append(int(r["pcg_iterations"]))
+        lat = _report_latency(rep)
+        if math.isfinite(lat):
+            row["lat"].append(lat)
+
+    def _mean(vals: List[float]) -> float:
+        return sum(vals) / len(vals) if vals else float("nan")
+
+    lines = [f"== fleet table: {len(reports)} solves / "
+             f"{len(rows)} buckets / {len(by_worker)} workers =="]
+    header = (f"   {'bucket':<28} {'solves':>6} {'workers':>7} "
+              f"{'lm avg':>7} {'lm max':>7} {'pcg avg':>8} "
+              f"{'p50 ms':>8} {'p95 ms':>8} {'max ms':>8}")
+    lines.append(header)
+    for bucket in sorted(rows):
+        row = rows[bucket]
+        lat = sorted(row["lat"])
+        lines.append(
+            f"   {bucket:<28} {row['n']:>6} {len(row['workers']):>7} "
+            f"{_mean(row['lm']):>7.1f} "
+            f"{max(row['lm'], default=0):>7d} "
+            f"{_mean(row['pcg']):>8.1f} "
+            f"{1e3 * _percentile(lat, 50):>8.1f} "
+            f"{1e3 * _percentile(lat, 95):>8.1f} "
+            f"{1e3 * (lat[-1] if lat else float('nan')):>8.1f}")
+    per = " / ".join(f"{w}:{by_worker[w]}" for w in sorted(by_worker))
+    lines.append(f"   by worker: {per}")
+    traced = sum(1 for r in reports if getattr(r, "trace_id", None))
+    if traced:
+        n_traces = len({r.trace_id for r in reports
+                        if getattr(r, "trace_id", None)})
+        lines.append(f"   traced: {traced} solves in {n_traces} traces")
+    return "\n".join(lines)
+
+
+def format_metrics_snapshot(snap: dict) -> str:
+    """Render a metrics-registry snapshot (one process's or the
+    router's merged fleet view) as a readable table."""
+    lines = [f"== metrics snapshot ({snap.get('schema', '?')}) =="]
+    for name in sorted(snap.get("metrics") or {}):
+        m = snap["metrics"][name]
+        kind = m.get("kind", "?")
+        lines.append(f"   {name} ({kind})")
+        for key in sorted(m.get("series") or {}):
+            s = m["series"][key]
+            label = f"{{{key}}}" if key else ""
+            if kind == "histogram":
+                count = s.get("count", 0)
+                total = s.get("sum", 0.0)
+                mean = total / count if count else float("nan")
+                lines.append(
+                    f"     {label or '(no labels)'}: count {count}, "
+                    f"sum {total:.6g}, mean {mean:.6g}")
+            else:
+                lines.append(
+                    f"     {label or '(no labels)'}: {float(s):g}")
+    return "\n".join(lines)
+
+
+def fleet_paths(paths: Iterable[str]) -> str:
+    reports: List[SolveReport] = []
+    for path in paths:
+        reports.extend(load_reports(path))
+    return fleet_table(reports)
+
+
 def aggregate_paths(paths: Iterable[str]) -> str:
     reports: List[SolveReport] = []
     for path in paths:
@@ -362,11 +468,34 @@ def main(argv=None) -> int:
         print(__doc__.strip())
         return 0 if argv else 2
     aggregate = "--aggregate" in argv
-    paths = [a for a in argv if a != "--aggregate"]
-    if not paths:
+    fleet = "--fleet" in argv
+    metrics_path = None
+    paths = []
+    it = iter(a for a in argv if a not in ("--aggregate", "--fleet"))
+    for a in it:
+        if a == "--metrics":
+            metrics_path = next(it, None)
+            if metrics_path is None:
+                print("--metrics requires a snapshot path",
+                      file=sys.stderr)
+                return 2
+        else:
+            paths.append(a)
+    if not paths and metrics_path is None:
         print(__doc__.strip())
         return 2
-    print(aggregate_paths(paths) if aggregate else summarize_paths(paths))
+    if paths:
+        if fleet:
+            print(fleet_paths(paths))
+        elif aggregate:
+            print(aggregate_paths(paths))
+        else:
+            print(summarize_paths(paths))
+    if metrics_path is not None:
+        import json
+
+        with open(metrics_path) as fh:
+            print(format_metrics_snapshot(json.load(fh)))
     return 0
 
 

@@ -676,14 +676,13 @@ def _append_elastic_report(monitor: ElasticMonitor, result,
     ledger (chunk lines carry interim snapshots; this one is the
     complete story, and `summarize --aggregate` keeps the last snapshot
     per monitor)."""
-    import time as _time
-
     from megba_tpu.common import status_name
     from megba_tpu.observability.report import (
         SolveReport,
         append_report,
         backend_topology,
     )
+    from megba_tpu.utils.timing import wall_unix
 
     status = getattr(result, "status", None)
     rep = SolveReport(
@@ -699,6 +698,6 @@ def _append_elastic_report(monitor: ElasticMonitor, result,
                             else status_name(status)),
         },
         elastic=monitor.report_block(),
-        created_unix=_time.time(),
+        created_unix=wall_unix(),
     )
     append_report(rep, telemetry)

@@ -58,11 +58,12 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import time
 import types
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from megba_tpu.utils.timing import monotonic_s
 
 
 def _default_bal_hooks():
@@ -511,7 +512,7 @@ def check_problem(
         hooks = getattr(factor, "triage", None)
         unique_edges = bool(getattr(factor, "unique_edges", True))
     geometric_on = bool(policy.geometric) and hooks is not None
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     cameras = np.asarray(cameras)
     points = np.asarray(points)
     obs = np.asarray(obs)
@@ -769,7 +770,7 @@ def check_problem(
 
     report = HealthReport(
         n_cam=n_cam, n_pt=n_pt, n_edge=n_edge, findings=findings,
-        n_components=n_components, triage_s=time.perf_counter() - t0,
+        n_components=n_components, triage_s=monotonic_s() - t0,
         # `geometric` records what actually RAN: a hook-less factor
         # (priors, planar) reports False even under a geometric policy,
         # so downstream gates never mistake "not applicable" for

@@ -18,15 +18,16 @@ the batched path changes WHERE a problem computes, never what.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from megba_tpu import observability as _obs
 from megba_tpu.common import ProblemOption, status_name, validate_options
 from megba_tpu.observability.trace import SolveTrace
 from megba_tpu.serving.compile_pool import CompilePool
@@ -39,7 +40,7 @@ from megba_tpu.serving.shape_class import (
 )
 from megba_tpu.serving.stats import FleetStats
 from megba_tpu.utils.backend import warn_if_x64_unavailable
-from megba_tpu.utils.timing import PhaseTimer
+from megba_tpu.utils.timing import PhaseTimer, monotonic_s
 
 
 @dataclasses.dataclass
@@ -138,12 +139,15 @@ class FleetResult:
 
 
 def _strip_telemetry(option: ProblemOption) -> Tuple[ProblemOption, Optional[str], ProblemOption]:
-    """Resolve the telemetry sink and strip the knob (same contract as
-    solve.flat_solve: program caches must stay telemetry-agnostic)."""
+    """Resolve the telemetry sink and strip the observability knobs
+    (`telemetry` AND `metrics` — same contract as solve.flat_solve:
+    program caches must stay observability-agnostic).  The resolved
+    metrics flag survives on the returned `report_option`, which is
+    what instrumentation sites gate on."""
     telemetry = option.telemetry or os.environ.get("MEGBA_TELEMETRY") or None
     report_option = option
-    if option.telemetry is not None:
-        option = dataclasses.replace(option, telemetry=None)
+    if option.telemetry is not None or option.metrics:
+        option = dataclasses.replace(option, telemetry=None, metrics=False)
     return option, telemetry, report_option
 
 
@@ -304,6 +308,27 @@ def _solve_bucket(
     lanes = ladder.bucket_lanes(n_real)
     phases_before = timer.as_dict()
     faulted = any(p.fault_plan is not None for _, p in items)
+    # Observability plane (all host-side; None when off — the compiled
+    # program below is byte-identical either way, HLO-audit-pinned).
+    recorder = _obs.span_recorder()
+    span_scope = (contextlib.nullcontext() if recorder is None
+                  else recorder.span("solve_bucket", bucket=str(shape),
+                                     factor=factor, lanes=lanes,
+                                     problems=n_real, rung=rung))
+    with span_scope:
+        return _solve_bucket_inner(
+            items, shape, option, engine, ladder, pool, stats, timer,
+            telemetry, report_option, initial_region=initial_region,
+            rung=rung, attempts=attempts, factor=factor, dtype=dtype,
+            n_real=n_real, lanes=lanes, phases_before=phases_before,
+            faulted=faulted)
+
+
+def _solve_bucket_inner(
+    items, shape, option, engine, ladder, pool, stats, timer,
+    telemetry, report_option, *, initial_region, rung, attempts, factor,
+    dtype, n_real, lanes, phases_before, faulted,
+) -> List[Tuple[int, FleetResult]]:
     with timer.phase("lowering"):
         padded = [pad_to_class(p.cameras, p.points, p.obs, p.cam_idx,
                                p.pt_idx, shape, edge_mask=p.edge_mask,
@@ -347,7 +372,7 @@ def _solve_bucket(
                      if initial_region is None else initial_region, dtype)
     iv = jnp.asarray(2.0, dtype)
 
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     with timer.phase("dispatch"):
         if faulted:
             result = program(*operands, ir, iv, plan_stack)
@@ -355,11 +380,37 @@ def _solve_bucket(
             result = program(*operands, ir, iv)
     with timer.phase("execute") as ph:
         ph.sync(result.cost)
-    wall = time.perf_counter() - t0
+    wall = monotonic_s() - t0
 
     edges_real = sum(p.n_edge for p in padded)
     stats.record_batch(str(shape), lanes, n_real, edges_real,
                        shape.n_edge, wall)
+    registry = _obs.metrics_registry(report_option.metrics)
+    if registry is not None:
+        from megba_tpu.observability import metrics as _metrics
+
+        registry.counter(
+            "megba_fleet_batches_total",
+            "Batched dispatches per (bucket, factor, rung)").inc(
+                1, bucket=str(shape), factor=factor, rung=rung)
+        registry.counter(
+            "megba_fleet_problems_total",
+            "Problems solved per (bucket, factor)").inc(
+                n_real, bucket=str(shape), factor=factor)
+        registry.histogram(
+            "megba_fleet_batch_latency_seconds",
+            "Batch dispatch+execute wall clock").observe(
+                wall, bucket=str(shape), factor=factor)
+        registry.histogram(
+            "megba_fleet_lane_fill_ratio",
+            "Real lanes / dispatched lanes per batch",
+            buckets=_metrics.RATIO_BUCKETS).observe(
+                n_real / lanes, bucket=str(shape))
+        registry.histogram(
+            "megba_fleet_edge_fill_ratio",
+            "Real edges / padded edge capacity per batch",
+            buckets=_metrics.RATIO_BUCKETS).observe(
+                edges_real / (lanes * shape.n_edge), bucket=str(shape))
 
     out: List[Tuple[int, FleetResult]] = []
     for lane, ((orig_i, prob), pp) in enumerate(zip(items, padded)):
@@ -385,6 +436,21 @@ def _solve_bucket(
             health=prob.health,
         )
         out.append((orig_i, fr))
+        if registry is not None:
+            registry.histogram(
+                "megba_solve_lm_iterations",
+                "LM iterations per solved problem",
+                buckets=_metrics.ITER_BUCKETS).observe(
+                    fr.iterations, bucket=str(shape), factor=factor)
+            registry.histogram(
+                "megba_solve_pcg_iterations",
+                "Total PCG iterations per solved problem",
+                buckets=_metrics.ITER_BUCKETS).observe(
+                    fr.pcg_iterations, bucket=str(shape), factor=factor)
+            registry.counter(
+                "megba_solve_status_total",
+                "Solve outcomes by SolveStatus name").inc(
+                    1, status=fr.status_name, bucket=str(shape))
         if telemetry and jax.process_index() == 0:
             from megba_tpu.observability.report import (
                 append_report,

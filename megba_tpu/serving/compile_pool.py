@@ -89,28 +89,33 @@ def _flatten_config(d: Any, prefix: str = "") -> Dict[str, Any]:
 
 
 def _sans_telemetry(option):
-    """Strip the telemetry sink: programs (and therefore pool keys,
-    artifact fingerprints and manifests) are telemetry-agnostic by the
-    serving layer's contract — the dispatch path strips it before every
-    cache (batcher._strip_telemetry), so the warm/export paths must
-    key the same way or a sink-carrying option would warm programs
-    dispatch can never hit."""
-    if getattr(option, "telemetry", None) is not None:
+    """Strip the observability knobs (telemetry sink AND the metrics
+    flag): programs (and therefore pool keys, artifact fingerprints and
+    manifests) are observability-agnostic by the serving layer's
+    contract — the dispatch path strips them before every cache
+    (batcher._strip_telemetry), so the warm/export paths must key the
+    same way or a sink-carrying option would warm programs dispatch can
+    never hit."""
+    if (getattr(option, "telemetry", None) is not None
+            or getattr(option, "metrics", False)):
         import dataclasses as _dc
 
-        return _dc.replace(option, telemetry=None)
+        return _dc.replace(option, telemetry=None, metrics=False)
     return option
 
 
 def _config_mismatches(recorded: Dict[str, Any],
                        current: Dict[str, Any]) -> List[str]:
     a, b = _flatten_config(recorded), _flatten_config(current)
-    # The telemetry sink never reaches a program (the serving layer
-    # strips it before every cache/build — batcher._strip_telemetry),
-    # so two services differing only in where they log warmed the SAME
-    # programs: not a mismatch.
+    # The observability knobs never reach a program (the serving layer
+    # strips telemetry AND metrics before every cache/build —
+    # batcher._strip_telemetry), so two services differing only in
+    # where they log / whether they count warmed the SAME programs:
+    # not a mismatch.  "metrics" also covers manifests recorded before
+    # the knob existed (absent vs default-False is not drift).
     return sorted(k for k in set(a) | set(b)
-                  if k != "telemetry" and a.get(k) != b.get(k))
+                  if k not in ("telemetry", "metrics")
+                  and a.get(k) != b.get(k))
 
 # (engine, option, shape, lanes, cd, pd, od) -> jax.stages.Compiled
 _AOT: Dict[Tuple, Any] = {}

@@ -51,6 +51,7 @@ PR 6) no matter how routing, stealing or rerouting scattered them.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import pickle
@@ -65,7 +66,9 @@ import uuid
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from megba_tpu import observability as _obs
 from megba_tpu.serving.resilience import DeadlineExceeded
+from megba_tpu.utils.timing import monotonic_s, wall_unix
 
 _LEN = struct.Struct(">Q")
 _MAX_FRAME = 1 << 34  # 16 GiB: a corrupted length header fails fast
@@ -420,7 +423,7 @@ def _worker_main() -> int:
 
     # Cold start: warm the manifest's buckets (artifact-load when the
     # store holds them, compile otherwise) and report the split.
-    t0 = time.perf_counter()
+    t0 = monotonic_s()
     warmed = 0
     try:
         if cfg.get("manifest"):
@@ -431,7 +434,7 @@ def _worker_main() -> int:
         chan.send({"ok": False, "error": repr(exc),
                    "worker_id": worker_id})
         return 3
-    warm_s = time.perf_counter() - t0
+    warm_s = monotonic_s() - t0
     loads = stats.artifact_loads
     # Store-less warms compile without touching the artifact counters
     # (they describe a store that must exist) — the timer's phase count
@@ -451,53 +454,94 @@ def _worker_main() -> int:
     })
 
     first_solve: Optional[Dict[str, Any]] = None
-    while True:
-        try:
-            req = chan.recv()
-        except FrameError:
-            return 0  # router went away: a worker has no work without it
-        op = req.get("op")
-        if op == "shutdown":
-            chan.send({"ok": True})
-            return 0
-        if op == "stats":
-            chan.send({"ok": True, "stats": stats.as_dict(),
-                       "phases": timer.as_dict()})
-            continue
-        if op != "solve":
-            chan.send({"ok": False, "error": f"unknown op {op!r}"})
-            continue
-        problems = req["problems"]
-        try:
-            base = retrace.snapshot()
-            t0 = time.perf_counter()
-            results = solve_many(problems, solve_option, ladder=ladder,
-                                 pool=pool, stats=stats, timer=timer)
-            wall = time.perf_counter() - t0
-            if first_solve is None:
-                traces = sum(
-                    v - base.get(k, 0)
-                    for k, v in retrace.snapshot().items()
-                    if k[0].startswith("serving.batched")
-                    and v > base.get(k, 0))
-                first_solve = {"traces": int(traces), "wall_s": wall,
-                               "problems": len(problems)}
-            # Traces are per-iteration device history — large, and the
-            # router's callers read costs/params/status; telemetry (the
-            # per-problem SolveReports written ABOVE, worker-side)
-            # already persisted them for whoever wants forensics.
-            slim = [dataclasses.replace(r, trace=None) for r in results]
-            chan.send({
-                "ok": True, "results": slim,
-                "warm": sorted({str(_shape_of(e))
-                                for e in pool.entries()}),
-                "first_solve": first_solve,
-            })
-        except Exception as exc:  # solve failed: typed reply, keep serving
-            import traceback
+    try:
+        while True:
+            try:
+                req = chan.recv()
+            except FrameError:
+                return 0  # router went away: no work without it
+            op = req.get("op")
+            if op == "shutdown":
+                chan.send({"ok": True})
+                return 0
+            if op == "stats":
+                chan.send({"ok": True, "stats": stats.as_dict(),
+                           "phases": timer.as_dict()})
+                continue
+            if op == "metrics":
+                # Observability harvesting seam: the router merges these
+                # per-worker registry snapshots (metrics_snapshot()).
+                registry = _obs.metrics_registry()
+                chan.send({"ok": True, "metrics": (
+                    None if registry is None else registry.snapshot())})
+                continue
+            if op != "solve":
+                chan.send({"ok": False, "error": f"unknown op {op!r}"})
+                continue
+            problems = req["problems"]
+            recorder = _obs.span_recorder()
+            try:
+                base = retrace.snapshot()
+                t0 = monotonic_s()
+                # The router's trace context rides the solve frame; the
+                # worker's whole solve joins it as a child span and the
+                # spans recorded under it ship back in the reply.
+                scope = (contextlib.nullcontext() if recorder is None
+                         else recorder.adopt(
+                             "worker_solve", req.get("trace"),
+                             worker=worker_id, problems=len(problems)))
+                with scope:
+                    results = solve_many(problems, solve_option,
+                                         ladder=ladder, pool=pool,
+                                         stats=stats, timer=timer)
+                wall = monotonic_s() - t0
+                if first_solve is None:
+                    traces = sum(
+                        v - base.get(k, 0)
+                        for k, v in retrace.snapshot().items()
+                        if k[0].startswith("serving.batched")
+                        and v > base.get(k, 0))
+                    first_solve = {"traces": int(traces), "wall_s": wall,
+                                   "problems": len(problems)}
+                # Traces are per-iteration device history — large, and
+                # the router's callers read costs/params/status;
+                # telemetry (the per-problem SolveReports written ABOVE,
+                # worker-side) already persisted them for whoever wants
+                # forensics.
+                slim = [dataclasses.replace(r, trace=None)
+                        for r in results]
+                chan.send({
+                    "ok": True, "results": slim,
+                    "warm": sorted({str(_shape_of(e))
+                                    for e in pool.entries()}),
+                    "first_solve": first_solve,
+                    "spans": (None if recorder is None
+                              else recorder.drain()),
+                })
+            except Exception as exc:  # solve failed: typed reply, serve on
+                import traceback
 
-            chan.send({"ok": False, "error": repr(exc),
-                       "traceback": traceback.format_exc()})
+                flight = _obs.flight_recorder()
+                if flight is not None:
+                    flight.record("solve_error", worker=worker_id,
+                                  problems=len(problems),
+                                  error=repr(exc))
+                chan.send({"ok": False, "error": repr(exc),
+                           "traceback": traceback.format_exc(),
+                           "spans": (None if recorder is None
+                                     else recorder.drain())})
+    except BaseException:
+        # Worker is crashing out of the serve loop (router still thinks
+        # it is alive): dump the flight ring before dying so the last
+        # ~256 events survive the process.  SIGKILL deaths cannot run
+        # this — the ROUTER's recorder covers those (_on_worker_lost).
+        flight = _obs.flight_recorder()
+        if flight is not None:
+            flight.record("worker_crash", worker=worker_id)
+            from megba_tpu.observability import flight as _flight
+
+            _flight.dump_default("worker_crash")
+        raise
 
 
 def _shape_of(entry: Dict[str, Any]):
@@ -532,6 +576,10 @@ class WorkerHandle:
         self.alive = True
         self.pid = proc.pid
         self.rank = 0  # heartbeat-board rank, set by the router at spawn
+        # Serializes out-of-band pulls (metrics_snapshot) against the
+        # serve thread: the channel is strictly lockstep, so two
+        # concurrent requests would interleave frames.
+        self._req_lock = threading.Lock()
 
     def _poll(self) -> None:
         rc = self.proc.poll()
@@ -546,8 +594,10 @@ class WorkerHandle:
     def request(self, msg: Dict[str, Any],
                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
         try:
-            self.chan.send(msg)
-            return self.chan.recv(timeout_s=timeout_s, poll=self._poll)
+            with self._req_lock:
+                self.chan.send(msg)
+                return self.chan.recv(timeout_s=timeout_s,
+                                      poll=self._poll)
         except (FrameError, BrokenPipeError, OSError) as exc:
             rc = self.proc.poll()
             raise WorkerLostError(
@@ -959,6 +1009,43 @@ class FleetRouter:
     def __exit__(self, exc_type, exc, tb) -> None:
         self.close()
 
+    # -- observability harvesting ----------------------------------------
+    def metrics_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Fleet-wide merged metrics snapshot, or None when the plane
+        is off (`MEGBA_METRICS` unset everywhere).
+
+        Pulls each live worker's registry snapshot over the RPC channel
+        (a new lockstep `metrics` op, serialized against the serve
+        thread by the handle's request lock) and merges it with the
+        router's own — counters/histograms sum, gauges too (depth-style
+        gauges are per-process, so the sum reads as fleet totals).  The
+        merge iterates sorted names and sorted label keys, so repeated
+        pulls on an idle fleet are bitwise identical — the stable seam
+        a self-tuning router (ROADMAP item 4) can diff between policy
+        adjustments.  Workers that died, or stubs that do not speak the
+        op, are skipped rather than failed: harvesting is forensic and
+        must never take the fleet down.
+        """
+        snaps: List[Dict[str, Any]] = []
+        registry = _obs.metrics_registry()
+        if registry is not None:
+            snaps.append(registry.snapshot())
+        for w in self.workers.values():
+            if not getattr(w, "alive", False):
+                continue
+            try:
+                reply = w.request({"op": "metrics"}, timeout_s=60.0)
+            except Exception:
+                continue  # lost mid-pull or stub without the op
+            if isinstance(reply, dict) and reply.get("ok") \
+                    and reply.get("metrics") is not None:
+                snaps.append(reply["metrics"])
+        if not snaps:
+            return None
+        from megba_tpu.observability import metrics as _metrics
+
+        return _metrics.merge_snapshots(snaps)
+
     # -- dispatch --------------------------------------------------------
     @staticmethod
     def _resolve(future: Future, result=None, exc=None) -> None:
@@ -1098,10 +1185,22 @@ class FleetRouter:
                 continue
             try:
                 try:
-                    reply = worker.request(
-                        {"op": "solve",
-                         "problems": [it.problem for it in batch]},
-                        timeout_s=self.watchdog_s)
+                    msg: Dict[str, Any] = {
+                        "op": "solve",
+                        "problems": [it.problem for it in batch]}
+                    recorder = _obs.span_recorder()
+                    scope = (contextlib.nullcontext()
+                             if recorder is None else recorder.span(
+                                 "fed_dispatch", bucket=batch[0].bucket,
+                                 worker=wid, problems=len(batch),
+                                 stolen=stolen))
+                    with scope:
+                        if recorder is not None:
+                            msg["trace"] = recorder.context()
+                        reply = worker.request(
+                            msg, timeout_s=self.watchdog_s)
+                    if recorder is not None and reply.get("spans"):
+                        recorder.ingest(reply["spans"])
                 except (WorkerLostError, TimeoutError) as exc:
                     if isinstance(exc, TimeoutError):
                         exc = WorkerLostError(
@@ -1127,6 +1226,20 @@ class FleetRouter:
                         self.stats.record_first_solve(
                             wid, reply["first_solve"])
                     self.stats.record_batch(wid, len(batch), stolen)
+                    registry = _obs.metrics_registry()
+                    if registry is not None:
+                        registry.counter(
+                            "megba_fed_dispatch_total",
+                            "Problems dispatched per shape-class bucket "
+                            "and worker").inc(
+                                len(batch), bucket=batch[0].bucket,
+                                worker=wid)
+                        if stolen:
+                            registry.counter(
+                                "megba_fed_steal_total",
+                                "Problems moved by work-stealing").inc(
+                                    len(batch), bucket=batch[0].bucket,
+                                    worker=wid)
                     if stolen:
                         self.timer.count_event("federation_steal")
                         self.timer.count_event(
@@ -1169,6 +1282,20 @@ class FleetRouter:
         worker.terminate()
         self.stats.record_worker_lost(wid)
         self.timer.count_event("federation_worker_lost")
+        registry = _obs.metrics_registry()
+        if registry is not None:
+            registry.counter("megba_fed_worker_lost_total",
+                             "Federation workers lost").inc(worker=wid)
+        flight = _obs.flight_recorder()
+        if flight is not None:
+            # The router-side crash record for deaths the worker could
+            # not announce (SIGKILL, OOM): what died, why, and what it
+            # had in flight — then dump the ring, because the fleet may
+            # be about to fail outright if this was the last survivor.
+            flight.record(
+                "worker_lost", worker=wid, reason=exc.reason,
+                inflight=len(batch),
+                buckets=sorted({it.bucket for it in batch})[:8])
         # Failures are COLLECTED under the lock and resolved outside it:
         # a future's done-callback may re-enter the router, and the
         # Condition's lock is not reentrant.  The failed items count as
@@ -1199,6 +1326,15 @@ class FleetRouter:
             if rerouted:
                 self.stats.record_reroute(rerouted)
                 self.timer.count_event("federation_reroute", rerouted)
+                if registry is not None:
+                    for it in batch:
+                        if it.reroutes <= self.max_reroutes:
+                            registry.counter(
+                                "megba_fed_reroute_total",
+                                "Problems rerouted off lost workers"
+                            ).inc(bucket=it.bucket)
+                if flight is not None:
+                    flight.record("reroute", worker=wid, n=rerouted)
             if not survivors:
                 # Nothing can serve the queue: fail it all, typed.
                 for key in list(self._pending):
@@ -1214,6 +1350,10 @@ class FleetRouter:
             self._resolve(future, exc=err)
         with self._lock:
             self._lock.notify_all()  # flush waiters re-check after fails
+        if flight is not None:
+            from megba_tpu.observability import flight as _flight
+
+            _flight.dump_default(f"worker_lost:{wid}")
 
 
 def append_federation_report(option, stats: FederationStats, timer,
@@ -1234,7 +1374,7 @@ def append_federation_report(option, stats: FederationStats, timer,
         phases=timer.as_dict(),
         result={},
         federation=stats.as_dict(),
-        created_unix=time.time(),
+        created_unix=wall_unix(),
     )
     append_report(rep, path)
 

@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from megba_tpu import observability as _obs
 from megba_tpu.common import ProblemOption
 from megba_tpu.serving.batcher import (
     FleetProblem,
@@ -192,6 +193,10 @@ class FleetQueue:
     def _breaker_event(self, event: str, bucket: str, reason: str) -> None:
         self.stats.record_breaker(event)
         self.timer.count_event(f"breaker_{event}")
+        flight = _obs.flight_recorder()
+        if flight is not None:
+            flight.record("breaker", event=event, bucket=bucket,
+                          reason=reason)
 
     def _rung_option(self, rung: int) -> ProblemOption:
         if rung == 0 or self.escalation is None:
@@ -495,6 +500,11 @@ class FleetQueue:
                 if shed:
                     self.stats.record_shed(len(shed))
                     self.timer.count_event("deadline_shed", len(shed))
+                    flight = _obs.flight_recorder()
+                    if flight is not None:
+                        flight.record(
+                            "queue_shed", count=len(shed),
+                            names=[it.problem.name for it in shed[:8]])
                     # Shed items count as in-flight until their futures
                     # carry DeadlineExceeded (set outside the lock):
                     # flush() must not observe "drained" while a shed
@@ -556,6 +566,10 @@ class FleetQueue:
         self._npending += 1
         self.stats.record_retry(item.rung)
         self.timer.count_event("fleet_retry")
+        flight = _obs.flight_recorder()
+        if flight is not None:
+            flight.record("escalation_retry", name=item.problem.name,
+                          rung=item.rung, attempts=item.attempts)
 
     def _dispatch(self, key, taken: List[_Pending]) -> None:
         sc, _dims, factor, rung = key
@@ -573,6 +587,12 @@ class FleetQueue:
         from megba_tpu.factors import engine_for
 
         engine = engine_for(factor, option.jacobian_mode)
+        t_dispatch = time.monotonic()
+        for it in taken:
+            # Submit-to-dispatch wait (first attempt only: a retry's
+            # wait would double-count its earlier dispatch).
+            if it.attempts == 1:
+                self.stats.record_wait(bucket, t_dispatch - it.enqueued)
         try:
             if self._chaos is not None:
                 self._chaos.before_dispatch(bucket)
@@ -615,6 +635,10 @@ class FleetQueue:
 
     def _on_dispatch_failure(self, bucket: str, taken: List[_Pending],
                              exc: Exception) -> None:
+        flight = _obs.flight_recorder()
+        if flight is not None:
+            flight.record("dispatch_failure", bucket=bucket,
+                          problems=len(taken), error=repr(exc))
         with self._lock:
             self.breaker.record_failure(bucket, repr(exc))
         now = time.monotonic()

@@ -26,6 +26,7 @@ from megba_tpu.common import PrecondKind, ProblemOption, validate_options
 from megba_tpu.core.fm import EDGE_QUANTUM
 from megba_tpu.core.types import is_cam_sorted, pad_edges
 from megba_tpu.io.bal import BALFile, load_bal
+from megba_tpu import observability as _obs
 from megba_tpu.observability.emit import next_verbose_token
 from megba_tpu.parallel.mesh import (
     distributed_lm_solve,
@@ -244,13 +245,19 @@ def flat_solve(
             "flat_solve needs residual_jac_fn or a registered factor= "
             "to resolve one from")
     # Resolve the telemetry target here (knob wins over env), then strip
-    # the knob: program caches are keyed on `option` and must stay
-    # telemetry-agnostic — turning telemetry on can never recompile.
+    # the observability knobs (`telemetry` AND `metrics`): program
+    # caches are keyed on `option` and must stay observability-agnostic
+    # — turning telemetry or metrics on can never recompile.
     telemetry = option.telemetry or os.environ.get("MEGBA_TELEMETRY") or None
     report_option = option
-    if option.telemetry is not None:
-        option = dataclasses.replace(option, telemetry=None)
+    if option.telemetry is not None or option.metrics:
+        option = dataclasses.replace(option, telemetry=None, metrics=False)
     timer = PhaseTimer() if timer is None else timer
+    # Touch the span recorder up front when MEGBA_TRACE is armed: its
+    # first creation installs the PhaseTimer hook, so even a bare
+    # flat_solve (no router/batcher to initialise it) records its
+    # lowering/plan/dispatch phases as spans.  One env lookup when off.
+    _obs.span_recorder()
 
     health = None
     if triage is not None:
@@ -660,16 +667,42 @@ def flat_solve(
 
 def _maybe_emit_report(telemetry, option, result, timer, problem,
                        elastic=None, health=None) -> None:
-    """Append a SolveReport JSONL line when telemetry is on; no-op (no
-    sink import, no device sync) when it is off."""
-    if not telemetry:
+    """Append a SolveReport JSONL line when telemetry is on, and feed
+    the per-solve metrics observables when the metrics plane is armed;
+    no-op (no sink import, no device sync) when both are off."""
+    registry = _obs.metrics_registry(getattr(option, "metrics", False))
+    if not telemetry and registry is None:
         return
     # The report wants final scalars + the trace anyway, so the blocking
-    # "execute" phase is honest accounting, not added overhead.
+    # "execute" phase is honest accounting, not added overhead.  (The
+    # metrics-only path pays the same sync: iteration counts live on
+    # device.  Neither path adds a dispatch — the program is untouched.)
     with timer.phase("execute") as ph:
         ph.sync(result)
     if jax.process_index() != 0:
         return  # one report line per solve, not one per host
+    if registry is not None:
+        from megba_tpu.observability import metrics as _metrics
+        from megba_tpu.common import status_name as _sn
+
+        status = getattr(result, "status", None)
+        registry.histogram(
+            "megba_solve_lm_iterations",
+            "LM iterations per solved problem",
+            buckets=_metrics.ITER_BUCKETS).observe(
+                int(result.iterations), bucket="unbatched", factor="-")
+        registry.histogram(
+            "megba_solve_pcg_iterations",
+            "Total PCG iterations per solved problem",
+            buckets=_metrics.ITER_BUCKETS).observe(
+                int(result.pcg_iterations), bucket="unbatched", factor="-")
+        if status is not None:
+            registry.counter(
+                "megba_solve_status_total",
+                "Solve outcomes by SolveStatus name").inc(
+                    1, status=_sn(status), bucket="unbatched")
+    if not telemetry:
+        return
     trace = getattr(result, "trace", None)
     if trace is not None:
         # Surface the robustness counters as PhaseTimer events (the

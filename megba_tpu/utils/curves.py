@@ -103,16 +103,16 @@ def dtype_parity_payload(solve_for, rel_tol, label="", block_on=None,
     `iterations_equal=False` and `curve_len_{f64,f32}` instead of
     silently zip-truncating the comparison.
     """
-    import time
-
     import numpy as np
+
+    from megba_tpu.utils.timing import monotonic_s
 
     runs = {}
     for dtype in (np.float64, np.float32):
-        t0 = time.perf_counter()
+        t0 = monotonic_s()
         res, curve = run_with_curve(lambda: solve_for(dtype),
                                     block_on=block_on)
-        elapsed = time.perf_counter() - t0
+        elapsed = monotonic_s() - t0
         runs[np.dtype(dtype).name] = {
             "initial_cost": float(res.initial_cost),
             "final_cost": float(res.cost),

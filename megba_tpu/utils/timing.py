@@ -11,9 +11,42 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 import jax
+
+# ---------------------------------------------------------------------------
+# Clock discipline.  These two helpers are the ONLY sanctioned raw-clock
+# reads outside observability/ — the `raw-clock` lint rule
+# (analysis/rules.py) forbids time.time()/time.perf_counter() everywhere
+# else, so every latency measurement in the package goes through the
+# monotonic clock (immune to NTP steps) and every timestamp that must be
+# comparable across hosts is an explicit, named wall-clock read.
+
+
+def monotonic_s() -> float:
+    """Monotonic seconds for measuring durations (never wall clock)."""
+    return time.perf_counter()
+
+
+def wall_unix() -> float:
+    """Unix wall-clock seconds, for report timestamps only — never for
+    durations (NTP steps make wall-clock deltas lie)."""
+    return time.time()
+
+
+# Optional observer of completed PhaseTimer phases: the spans recorder
+# (observability/spans.py) installs a hook so every timed phase joins the
+# active trace as a child span.  Module-level on purpose — phases fire
+# deep inside solve paths that never see a recorder object.  Hook
+# signature: (name, duration_s).  Exceptions are swallowed: telemetry
+# must never fail a solve.
+_PHASE_HOOK: Optional[Callable[[str, float], None]] = None
+
+
+def set_phase_hook(hook: Optional[Callable[[str, float], None]]) -> None:
+    global _PHASE_HOOK
+    _PHASE_HOOK = hook
 
 
 class _Phase:
@@ -59,6 +92,11 @@ class PhaseTimer:
                 dt = time.perf_counter() - t0
                 self.totals[name] = self.totals.get(name, 0.0) + dt
                 self.counts[name] = self.counts.get(name, 0) + 1
+                if _PHASE_HOOK is not None:
+                    try:
+                        _PHASE_HOOK(name, dt)
+                    except Exception:
+                        pass
 
     def count_event(self, name: str, n: int = 1) -> None:
         """Count an instantaneous event (zero duration) — e.g. the host

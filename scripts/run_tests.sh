@@ -59,12 +59,16 @@ echo "observability smoke OK"
 # SolveStatus.  MEGBA_BENCH_BF16=1 rides the same run too: the bf16
 # MXU pipeline head-to-head (cost band + guard cleanliness + halved
 # bytes axes; asserted below, certified in BENCH_bf16.json).
+# MEGBA_BENCH_OBS=1 rides the same run as well: the observability-plane
+# overhead head-to-head (ISSUE 16) — solve_many with the plane off vs
+# metrics+spans on, interleaved best-of-6 pairs, <= 2% overhead band
+# (asserted below, certified in BENCH_obs.json).
 FORCING_OUT=$(mktemp /tmp/megba_forcing_smoke.XXXXXX.json)
 trap 'rm -f "$SMOKE" "$FORCING_OUT"' EXIT
 JAX_PLATFORMS=cpu MEGBA_BENCH_CONFIG=venice MEGBA_BENCH_SCALE=0.1 \
 MEGBA_BENCH_CONVERGENCE=0 MEGBA_BENCH_FORCING=1 MEGBA_BENCH_FLEET=16 \
 MEGBA_BENCH_PRECOND=neumann MEGBA_BENCH_NEUMANN_ORDER=1 \
-MEGBA_BENCH_BF16=1 \
+MEGBA_BENCH_BF16=1 MEGBA_BENCH_OBS=1 \
   python bench.py > "$FORCING_OUT"
 python - "$FORCING_OUT" <<'PY'
 import json
@@ -146,8 +150,23 @@ if live:
     assert live["ba_bf16_w2_f32"]["collective_bytes_per_sp"] == \
         0.5 * live["ba_sharded_w2_f32"]["collective_bytes_per_sp"], live
     assert not any(v["violations"] for v in live.values()), live
+
+# Observability-plane overhead smoke (ISSUE 16): the SAME venice-10%
+# run re-solves the fleet with metrics+spans armed vs the plane off
+# (interleaved best-of-6 pairs so container drift cancels).  The plane
+# is host-side only — the jitted programs are byte-identical (pinned by
+# the audit gate) — so the overhead must sit inside the 2% band, and
+# the instrumented side must actually have instrumented (non-empty
+# metric families + spans).  Certified in BENCH_obs.json.
+ob = json.loads(line)["extra"]["obs"]
+print("obs overhead smoke:", json.dumps(ob))
+assert ob["within_band"] and ob["overhead_pct"] <= ob["band_pct"], (
+    f"observability plane cost {ob['overhead_pct']:.2f}% on the fleet "
+    f"pass (> {ob['band_pct']:.0f}% band)")
+assert ob["metric_families"] > 0 and ob["spans"] > 0, (
+    f"instrumented side recorded nothing: {ob}")
 PY
-echo "inexact-LM + fleet + bf16 smoke OK"
+echo "inexact-LM + fleet + bf16 + obs smoke OK"
 
 # Locality-scene multilevel smoke (ISSUE 11): the venice-10% bench on
 # a RING-locality scene (banded camera co-observation — the structure
@@ -782,9 +801,20 @@ echo "mixed-factor fleet smoke OK"
 # (shape-class padding exactness makes federated placement
 # result-invariant).  `summarize --aggregate` must render the
 # federation block from the merged telemetry streams.
+#
+# The observability PLANE (ISSUE 16) rides the same smoke with all
+# three knobs armed: the router must harvest a merged Prometheus-ready
+# metrics snapshot from itself + the surviving worker (bitwise-
+# deterministic across repeated idle pulls), the trace recorder must
+# export ONE merged Chrome/Perfetto trace-event JSON spanning router
+# and worker pids (worker spans ride the RPC replies home), and the
+# w1 SIGKILL must leave a flight-recorder dump on disk.
 FED_DIR=$(mktemp -d /tmp/megba_federation_smoke.XXXXXX)
 trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$FED_DIR"' EXIT
-JAX_PLATFORMS=cpu MEGBA_FED_DIR="$FED_DIR" python - <<'PY'
+JAX_PLATFORMS=cpu MEGBA_FED_DIR="$FED_DIR" \
+MEGBA_METRICS=1 MEGBA_TRACE=1 MEGBA_FLIGHT="$FED_DIR/flight.jsonl" \
+  python - <<'PY'
+import json
 import os
 import signal
 import time
@@ -859,6 +889,27 @@ t0 = time.perf_counter()
 router.flush()  # the no-wedge gate: returns with every future resolved
 flush_s = time.perf_counter() - t0
 results = [f.result(timeout=5) for f in futs]  # none may raise
+
+# -- observability plane: merged metrics snapshot, idle-pull determinism
+# (before close(): the pull needs the surviving worker's RPC alive) ----
+from megba_tpu.observability import metrics as obs_metrics
+
+snap = router.metrics_snapshot()
+assert snap is not None, "metrics_snapshot returned None with plane armed"
+assert obs_metrics.snapshot_to_json(snap) == \
+    obs_metrics.snapshot_to_json(router.metrics_snapshot()), (
+    "metrics_snapshot drifted between two pulls on an idle fleet")
+prom = obs_metrics.render_prometheus(snap)
+for series in ("megba_fleet_batch_latency_seconds_bucket{",
+               "megba_solve_lm_iterations_bucket{",
+               "megba_fed_dispatch_total{",
+               "megba_fed_worker_lost_total{"):
+    assert series in prom, f"missing {series!r} in merged exposition"
+n_series = sum(1 for l in prom.splitlines() if not l.startswith("#"))
+print(f"federation smoke: merged metrics snapshot OK "
+      f"({len(snap['metrics'])} families, {n_series} samples, "
+      "2 idle pulls bitwise-equal)")
+
 router.close()
 d = router.stats.as_dict()
 assert d["workers_lost"] == 1 and d["lost_workers"] == ["w1"], d
@@ -873,14 +924,51 @@ print(f"federation smoke: w1 SIGKILLed mid-fleet, {d['reroutes']} problems "
       f"rerouted, flush returned in {flush_s:.1f}s, 16/16 BITWISE vs the "
       "single-host solve_many control")
 
-# -- aggregate CLI renders the federation block ------------------------
-out = summarize.aggregate_paths(
-    [p for p in (sink, sink + ".w0", sink + ".w1") if os.path.exists(p)])
+# -- merged Chrome/Perfetto trace export (router + worker pids) --------
+from megba_tpu.observability import spans as obs_spans
+
+trace_path = os.path.join(work, "trace.json")
+recorded = obs_spans.default_recorder().drain()
+assert recorded, "no spans recorded with MEGBA_TRACE armed"
+obs_spans.write_chrome_trace(trace_path, recorded)
+with open(trace_path) as fh:
+    doc = json.load(fh)
+events = doc["traceEvents"]
+assert events and all("ph" in e and "pid" in e for e in events), "bad events"
+names = {e["name"] for e in events if e["ph"] == "X"}
+assert "fed_dispatch" in names and "worker_solve" in names, names
+procs = {e["args"]["name"] for e in events if e["ph"] == "M"}
+assert len(procs) >= 2, f"trace spans only {procs} — worker spans missing"
+traces = {e["args"]["trace_id"] for e in events
+          if e["ph"] == "X" and "trace_id" in e.get("args", {})}
+print(f"federation smoke: merged trace OK ({len(events)} events across "
+      f"{sorted(procs)}, {len(traces)} traces)")
+
+# -- flight-recorder dump left by the w1 host loss ---------------------
+from megba_tpu.observability import flight as obs_flight
+
+dumps = obs_flight.load_dumps(os.environ["MEGBA_FLIGHT"])
+assert dumps, "no flight dump on disk after the w1 SIGKILL"
+assert any(dmp["reason"].startswith("worker_lost") for dmp in dumps), (
+    [dmp["reason"] for dmp in dumps])
+kinds = {e["kind"] for dmp in dumps for e in dmp["events"]}
+assert "worker_lost" in kinds, kinds
+print(f"federation smoke: flight dump OK ({len(dumps)} dump(s), "
+      f"kinds={sorted(kinds)})")
+
+# -- aggregate + fleet CLI render the merged telemetry streams ---------
+streams = [p for p in (sink, sink + ".w0", sink + ".w1")
+           if os.path.exists(p)]
+out = summarize.aggregate_paths(streams)
 print(out)
 assert "1 workers lost" in out, out
 assert "rerouted" in out, out
 assert "cold start w0: artifact" in out, out
 assert "first solve 0 traces" in out, out
+fleet_out = summarize.fleet_paths(streams)
+print(fleet_out)
+assert "traced:" in fleet_out, (
+    "fleet table shows no traced solves with MEGBA_TRACE armed")
 PY
 echo "federation smoke OK"
 

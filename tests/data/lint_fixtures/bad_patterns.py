@@ -6,10 +6,13 @@ matching breaks the suite, not the codebase.  This file is never
 imported or executed; it only exists to be parsed.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import io_callback
+from time import perf_counter as _pc
 
 
 def leaky_callback(x):
@@ -62,6 +65,15 @@ def donated_then_reused(cameras, points, obs):
     # donated-reuse: cameras' buffer was deleted by the call above
     leak = cameras + 1.0
     return out_c, out_p, leak
+
+
+def raw_clock_reads():
+    # raw-clock: wall/perf reads outside the clock homes (utils/timing,
+    # observability/) — including through import aliases
+    started = time.time()
+    t0 = time.perf_counter()
+    t1 = _pc()
+    return started, t0, t1
 
 
 def weak_literal_leaks(x, cond):

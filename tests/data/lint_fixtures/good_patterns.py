@@ -3,9 +3,13 @@ right, plus the legitimate edge cases each rule must NOT flag.  The
 linter must stay silent on this file — a false positive here is a
 regression in a rule, caught by tests/test_analysis.py."""
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from megba_tpu.utils.timing import monotonic_s, wall_unix
 
 
 def host_driver(cams_np):
@@ -75,6 +79,16 @@ def donate_multiline_call(cameras, points, obs):
         cameras,
         points, obs)
     return out_c, out_p
+
+
+def sanctioned_clocks(deadline):
+    # raw-clock done right: durations via monotonic_s(), epoch stamps
+    # via wall_unix(); time.monotonic deadline arithmetic and
+    # time.sleep are not clock READS and must stay unflagged
+    t0 = monotonic_s()
+    time.sleep(0.0)
+    late = time.monotonic() > deadline
+    return monotonic_s() - t0, wall_unix(), late
 
 
 def weak_literal_done_right(x, cond, lo, hi):

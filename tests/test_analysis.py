@@ -38,7 +38,7 @@ def test_lint_clean_on_package():
 
 @pytest.mark.parametrize("rule", [
     "host-callback", "np-in-jit", "implicit-dtype", "scalar-promotion",
-    "donated-reuse", "weak-literal"])
+    "donated-reuse", "weak-literal", "raw-clock"])
 def test_each_rule_fires_on_bad_and_not_on_good(rule):
     bad = _lint(BAD, rules=[rule])
     assert bad, f"rule {rule} found nothing in the seeded bad fixture"
@@ -61,6 +61,7 @@ def test_bad_fixture_finding_shape():
         "scalar-promotion": 2,  # np.float64 *, jnp.int64 +
         "donated-reuse": 1,
         "weak-literal": 5,      # 3 where branches + 2 clip bounds
+        "raw-clock": 3,         # time.time, time.perf_counter, aliased
     }, counts
 
 

@@ -324,12 +324,17 @@ res = flat_solve(make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF),
 assert res.trace is not None
 assert "megba_tpu.observability.report" not in sys.modules, "sink imported"
 assert "megba_tpu.observability.summarize" not in sys.modules, "CLI imported"
+assert "megba_tpu.observability.metrics" not in sys.modules, "metrics imported"
+assert "megba_tpu.observability.spans" not in sys.modules, "spans imported"
+assert "megba_tpu.observability.flight" not in sys.modules, "flight imported"
 print("NOOP_OK")
 """
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=REPO_ROOT + os.pathsep
                + os.environ.get("PYTHONPATH", ""))
     env.pop("MEGBA_TELEMETRY", None)
+    for knob in ("MEGBA_METRICS", "MEGBA_TRACE", "MEGBA_FLIGHT"):
+        env.pop(knob, None)
     proc = subprocess.run([sys.executable, "-c", code],
                           capture_output=True, text=True, env=env,
                           cwd=str(tmp_path), timeout=600)

@@ -1,0 +1,454 @@
+"""Observability-plane tests: metrics registry, spans, flight recorder.
+
+Zero-compile by design (the test_serving.py tier-1 contract): the
+registry / span / flight primitives are pure host objects, and the
+router round-trips run through in-process stub workers — no
+subprocesses, no jitted programs.  The end-to-end plane (2 real
+workers, SIGKILL, merged Perfetto trace + Prometheus snapshot + flight
+dump) rides the run_tests.sh federation smoke.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from megba_tpu import observability as obs
+from megba_tpu.common import (
+    AlgoOption,
+    ProblemOption,
+    SolverOption,
+    SolveStatus,
+)
+from megba_tpu.io.synthetic import make_synthetic_bal
+from megba_tpu.serving import (
+    BucketLadder,
+    FleetProblem,
+    FleetResult,
+    FleetRouter,
+    FleetStats,
+    classify,
+)
+from megba_tpu.serving.federation import WorkerLostError
+
+OPT64 = ProblemOption(dtype=np.float64,
+                      algo_option=AlgoOption(max_iter=6),
+                      solver_option=SolverOption(max_iter=12, tol=1e-10))
+LADDER = BucketLadder()
+
+
+def _mk(seed, n_pt, n_cam=4):
+    s = make_synthetic_bal(num_cameras=n_cam, num_points=n_pt,
+                           obs_per_point=3, seed=seed, param_noise=2e-2,
+                           pixel_noise=0.3, dtype=np.float64)
+    return FleetProblem.from_synthetic(s, name=f"s{seed}_p{n_pt}")
+
+
+def _stub_result(p) -> FleetResult:
+    sc = classify(*p.dims(), OPT64.dtype, LADDER)
+    return FleetResult(
+        name=p.name, shape=sc, lane=0, lanes=1,
+        cameras=np.asarray(p.cameras).copy(),
+        points=np.asarray(p.points).copy(),
+        cost=np.float64(1.0), initial_cost=np.float64(2.0),
+        iterations=1, accepted=1, pcg_iterations=1,
+        status=int(SolveStatus.CONVERGED), recoveries=0, latency_s=0.0)
+
+
+class StubWorker:
+    """In-process worker stand-in that speaks the observability ops:
+    adopts the solve frame's trace context into its own SpanRecorder
+    (shipping the spans back in the reply, like a real worker process)
+    and answers the `metrics` op with a canned registry snapshot."""
+
+    def __init__(self, worker_id, warm=(), behavior=None,
+                 metrics_snapshot=None):
+        self.worker_id = worker_id
+        self.warm = set(warm)
+        self.alive = True
+        self.pid = 0
+        self.behavior = behavior
+        self.metrics_snapshot = metrics_snapshot
+        self.batches = []
+
+    def request(self, msg, timeout_s=None):
+        op = msg.get("op")
+        if op == "shutdown":
+            return {"ok": True}
+        if op == "metrics":
+            return {"ok": True, "metrics": self.metrics_snapshot}
+        problems = msg["problems"]
+        self.batches.append([p.name for p in problems])
+        if self.behavior is not None:
+            return self.behavior(self, problems)
+        from megba_tpu.observability import spans as spans_mod
+
+        rec = spans_mod.SpanRecorder(process_name=self.worker_id)
+        with rec.adopt("worker_solve", msg.get("trace"),
+                       worker=self.worker_id):
+            results = [_stub_result(p) for p in problems]
+        return {"ok": True, "results": results,
+                "warm": sorted(self.warm), "spans": rec.drain()}
+
+    def terminate(self):
+        self.alive = False
+
+
+@pytest.fixture
+def armed(monkeypatch, tmp_path):
+    """Arm all three plane knobs with fresh process defaults; disarm
+    and reset after, so no other in-process test observes the plane."""
+    from megba_tpu.observability import flight, metrics, spans
+
+    flight_path = tmp_path / "flight.jsonl"
+    monkeypatch.setenv("MEGBA_METRICS", "1")
+    monkeypatch.setenv("MEGBA_TRACE", "1")
+    monkeypatch.setenv("MEGBA_FLIGHT", str(flight_path))
+    metrics.reset_default_registry()
+    spans.reset_default_recorder()
+    flight.reset_default_recorder()
+    yield flight_path
+    metrics.reset_default_registry()
+    spans.reset_default_recorder()
+    flight.reset_default_recorder()
+
+
+# ------------------------------------------------------------- gates
+
+
+def test_gates_closed_by_default(monkeypatch):
+    for knob in ("MEGBA_METRICS", "MEGBA_TRACE", "MEGBA_FLIGHT"):
+        monkeypatch.delenv(knob, raising=False)
+    assert obs.metrics_registry() is None
+    assert obs.span_recorder() is None
+    assert obs.flight_recorder() is None
+    # the explicit per-solve knob opens the metrics gate without env
+    assert obs.metrics_registry(enabled=True) is not None
+
+
+# ---------------------------------------------------------- registry
+
+
+def test_registry_thread_safety_under_concurrent_increments():
+    from megba_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    n_threads, n_each = 8, 500
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(n_each):
+            reg.counter("megba_test_total", "t").inc(bucket=f"b{tid % 2}")
+            reg.gauge("megba_test_depth", "t").max(i, bucket="b0")
+            reg.histogram("megba_test_lat", "t").observe(
+                0.001 * (i % 7 + 1), bucket="b0")
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = reg.snapshot()
+    counters = snap["metrics"]["megba_test_total"]["series"]
+    assert sum(counters.values()) == n_threads * n_each
+    assert counters["bucket=b0"] == counters["bucket=b1"]
+    hist = snap["metrics"]["megba_test_lat"]["series"]["bucket=b0"]
+    assert hist["count"] == n_threads * n_each
+    assert sum(hist["buckets"]) == hist["count"]  # nothing above 60s
+    assert snap["metrics"]["megba_test_depth"]["series"]["bucket=b0"] == (
+        n_each - 1)
+
+
+def test_prometheus_exposition_golden():
+    from megba_tpu.observability.metrics import (
+        MetricsRegistry, render_prometheus)
+
+    reg = MetricsRegistry()
+    reg.counter("megba_solves_total", "Solves by status").inc(
+        3, status="converged", bucket="B1")
+    reg.counter("megba_solves_total", "Solves by status").inc(
+        1, status="max_iter", bucket="B1")
+    reg.gauge("megba_queue_depth", "Queue depth").set(7)
+    h = reg.histogram("megba_latency_seconds", "Latency",
+                      buckets=(0.1, 1.0))
+    h.observe(0.05, bucket="B1")
+    h.observe(0.5, bucket="B1")
+    h.observe(5.0, bucket="B1")
+
+    golden = (
+        "# HELP megba_latency_seconds Latency\n"
+        "# TYPE megba_latency_seconds histogram\n"
+        'megba_latency_seconds_bucket{bucket="B1",le="0.1"} 1\n'
+        'megba_latency_seconds_bucket{bucket="B1",le="1"} 2\n'
+        'megba_latency_seconds_bucket{bucket="B1",le="+Inf"} 3\n'
+        'megba_latency_seconds_sum{bucket="B1"} 5.55\n'
+        'megba_latency_seconds_count{bucket="B1"} 3\n'
+        "# HELP megba_queue_depth Queue depth\n"
+        "# TYPE megba_queue_depth gauge\n"
+        "megba_queue_depth 7\n"
+        "# HELP megba_solves_total Solves by status\n"
+        "# TYPE megba_solves_total counter\n"
+        'megba_solves_total{bucket="B1",status="converged"} 3\n'
+        'megba_solves_total{bucket="B1",status="max_iter"} 1\n'
+    )
+    assert render_prometheus(reg.snapshot()) == golden
+
+
+def test_merge_snapshots_sums_and_is_bitwise_deterministic():
+    from megba_tpu.observability.metrics import (
+        MetricsRegistry, merge_snapshots, snapshot_to_json)
+
+    def make(n):
+        reg = MetricsRegistry()
+        reg.counter("megba_x_total", "x").inc(n, bucket="B1")
+        reg.gauge("megba_depth", "d").set(n)
+        reg.histogram("megba_lat", "l").observe(0.01 * n, bucket="B1")
+        return reg.snapshot()
+
+    a, b = make(2), make(5)
+    merged = merge_snapshots([a, b])
+    assert merged["metrics"]["megba_x_total"]["series"]["bucket=B1"] == 7
+    assert merged["metrics"]["megba_depth"]["series"][""] == 7
+    assert merged["metrics"]["megba_lat"]["series"]["bucket=B1"][
+        "count"] == 2
+    # bitwise: merge order of equal inputs does not matter, and the
+    # canonical JSON encoding is stable across repeated merges
+    assert snapshot_to_json(merge_snapshots([a, b])) == snapshot_to_json(
+        merge_snapshots([a, b]))
+    assert (merge_snapshots([a, b])["metrics"]
+            == merge_snapshots([b, a])["metrics"])
+
+
+def test_merge_rejects_bucket_boundary_skew():
+    from megba_tpu.observability.metrics import (
+        MetricsRegistry, merge_snapshots)
+
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    r1.histogram("megba_lat", "l", buckets=(0.1, 1.0)).observe(0.5)
+    r2.histogram("megba_lat", "l", buckets=(0.2, 2.0)).observe(0.5)
+    with pytest.raises(ValueError, match="bucket mismatch"):
+        merge_snapshots([r1.snapshot(), r2.snapshot()])
+
+
+def test_fleet_stats_mirror_into_registry(armed):
+    from megba_tpu.observability import metrics as metrics_mod
+
+    stats = FleetStats()
+    stats.record_shed(2)
+    stats.record_retry(rung=1)
+    stats.record_wait("B1", 0.02)
+    snap = metrics_mod.default_registry().snapshot()
+    m = snap["metrics"]
+    assert m["megba_queue_shed_total"]["series"][""] == 2
+    assert m["megba_queue_retries_total"]["series"]["rung=1"] == 1
+    assert m["megba_queue_wait_seconds"]["series"]["bucket=B1"][
+        "count"] == 1
+
+
+# ------------------------------------------------------------- spans
+
+
+def test_span_context_propagates_router_to_worker(armed):
+    probs = [_mk(0, 16), _mk(1, 16)]
+    w0 = StubWorker("w0")
+    with FleetRouter(OPT64, workers=[w0], max_batch=8) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        [f.result(timeout=5) for f in futs]
+
+    recorder = obs.span_recorder()
+    assert recorder is not None
+    spans = recorder.spans()
+    dispatches = [s for s in spans if s["name"] == "fed_dispatch"]
+    workers = [s for s in spans if s["name"] == "worker_solve"]
+    assert dispatches and workers
+    by_id = {s["span_id"]: s for s in spans}
+    for ws in workers:
+        parent = by_id[ws["parent_id"]]  # grafted under the dispatch
+        assert parent["name"] == "fed_dispatch"
+        assert ws["trace_id"] == parent["trace_id"]
+        assert ws["process"] == "w0"
+
+
+def test_chrome_trace_export_schema(armed):
+    from megba_tpu.observability import spans as spans_mod
+
+    rec = obs.span_recorder()
+    with rec.span("request", bucket="B1"):
+        with rec.span("solve_bucket"):
+            rec.record_phase("dispatch", 0.01)
+    doc = spans_mod.to_chrome_trace(rec.spans())
+    assert doc["schema"] == spans_mod.SCHEMA
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert meta and meta[0]["name"] == "process_name"
+    assert {e["name"] for e in complete} == {
+        "request", "solve_bucket", "phase.dispatch"}
+    for e in complete:
+        assert e["dur"] >= 0 and isinstance(e["pid"], int)
+        assert 0 <= e["tid"] < (1 << 31)
+        assert e["args"]["trace_id"]
+    # the export is valid JSON end-to-end (the Perfetto load surface)
+    json.loads(json.dumps(doc))
+
+
+# ------------------------------------------------------------ flight
+
+
+def test_flight_dump_rides_worker_loss(armed):
+    flight_path = armed
+
+    def die(worker, problems):
+        raise WorkerLostError(worker.worker_id, "stub sigkill")
+
+    probs = [_mk(0, 16)]
+    with FleetRouter(OPT64, workers=[StubWorker("w0", behavior=die)],
+                     max_batch=8, max_reroutes=0) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        with pytest.raises(WorkerLostError):
+            futs[0].result(timeout=5)
+
+    from megba_tpu.observability import flight as flight_mod
+
+    dumps = flight_mod.load_dumps(str(flight_path))
+    assert dumps, "worker loss did not dump the flight ring"
+    assert dumps[-1]["reason"].startswith("worker_lost")
+    kinds = [e["kind"] for e in dumps[-1]["events"]]
+    assert "worker_lost" in kinds
+    lost = [e for e in dumps[-1]["events"] if e["kind"] == "worker_lost"]
+    assert lost[-1]["worker"] == "w0"
+    assert lost[-1]["reason"] == "stub sigkill"
+
+
+def test_flight_ring_is_bounded_and_ordered():
+    from megba_tpu.observability.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, process_name="t")
+    for i in range(10):
+        rec.record("tick", i=i)
+    events = rec.events()
+    assert len(events) == 4
+    assert [e["i"] for e in events] == [6, 7, 8, 9]
+    assert [e["seq"] for e in events] == [7, 8, 9, 10]
+    d = rec.dump_dict(reason="test")
+    assert d["dropped"] == 6 and d["process"] == "t"
+
+
+# -------------------------------------------------- fleet harvesting
+
+
+def test_router_metrics_snapshot_merges_and_repeats_bitwise(armed):
+    from megba_tpu.observability import metrics as metrics_mod
+
+    def worker_snap(n):
+        reg = metrics_mod.MetricsRegistry()
+        reg.counter("megba_solve_status_total", "s").inc(
+            n, status="converged", bucket="B1")
+        reg.histogram("megba_fleet_batch_latency_seconds", "l").observe(
+            0.01 * n, bucket="B1", factor="bal")
+        return reg.snapshot()
+
+    w0 = StubWorker("w0", metrics_snapshot=worker_snap(2))
+    w1 = StubWorker("w1", metrics_snapshot=worker_snap(3))
+    probs = [_mk(0, 16)]
+    with FleetRouter(OPT64, workers=[w0, w1], max_batch=8) as router:
+        futs = [router.submit(p) for p in probs]
+        router.flush()
+        [f.result(timeout=5) for f in futs]
+        first = router.metrics_snapshot()
+        second = router.metrics_snapshot()
+
+    assert first is not None
+    # worker series merged (2 + 3), router's own dispatch counter rides
+    m = first["metrics"]
+    assert m["megba_solve_status_total"]["series"][
+        "bucket=B1,status=converged"] == 5
+    assert m["megba_fleet_batch_latency_seconds"]["series"][
+        "bucket=B1,factor=bal"]["count"] == 2
+    assert sum(m["megba_fed_dispatch_total"]["series"].values()) == 1
+    # bitwise-deterministic across repeated pulls on an idle fleet
+    assert metrics_mod.snapshot_to_json(first) == (
+        metrics_mod.snapshot_to_json(second))
+    # and the merged snapshot renders as valid Prometheus text
+    text = metrics_mod.render_prometheus(first)
+    assert "megba_solve_status_total{" in text
+    assert "megba_fed_dispatch_total{" in text
+
+
+def test_router_metrics_snapshot_none_when_plane_off(monkeypatch):
+    for knob in ("MEGBA_METRICS", "MEGBA_TRACE", "MEGBA_FLIGHT"):
+        monkeypatch.delenv(knob, raising=False)
+    w0 = StubWorker("w0")
+    with FleetRouter(OPT64, workers=[w0], max_batch=8) as router:
+        assert router.metrics_snapshot() is None
+
+
+# --------------------------------------------------- SolveReport v2
+
+
+def test_solve_report_v2_roundtrip_and_v1_readable():
+    from megba_tpu.observability.report import SCHEMA, SolveReport
+
+    rep = SolveReport(
+        problem={"num_cameras": 4}, config={}, backend={}, phases={},
+        result={"status_name": "converged"}, trace_id="aa" * 8,
+        span_id="bb" * 8, worker="w1", created_unix=123.0)
+    back = SolveReport.from_json(rep.to_json())
+    assert back.schema == SCHEMA and back.schema.endswith("/v2")
+    assert (back.trace_id, back.span_id, back.worker) == (
+        "aa" * 8, "bb" * 8, "w1")
+    # a v1 line (no identity fields) still loads, identity defaults None
+    v1 = json.dumps({
+        "problem": {}, "config": {}, "backend": {}, "phases": {},
+        "result": {}, "schema": "megba_tpu.solve_report/v1",
+        "created_unix": 1.0, "not_a_field": True})
+    old = SolveReport.from_json(v1)
+    assert old.trace_id is None and old.worker is None
+
+
+def test_summarize_fleet_table_and_metrics_render(tmp_path, capsys):
+    from megba_tpu.observability import summarize
+    from megba_tpu.observability.metrics import (
+        MetricsRegistry, snapshot_to_json)
+    from megba_tpu.observability.report import SolveReport, append_report
+
+    sink = tmp_path / "fleet.jsonl"
+    for i, (bucket, worker, lm) in enumerate(
+            [("B1", "w0", 3), ("B1", "w1", 5), ("B2", "w0", 7)]):
+        append_report(SolveReport(
+            problem={}, config={}, backend={}, phases={},
+            result={"iterations": lm, "pcg_iterations": 2 * lm,
+                    "status_name": "converged"},
+            fleet={"bucket": bucket, "latency_s": 0.01 * (i + 1)},
+            trace_id=f"t{i:02d}", span_id=f"s{i:02d}", worker=worker,
+            created_unix=100.0 + i), str(sink))
+    # one v1-style line (no worker/trace fields) must not break the table
+    with open(sink, "a") as fh:
+        fh.write(json.dumps({
+            "problem": {}, "config": {}, "backend": {}, "phases": {},
+            "result": {"iterations": 1, "pcg_iterations": 1},
+            "schema": "megba_tpu.solve_report/v1",
+            "created_unix": 99.0}) + "\n")
+
+    reg = MetricsRegistry()
+    reg.counter("megba_fleet_batches_total", "b").inc(
+        2, bucket="B1", factor="bal", rung="0")
+    snap_path = tmp_path / "metrics.json"
+    snap_path.write_text(snapshot_to_json(reg.snapshot()))
+
+    rc = summarize.main(
+        ["--fleet", "--metrics", str(snap_path), str(sink)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet table: 4 solves" in out
+    assert "B1" in out and "B2" in out and "unbatched" in out
+    assert "by worker:" in out and "w0:" in out
+    assert "traced: 3 solves in 3 traces" in out
+    assert "metrics snapshot" in out
+    assert "megba_fleet_batches_total" in out
