@@ -60,6 +60,11 @@ class FunctionInfo:
     children: List[str] = dataclasses.field(default_factory=list)
     refs: Set[str] = dataclasses.field(default_factory=set)
     is_entry: bool = False
+    # Dotted qualname of the innermost enclosing class, when this def is
+    # a method (None for plain functions).  Lets `self.method()` calls
+    # resolve to the defining class — the cross-method lock edges the
+    # concurrency passes follow.
+    classname: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -82,6 +87,10 @@ class PackageIndex:
         self.modules: Dict[str, ModuleInfo] = {}
         self.functions: Dict[str, FunctionInfo] = {}
         self.reachable: Set[str] = set()
+        # class qualname -> method simple name -> function qualname
+        # (immediate methods only; no inheritance walking — the linter
+        # never guesses).
+        self.classes: Dict[str, Dict[str, str]] = {}
 
     # ------------------------------------------------------------- build
     @classmethod
@@ -118,27 +127,34 @@ class PackageIndex:
         self._index_functions(mod, tree, parent=None, prefix=modname)
 
     def _index_functions(self, mod: ModuleInfo, node: ast.AST,
-                         parent: Optional[str], prefix: str) -> None:
+                         parent: Optional[str], prefix: str,
+                         classname: Optional[str] = None) -> None:
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qual = f"{prefix}.{child.name}"
                 info = FunctionInfo(
-                    qualname=qual, module=mod.name, node=child, parent=parent)
+                    qualname=qual, module=mod.name, node=child, parent=parent,
+                    classname=classname)
                 self.functions[qual] = info
+                if classname is not None:
+                    self.classes.setdefault(classname, {})[child.name] = qual
                 if parent is not None:
                     self.functions[parent].children.append(qual)
                 else:
                     mod.functions[child.name] = qual
-                self._index_functions(mod, child, parent=qual, prefix=qual)
+                # A method's own nested defs are plain functions again.
+                self._index_functions(mod, child, parent=qual, prefix=qual,
+                                      classname=None)
             elif isinstance(child, ast.ClassDef):
                 # Methods are indexed too (flat qualname through the class).
                 self._index_functions(
-                    mod, child, parent=parent, prefix=f"{prefix}.{child.name}")
+                    mod, child, parent=parent, prefix=f"{prefix}.{child.name}",
+                    classname=f"{prefix}.{child.name}")
             elif isinstance(child, (ast.If, ast.Try, ast.With, ast.For,
                                     ast.While)):
                 # Compound statements at the same scope can hold defs —
                 # mesh.py's shard_map fallback lives in an `except:` block.
-                self._index_functions(mod, child, parent, prefix)
+                self._index_functions(mod, child, parent, prefix, classname)
 
     # -------------------------------------------------- refs and entries
     def _scope_chain(self, mod: ModuleInfo,
@@ -157,6 +173,17 @@ class PackageIndex:
         if dotted is None:
             return None
         head, *rest = dotted.split(".")
+        # 0. `self.method(...)` resolves to the innermost enclosing
+        #    class's own method (closures nested in methods capture
+        #    `self`, so the whole scope chain is searched).  Immediate
+        #    methods only — no inheritance guessing.
+        if head == "self" and len(rest) == 1:
+            for scope in self._scope_chain(mod, func):
+                if scope.classname is not None:
+                    q = self.classes.get(scope.classname, {}).get(rest[0])
+                    if q is not None:
+                        return q
+                    break  # innermost class decides; never walk outward
         # 1. lexical function scopes: own nested defs, then siblings via
         #    each enclosing function's children
         if not rest:

@@ -12,6 +12,9 @@ Rule ids are kebab-case; suppress one finding with an inline
 | donated-reuse | an argument passed at a `donate_argnums` position of a locally-built `jax.jit` program must not be read after the call — the buffer is deleted by the call |
 | weak-literal | no BARE float literal as a `jnp.where` branch or `jnp.clip` bound in jit-reachable code — probed on this jaxlib: under x64 those positions materialise a `tensor<f64>` constant (plus a convert) in f32 programs, the dtype-census leak hand-fixed in PRs 3 and 6 (`jnp.where(safe, θ², 1.0)`, `jnp.where(..., 0.0, ...)`); use `zeros_like`/`ones_like`/`jnp.asarray(c, x.dtype)`.  Plain arithmetic (`2.0 * x`) and `jnp.maximum/minimum` literals promote weakly and are clean — the rule matches only the probed leaky positions |
 | raw-clock | no raw `time.time()` / `time.perf_counter()` outside the sanctioned clock homes (`utils/timing.py`, `observability/`) — scattered raw reads fragment the timing story the observability plane narrates (PhaseTimer phases, span timestamps, report `created_unix` all flow from ONE seam); use `utils.timing.monotonic_s()` for durations and `utils.timing.wall_unix()` for epoch stamps.  `time.monotonic()` deadline arithmetic and `time.sleep` are clean — the rule bans the two reads that LOOK interchangeable but are not |
+| guarded-by | shared mutable attributes of lock-owning classes, declared with `# megba: guarded-by(<lockattr>)` on the assignment (or inferred at >= 80% locked accesses in thread-reachable classes), must not be read/written outside a `with <lock>` block — the host serving tier's race detector (analysis/concurrency.py); `# megba: allow-unguarded` is the per-line escape hatch |
+| lock-order | the package-wide acquires-while-holding digraph (nested `with` blocks, cross-method/cross-class edges through the callgraph, `Condition.wait` re-acquires) must be acyclic — a cycle is a deadlock waiting for the right interleaving; the finding prints the witness path |
+| blocking-under-lock | no call from the curated blocking set (`Future.result`, `queue.get`/`join`, socket/pipe `recv*`, `subprocess`-style `.wait`, `time.sleep` above 0.05 s, the RPC `_recv_frame`) while any lock is held — the classic serve-loop stall shape; waiting on a HELD Condition is the sanctioned exception (it releases the lock) |
 """
 
 from __future__ import annotations
@@ -20,6 +23,7 @@ import ast
 import dataclasses
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from megba_tpu.analysis import concurrency
 from megba_tpu.analysis.callgraph import (
     FunctionInfo,
     ModuleInfo,
@@ -62,6 +66,9 @@ ALL_RULES = (
     "donated-reuse",
     "weak-literal",
     "raw-clock",
+    "guarded-by",
+    "lock-order",
+    "blocking-under-lock",
 )
 
 # Fully-resolved call targets the raw-clock rule bans (time.monotonic,
@@ -389,6 +396,27 @@ def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
     return ()
 
 
+# ------------------------------------------------ concurrency rules
+# The analysis lives in analysis/concurrency.py (it yields plain
+# (path, line, col, message) tuples so it never needs this module);
+# these wrappers stamp the rule ids.
+
+
+def rule_guarded_by(index: PackageIndex) -> Iterator[Finding]:
+    for path, line, col, msg in concurrency.find_guarded_by(index):
+        yield Finding(path, line, col, "guarded-by", msg)
+
+
+def rule_lock_order(index: PackageIndex) -> Iterator[Finding]:
+    for path, line, col, msg in concurrency.find_lock_order(index):
+        yield Finding(path, line, col, "lock-order", msg)
+
+
+def rule_blocking_under_lock(index: PackageIndex) -> Iterator[Finding]:
+    for path, line, col, msg in concurrency.find_blocking_under_lock(index):
+        yield Finding(path, line, col, "blocking-under-lock", msg)
+
+
 RULES = {
     "host-callback": rule_host_callback,
     "np-in-jit": rule_np_in_jit,
@@ -397,4 +425,7 @@ RULES = {
     "donated-reuse": rule_donated_reuse,
     "weak-literal": rule_weak_literal,
     "raw-clock": rule_raw_clock,
+    "guarded-by": rule_guarded_by,
+    "lock-order": rule_lock_order,
+    "blocking-under-lock": rule_blocking_under_lock,
 }

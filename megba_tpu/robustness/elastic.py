@@ -139,6 +139,12 @@ class HeartbeatBoard:
         self.straggler_after_s = float(straggler_after_s)
         self.dead_after_s = float(dead_after_s)
         self._clock = clock
+        # Thread-confined, not locked: `_counter` belongs to whichever
+        # single thread drives beat() (the monitor's beater thread —
+        # start()'s one pre-spawn beat orders-before via Thread.start),
+        # and the observation maps belong to the observing thread
+        # (ElasticMonitor's guard poll loop).  Cross-thread publication
+        # happens through the filesystem (atomic replace), never these.
         self._counter = 0
         self._last_value: Dict[int, int] = {}
         self._last_change: Dict[int, float] = {}
@@ -228,9 +234,9 @@ class CollectiveWatchdog:
     def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
         self._clock = clock
         self._lock = threading.Lock()
-        self._armed: Dict[int, _Armed] = {}
-        self._next_token = 0
-        self.timeouts = 0  # lifetime count of deadlines that fired
+        self._armed: Dict[int, _Armed] = {}  # megba: guarded-by(_lock)
+        self._next_token = 0  # megba: guarded-by(_lock)
+        self.timeouts = 0  # megba: guarded-by(_lock); deadlines fired
 
     def arm(self, label: str, budget_s: float,
             now: Optional[float] = None) -> int:

@@ -303,7 +303,7 @@ class CompilePool:
 
     def __init__(self, stats=None, artifacts=None, timer=None) -> None:
         self._stats = stats
-        self._seen: Dict[Tuple, Dict[str, Any]] = {}  # key -> manifest entry
+        self._seen: Dict[Tuple, Dict[str, Any]] = {}  # megba: guarded-by(_lock); key -> manifest entry
         self._lock = threading.Lock()
         # `artifacts` — an ArtifactStore (or its root path) of serialized
         # executables (serving/artifacts.py): warm()/program() try the

@@ -256,18 +256,18 @@ class FederationStats:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.router = uuid.uuid4().hex[:12]
-        self.problems = 0  # problems resolved through the router
-        self.problems_by_worker: Dict[str, int] = {}
-        self.steals = 0  # steal events (one per pulled batch)
-        self.stolen_problems = 0  # problems moved by steals
-        self.reroutes = 0  # problems requeued off a lost worker
-        self.reroute_failures = 0  # problems that exhausted max_reroutes
-        self.workers_lost = 0
-        self.sheds = 0  # deadline-expired problems shed before dispatch
-        self.deadline_misses = 0  # results delivered AFTER their deadline
-        self.cold_start: Dict[str, Dict[str, Any]] = {}  # worker -> hello
-        self.first_solve: Dict[str, Dict[str, Any]] = {}
-        self.lost_workers: List[str] = []
+        self.problems = 0  # megba: guarded-by(_lock); resolved via router
+        self.problems_by_worker: Dict[str, int] = {}  # megba: guarded-by(_lock)
+        self.steals = 0  # megba: guarded-by(_lock); one per pulled batch
+        self.stolen_problems = 0  # megba: guarded-by(_lock)
+        self.reroutes = 0  # megba: guarded-by(_lock); requeued off a loss
+        self.reroute_failures = 0  # megba: guarded-by(_lock); max_reroutes hit
+        self.workers_lost = 0  # megba: guarded-by(_lock)
+        self.sheds = 0  # megba: guarded-by(_lock); shed before dispatch
+        self.deadline_misses = 0  # megba: guarded-by(_lock); delivered late
+        self.cold_start: Dict[str, Dict[str, Any]] = {}  # megba: guarded-by(_lock); worker -> hello
+        self.first_solve: Dict[str, Dict[str, Any]] = {}  # megba: guarded-by(_lock)
+        self.lost_workers: List[str] = []  # megba: guarded-by(_lock)
 
     def record_batch(self, worker_id: str, n: int, stolen: bool) -> None:
         with self._lock:
@@ -558,10 +558,16 @@ def _shape_of(entry: Dict[str, Any]):
 class WorkerHandle:
     """One spawned worker: process + channel + router-side bookkeeping.
 
-    `request` is strictly lockstep (one outstanding request per worker;
-    each worker is driven by exactly one router thread) and converts
-    every death signal — pipe EOF, process exit, heartbeat DEAD — into
-    a typed `WorkerLostError`."""
+    `request` is strictly lockstep at the FRAME level (the worker's
+    serve loop answers one request at a time, in arrival order) but no
+    lock is ever held across the blocking reply read: sends are
+    serialized under `_req_lock` and stamped with a ticket, and replies
+    are read in ticket order under the `_turn` condition — the reader
+    whose turn it is owns the pipe with every lock released, so an
+    out-of-band `metrics` pull never stalls a lock behind a whole solve
+    RPC (the blocking-under-lock shape lint lane 6 polices).  Every
+    death signal — pipe EOF, process exit, heartbeat DEAD — converts
+    into a typed `WorkerLostError`."""
 
     def __init__(self, worker_id: str, proc: subprocess.Popen,
                  chan: FrameChannel, log_path: str,
@@ -572,14 +578,26 @@ class WorkerHandle:
         self.chan = chan
         self.log_path = log_path
         self.liveness = liveness
+        # `warm`/`alive` are confined to this worker's serve thread once
+        # it starts (spawn-time writes order-before via Thread.start;
+        # close() reads only after joining it).  Cross-thread consumers
+        # go through FleetRouter's locked `_views` mirror instead — see
+        # metrics_snapshot().
         self.warm: set = set()
         self.alive = True
         self.pid = proc.pid
         self.rank = 0  # heartbeat-board rank, set by the router at spawn
-        # Serializes out-of-band pulls (metrics_snapshot) against the
-        # serve thread: the channel is strictly lockstep, so two
-        # concurrent requests would interleave frames.
+        # Serializes SENDS (the channel is strictly lockstep, so two
+        # concurrent writers would interleave frames) and hands out
+        # reply tickets; never held across a read.
         self._req_lock = threading.Lock()
+        self._next_send = 0  # megba: guarded-by(_req_lock)
+        # Orders reply reads: replies arrive in send order (the worker
+        # serve loop is single-threaded FIFO), so ticket n reads the
+        # n-th reply — exclusivity without holding anything during the
+        # blocking recv.
+        self._turn = threading.Condition()
+        self._next_recv = 0  # megba: guarded-by(_turn)
 
     def _poll(self) -> None:
         rc = self.proc.poll()
@@ -596,8 +614,22 @@ class WorkerHandle:
         try:
             with self._req_lock:
                 self.chan.send(msg)
+                ticket = self._next_send
+                self._next_send += 1
+            with self._turn:
+                while self._next_recv != ticket:
+                    self._turn.wait()
+            try:
+                # Our turn: ticket order makes this thread the sole
+                # reader, with no lock held across the blocking recv.
                 return self.chan.recv(timeout_s=timeout_s,
                                       poll=self._poll)
+            finally:
+                # Always pass the turn — even on a broken pipe the next
+                # ticket holder must wake (its own recv then raises).
+                with self._turn:
+                    self._next_recv += 1
+                    self._turn.notify_all()
         except (FrameError, BrokenPipeError, OSError) as exc:
             rc = self.proc.poll()
             raise WorkerLostError(
@@ -705,22 +737,25 @@ class FleetRouter:
         self.telemetry = telemetry
 
         self._lock = threading.Condition()
-        self._pending: Dict[Tuple, List[_Routed]] = {}
-        self._npending = 0
-        self._closed = False
+        self._pending: Dict[Tuple, List[_Routed]] = {}  # megba: guarded-by(_lock)
+        self._npending = 0  # megba: guarded-by(_lock)
+        self._closed = False  # megba: guarded-by(_lock)
         self.pinned = False  # did worker CPU pinning actually apply?
         self._own_hb_dir: Optional[str] = None
         # Deadline-carrying items currently pending: the shed scan is
         # O(pending) under the router lock on every serve-thread wakeup,
         # so it only runs while this is nonzero (deadline-free fleets —
         # the common case — pay nothing).
-        self._ndeadline = 0
-        self._inflight = 0
-        self._closing = False
-        self._table = RoutingTable()
-        self._views: Dict[str, WorkerView] = {}
+        self._ndeadline = 0  # megba: guarded-by(_lock)
+        self._inflight = 0  # megba: guarded-by(_lock)
+        self._closing = False  # megba: guarded-by(_lock)
+        self._table = RoutingTable()  # megba: guarded-by(_lock)
+        self._views: Dict[str, WorkerView] = {}  # megba: guarded-by(_lock)
+        # Serializes HeartbeatBoard.observe across serve threads: the
+        # board's observation maps are thread-confined state, and every
+        # worker's liveness closure may poll concurrently.
         self._hb_lock = threading.Lock()
-        self._board = None
+        self._board = None  # set once in _spawn_workers, pre-thread-start
 
         if workers is not None:
             self.workers: Dict[str, Any] = {w.worker_id: w for w in workers}
@@ -1030,9 +1065,14 @@ class FleetRouter:
         registry = _obs.metrics_registry()
         if registry is not None:
             snaps.append(registry.snapshot())
-        for w in self.workers.values():
-            if not getattr(w, "alive", False):
-                continue
+        # Liveness comes from the locked `_views` mirror, not the
+        # handles' `alive` flags: a serve thread declaring a loss writes
+        # the flag concurrently with this pull, and the router lock is
+        # the only ordering the two threads share (guarded-by contract).
+        with self._lock:
+            live = [w for w in self.workers.values()
+                    if self._views[w.worker_id].alive]
+        for w in live:
             try:
                 reply = w.request({"op": "metrics"}, timeout_s=60.0)
             except Exception:

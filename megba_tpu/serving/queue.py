@@ -176,15 +176,15 @@ class FleetQueue:
         # history survives an empty queue.
         self._pending: Dict[
             Tuple[ShapeClass, Tuple[int, int, int], str, int],
-            List[_Pending]] = {}
-        self._inflight = 0  # work taken from _pending, not yet resolved
-        self._npending = 0  # O(1) pending gauge (append/take/shed-kept)
-        self._seq = 0
-        self._closing = False
+            List[_Pending]] = {}  # megba: guarded-by(_lock)
+        self._inflight = 0  # megba: guarded-by(_lock); taken, unresolved
+        self._npending = 0  # megba: guarded-by(_lock); O(1) pending gauge
+        self._seq = 0  # megba: guarded-by(_lock)
+        self._closing = False  # megba: guarded-by(_lock)
         # Active flush() count, not a bool: concurrent flushes must not
         # clobber each other's drain mode (the first to finish would
         # otherwise strand the second behind backoff/breaker waits).
-        self._force = 0
+        self._force = 0  # megba: guarded-by(_lock)
         self._thread = threading.Thread(
             target=self._run, name="megba-fleet-dispatch", daemon=True)
         self._thread.start()

@@ -1,7 +1,7 @@
 #!/bin/bash
 # Static-analysis + sanitizer lane (megba_tpu/analysis/).
 #
-# Five gates, all required (scripts/run_tests.sh invokes this, so
+# Six gates, all required (scripts/run_tests.sh invokes this, so
 # tier-1 cannot pass with a violation in any of them):
 #
 #   1. the JAX-contract linter runs CLEAN on the package;
@@ -20,7 +20,12 @@
 #      branches / jnp.clip bounds materialise f64 constants under x64)
 #      run standalone over the package — gate 1 includes it, but this
 #      lane keeps the dtype-surface story visible as its own step
-#      beside gate 4's bf16 surface census.
+#      beside gate 4's bf16 surface census;
+#   6. the concurrency contract lane: guarded-by race detection,
+#      lock-order deadlock analysis, and blocking-under-lock checks
+#      over the host serving tier, plus must-fire / must-stay-silent
+#      checks on the seeded concurrency fixtures (each of the three
+#      rule ids must appear in the bad fixture's findings).
 set -e -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -45,5 +50,28 @@ python -m megba_tpu.analysis.audit --check
 
 echo "[lint] weak-literal dtype-leak lane (lane 5)"
 python -m megba_tpu.analysis.lint --rule weak-literal megba_tpu/
+
+echo "[lint] concurrency contract lane (lane 6)"
+python -m megba_tpu.analysis.lint --rule guarded-by --rule lock-order \
+    --rule blocking-under-lock megba_tpu/
+
+echo "[lint] concurrency rules must fire on the seeded bad fixture"
+CONC_BAD=tests/data/lint_fixtures/bad_concurrency.py
+if conc_out=$(python -m megba_tpu.analysis.lint --rule guarded-by \
+    --rule lock-order --rule blocking-under-lock "$CONC_BAD" 2>&1); then
+    echo "ERROR: concurrency linter exited 0 on $CONC_BAD" >&2
+    exit 1
+fi
+for rule in guarded-by lock-order blocking-under-lock; do
+    if ! grep -q " $rule " <<< "$conc_out"; then
+        echo "ERROR: rule $rule produced no finding on $CONC_BAD" >&2
+        echo "$conc_out" >&2
+        exit 1
+    fi
+done
+
+echo "[lint] concurrency rules must stay silent on the good fixture"
+python -m megba_tpu.analysis.lint --rule guarded-by --rule lock-order \
+    --rule blocking-under-lock tests/data/lint_fixtures/good_concurrency.py
 
 echo "lint lane OK"

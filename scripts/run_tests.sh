@@ -808,7 +808,11 @@ echo "mixed-factor fleet smoke OK"
 # deterministic across repeated idle pulls), the trace recorder must
 # export ONE merged Chrome/Perfetto trace-event JSON spanning router
 # and worker pids (worker spans ride the RPC replies home), and the
-# w1 SIGKILL must leave a flight-recorder dump on disk.
+# w1 SIGKILL must leave a flight-recorder dump on disk.  The
+# concurrency stress (ISSUE 17) then solves a 200-problem fleet while
+# 4 reader threads hammer metrics_snapshot() over the shared RPC
+# stream and router lock — the live regression behind the guarded-by /
+# lock-order / blocking-under-lock contracts in lint lane 6.
 FED_DIR=$(mktemp -d /tmp/megba_federation_smoke.XXXXXX)
 trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$FED_DIR"' EXIT
 JAX_PLATFORMS=cpu MEGBA_FED_DIR="$FED_DIR" \
@@ -910,11 +914,80 @@ print(f"federation smoke: merged metrics snapshot OK "
       f"({len(snap['metrics'])} families, {n_series} samples, "
       "2 idle pulls bitwise-equal)")
 
+# -- concurrency stress (ISSUE 17): 200-problem fleet with the metrics
+# plane pulled concurrently from 4 reader threads WHILE solving.  The
+# pulls ride the same RPC stream as dispatch (WorkerHandle.request's
+# ticket-turn ordering) and the same router lock as the dispatch
+# bookkeeping (the guarded-by contracts) — a regression in either
+# wedges flush or kills a serve thread, and a snapshot race shows up
+# as a malformed/None pull.  All buckets are warm: zero compiles. -----
+import threading as _threading
+
+stress = [FleetProblem.from_synthetic(s, name=f"stress{i}")
+          for i, s in enumerate(
+              s for _ in range(13)
+              for s in make_fleet(16, size_range=(12, 96), seed=0,
+                                  dtype=np.float64))][:200]
+stop_pulls = _threading.Event()
+pull_errs = []
+pull_counts = [0] * 4
+
+def _puller(slot):
+    while not stop_pulls.is_set():
+        try:
+            s = router.metrics_snapshot()
+            assert s is not None and "metrics" in s, s
+            pull_counts[slot] += 1
+        except Exception as exc:  # noqa: BLE001 - collected and re-raised
+            pull_errs.append(f"reader {slot}: {type(exc).__name__}: {exc}")
+            return
+        time.sleep(0.02)
+
+readers = [_threading.Thread(target=_puller, args=(i,), daemon=True)
+           for i in range(4)]
+t0 = time.perf_counter()
+for r in readers:
+    r.start()
+stress_futs = router.submit_many(stress)
+router.flush()
+stress_results = [f.result(timeout=5) for f in stress_futs]
+stop_pulls.set()
+for r in readers:
+    r.join(timeout=10)
+assert not any(r.is_alive() for r in readers), "metrics reader wedged"
+assert not pull_errs, pull_errs
+assert min(pull_counts) >= 1, pull_counts
+for i, r in enumerate(stress_results):
+    # NOT bitwise vs control: 13 copies of one shape class co-batch
+    # into lane compositions the 16-problem control never saw.  Close
+    # agreement still catches cross-thread corruption cold.
+    c = control[i % 16]
+    assert int(r.status) == int(c.status), (r.name, r.status, c.status)
+    assert np.allclose(r.cameras, c.cameras, rtol=1e-6, atol=1e-9), r.name
+    assert np.allclose(r.cost, c.cost, rtol=1e-6), (r.name, r.cost, c.cost)
+
+# Idle again: 4 concurrent pulls must merge to ONE bitwise snapshot.
+idle_json = [None] * 4
+
+def _idle_pull(slot):
+    idle_json[slot] = obs_metrics.snapshot_to_json(router.metrics_snapshot())
+
+idlers = [_threading.Thread(target=_idle_pull, args=(i,)) for i in range(4)]
+for r in idlers:
+    r.start()
+for r in idlers:
+    r.join(timeout=10)
+assert all(j is not None for j in idle_json), "idle pull hung or died"
+assert len(set(idle_json)) == 1, "concurrent idle pulls disagree"
+print(f"federation smoke: 200-problem stress under {sum(pull_counts)} "
+      f"concurrent metric pulls in {time.perf_counter() - t0:.1f}s, "
+      "4 idle pulls bitwise-equal, 200/200 results match control")
+
 router.close()
 d = router.stats.as_dict()
 assert d["workers_lost"] == 1 and d["lost_workers"] == ["w1"], d
 assert d["reroutes"] >= 1, d
-assert sum(d["problems_by_worker"].values()) == 16, d
+assert sum(d["problems_by_worker"].values()) == 216, d  # 16 + 200 stress
 assert d["first_solve"]["w0"]["traces"] == 0, d["first_solve"]
 for r, c in zip(results, control):
     assert r.cameras.tobytes() == c.cameras.tobytes(), r.name

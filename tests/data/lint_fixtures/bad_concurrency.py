@@ -1,0 +1,119 @@
+"""Seeded BAD concurrency patterns — every block below must produce a
+finding (tests/test_concurrency.py pins the exact counts; lane 6 of
+scripts/lint.sh asserts the linter exits non-zero on this file for each
+of the three concurrency rule ids).
+
+NOT executed anywhere: this module exists purely as linter input.
+"""
+
+import queue
+import threading
+import time
+
+_REG_LOCK = threading.Lock()
+
+
+class UnguardedCounter:
+    """Declared contract violated: one unlocked write, one unlocked
+    read of a `guarded-by` field."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # megba: guarded-by(_lock)
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+
+    def racy_write(self):
+        self.hits += 1  # guarded-by: write without the lock
+
+    def racy_read(self):
+        return self.hits  # guarded-by: read without the lock
+
+
+class InferredRace:
+    """No pragma: 5 of 6 accesses hold `_mu` (>= 80%, >= 5 accesses)
+    and the class is thread-reachable, so the guard is inferred; the
+    unlocked read in `peek` flags."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.total = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+
+    def _work(self):
+        with self._mu:
+            self.total += 1
+            self.total += 2
+            self.total += 3
+            self.total += 4
+            self.total += 5
+
+    def peek(self):
+        return self.total  # guarded-by: inferred guard not held
+
+
+class Deadlock:
+    """Classic AB/BA inversion — the lock-order pass prints the
+    witness path."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class CondReacquire:
+    """The cycle exists ONLY because `Condition.wait` re-acquires its
+    condition LAST: `_locked_step` runs with `_cond` held at entry
+    (private helper, only called under it), nests `_gate`, then waits —
+    the wakeup re-acquires `_cond` while still holding `_gate`."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._gate = threading.Lock()
+
+    def step(self):
+        with self._cond:
+            self._locked_step()
+
+    def _locked_step(self):
+        with self._gate:  # lock-order: _cond -> _gate
+            self._cond.wait(0.01)  # re-acquire edge: _gate -> _cond
+
+
+def fetch_result(fut):
+    with _REG_LOCK:
+        return fut.result()  # blocking-under-lock: Future.result
+
+
+class BlockyServer:
+    """The serve-loop stall shapes: blocking I/O inside the critical
+    section."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain_one(self):
+        with self._lock:
+            return self._q.get()  # blocking-under-lock: queue get
+
+    def lazy_stop(self, worker):
+        with self._lock:
+            worker.join()  # blocking-under-lock: thread join
+            time.sleep(0.5)  # blocking-under-lock: long sleep
+
+    def pump(self, conn):
+        with self._lock:
+            return conn.recv(4096)  # blocking-under-lock: pipe recv

@@ -1,0 +1,114 @@
+"""Seeded GOOD concurrency patterns — every block below must stay
+silent under the guarded-by / lock-order / blocking-under-lock rules
+(lane 6 of scripts/lint.sh runs the linter over this file and fails on
+ANY finding; tests/test_concurrency.py pins zero).
+
+NOT executed anywhere: this module exists purely as linter input.
+"""
+
+import os
+import threading
+import time
+
+_STATE_LOCK = threading.Lock()
+
+
+class GuardedCounter:
+    """The declared contract, honoured — plus both escape hatches:
+    a field settled in __init__ (safe publication) and an explicit
+    `allow-unguarded` pragma on an approximate fast-path read."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # megba: guarded-by(_lock)
+        self.name = "counter"  # settled in __init__: publication is safe
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+
+    def read(self):
+        with self._lock:
+            return self.hits
+
+    def gauge_hint(self):
+        # An intentionally approximate read (monitoring display only).
+        return self.hits  # megba: allow-unguarded
+
+    def label(self):
+        return self.name  # read-only after __init__: no guard needed
+
+
+class LockedHelper:
+    """`_append_locked` is private and only ever called under the lock:
+    the entry-held fixed point grants it the guard, no pragma needed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # megba: guarded-by(_lock)
+        threading.Thread(target=self.run, daemon=True).start()
+
+    def run(self):
+        with self._lock:
+            self._append_locked(1)
+
+    def _append_locked(self, x):
+        self.items.append(x)  # caller holds the lock
+
+
+class CondWaiter:
+    """Sanctioned Condition use: waiting on the HELD condition releases
+    it — no stall, no ordering edge."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False  # megba: guarded-by(_cond)
+
+    def wait_ready(self):
+        with self._cond:
+            while not self.ready:
+                self._cond.wait(0.1)
+
+    def set_ready(self):
+        with self._cond:
+            self.ready = True
+            self._cond.notify_all()
+
+
+class OrderedLocks:
+    """Two locks, always nested in one global order: no inversion."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0  # megba: guarded-by(_b)
+
+    def one(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def two(self):
+        with self._a:
+            with self._b:
+                self.n -= 1
+
+
+def metadata(d, key):
+    with _STATE_LOCK:
+        return d.get(key, None)  # dict.get(key): not a queue get
+
+
+def label(parts):
+    with _STATE_LOCK:
+        return ", ".join(parts)  # str.join: not a thread join
+
+
+def artifact_path(root, name):
+    with _STATE_LOCK:
+        return os.path.join(root, name)  # path assembly, no blocking
+
+
+def tiny_pause():
+    with _STATE_LOCK:
+        time.sleep(0.01)  # below the 0.05 s stall threshold

@@ -1,0 +1,498 @@
+"""Concurrency contract lane: guarded-by / lock-order / blocking-under-lock.
+
+Compile-free tier-1 units — every finding class the analyzer knows gets
+a positive (fires on a handwritten fixture) AND a negative (silent on
+the sanctioned variant), so a pass that silently stops matching — or
+starts over-matching — breaks this suite rather than the serving tier.
+The seeded lint fixtures are pinned to exact per-rule counts, and the
+package itself must stay at zero findings.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "lint_fixtures")
+BAD = os.path.join(FIXTURES, "bad_concurrency.py")
+GOOD = os.path.join(FIXTURES, "good_concurrency.py")
+PACKAGE = os.path.join(os.path.dirname(__file__), "..", "megba_tpu")
+
+CONCURRENCY_RULES = ["guarded-by", "lock-order", "blocking-under-lock"]
+
+
+def _lint(*paths, rules=CONCURRENCY_RULES):
+    from megba_tpu.analysis.lint import lint_paths
+
+    return lint_paths(list(paths), rules=list(rules))
+
+
+def _lint_source(tmp_path, source, rules=CONCURRENCY_RULES):
+    """Write an inline fixture module and run the concurrency rules."""
+    mod = tmp_path / "fixture_mod.py"
+    mod.write_text(textwrap.dedent(source))
+    return _lint(str(mod), rules=rules)
+
+
+# ----------------------------------------------------------- guarded-by
+
+
+def test_declared_guard_unlocked_write_and_read(tmp_path):
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # megba: guarded-by(_lock)
+
+            def ok(self):
+                with self._lock:
+                    self.n += 1
+
+            def racy_write(self):
+                self.n += 1
+
+            def racy_read(self):
+                return self.n
+        """)
+    assert len(findings) == 2
+    assert all(f.rule == "guarded-by" for f in findings)
+    kinds = sorted(f.message.split()[0] for f in findings)
+    assert kinds == ["read", "write"]
+    assert all("self._lock" in f.message and "(declared)" in f.message
+               for f in findings)
+
+
+def test_declared_guard_enforced_without_thread_census(tmp_path):
+    """Declarations are a contract: enforced even when the analyzer
+    never sees a `threading.Thread` touch the class."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # megba: guarded-by(_lock)
+
+            def racy(self):
+                self.n += 1
+        """)
+    assert len(findings) == 1 and findings[0].rule == "guarded-by"
+
+
+_INFERENCE_TEMPLATE = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self.total = 0
+            {thread}
+
+        def _work(self):
+            with self._mu:
+{bumps}
+
+        def peek(self):
+            return self.total
+    """
+
+
+def _inference_src(locked_bumps, threaded=True):
+    thread = ("threading.Thread(target=self._work, daemon=True).start()"
+              if threaded else "pass")
+    bumps = "\n".join(" " * 16 + f"self.total += {i}"
+                      for i in range(locked_bumps))
+    return _INFERENCE_TEMPLATE.format(thread=thread, bumps=bumps)
+
+
+def test_inference_fires_at_threshold(tmp_path):
+    """5 locked accesses + 1 unlocked read = 5/6 >= 80% of >= 5: the
+    guard is inferred and `peek` flags."""
+    findings = _lint_source(tmp_path, _inference_src(5))
+    assert len(findings) == 1
+    assert findings[0].rule == "guarded-by"
+    assert "inferred: 5/6" in findings[0].message
+
+
+def test_inference_silent_below_access_floor(tmp_path):
+    """4 locked + 1 unlocked = 5 accesses but 4/5 = 80% of only 4 under
+    the lock... the floor is >= 5 *post-init* accesses under one lock is
+    not required — the ratio drops to 80% exactly; shrink to 3 locked so
+    3/4 < 80% stays silent."""
+    findings = _lint_source(tmp_path, _inference_src(3))
+    assert findings == []
+
+
+def test_inference_silent_without_thread_census(tmp_path):
+    """Same shape as the firing case, but no thread ever reaches the
+    class: single-threaded objects need no guard."""
+    findings = _lint_source(tmp_path, _inference_src(5, threaded=False))
+    assert findings == []
+
+
+def test_init_settled_field_is_silent(tmp_path):
+    """Written only in __init__, read everywhere: safe publication."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.name = "x"
+                threading.Thread(target=self.run, daemon=True).start()
+
+            def run(self):
+                with self._lock:
+                    pass
+
+            def label(self):
+                return self.name
+        """)
+    assert findings == []
+
+
+def test_allow_unguarded_pragma_suppresses(tmp_path):
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # megba: guarded-by(_lock)
+
+            def gauge(self):
+                return self.n  # megba: allow-unguarded
+        """)
+    assert findings == []
+
+
+def test_declared_alias_lock_counts_as_owned(tmp_path):
+    """A guard handed in from outside (not ctor-constructed) still IS
+    the contract: `with self._lock` satisfies it, unlocked access
+    flags."""
+    findings = _lint_source(tmp_path, """\
+        class C:
+            def __init__(self, registry):
+                self._lock = registry.lock
+                self.n = 0  # megba: guarded-by(_lock)
+
+            def ok(self):
+                with self._lock:
+                    self.n += 1
+
+            def racy(self):
+                self.n += 1
+        """)
+    assert len(findings) == 1
+    assert findings[0].rule == "guarded-by"
+    assert findings[0].line == 11
+
+
+def test_entry_held_private_helper(tmp_path):
+    """A private method called only under the lock inherits it at
+    entry — no pragma, no finding."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # megba: guarded-by(_lock)
+                threading.Thread(target=self.run, daemon=True).start()
+
+            def run(self):
+                with self._lock:
+                    self._append_locked(1)
+
+            def _append_locked(self, x):
+                self.items.append(x)
+        """)
+    assert findings == []
+
+
+# ----------------------------------------------------------- lock-order
+
+
+def test_lock_order_cycle_with_witness_path(tmp_path):
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "lock-order"
+    assert "witness path" in f.message
+    # The witness names both locks and cites acquisition sites.
+    assert "D._a" in f.message and "D._b" in f.message
+    assert "acquire" in f.message
+
+
+def test_lock_order_consistent_nesting_is_silent(tmp_path):
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert findings == []
+
+
+def test_lock_order_cycle_through_callgraph(tmp_path):
+    """The inversion spans two methods joined by a self-call: the
+    acquires-while-holding edge must be computed transitively."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def outer(self):
+                with self._a:
+                    self.inner()
+
+            def inner(self):
+                with self._b:
+                    pass
+
+            def inverted(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert any(f.rule == "lock-order" for f in findings)
+
+
+def test_condition_wait_reacquire_edge(tmp_path):
+    """`Condition.wait` re-acquires its condition LAST: holding any
+    other lock across the wait is an ordering edge held-lock -> cond,
+    and here it is the ONLY source of the cycle."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._gate = threading.Lock()
+
+            def step(self):
+                with self._cond:
+                    self._locked_step()
+
+            def _locked_step(self):
+                with self._gate:
+                    self._cond.wait(0.01)
+        """)
+    cycles = [f for f in findings if f.rule == "lock-order"]
+    assert len(cycles) == 1
+    assert "Condition.wait re-acquire" in cycles[0].message
+
+
+# -------------------------------------------------- blocking-under-lock
+
+
+@pytest.mark.parametrize("call,label", [
+    ("self._q.get()", "queue get"),
+    ("worker.join()", "thread/queue join"),
+    ("time.sleep(0.5)", "time.sleep(0.5)"),
+    ("conn.recv(4096)", "conn.recv"),
+    ("fut.result()", "Future.result"),
+])
+def test_blocking_call_under_lock_fires(tmp_path, call, label):
+    findings = _lint_source(tmp_path, f"""\
+        import queue
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def stall(self, worker, conn, fut):
+                with self._lock:
+                    return {call}
+        """)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "blocking-under-lock"
+    assert label in f.message
+    assert "S._lock" in f.message
+
+
+@pytest.mark.parametrize("call", [
+    "self._d.get('k')",        # dict.get(key): an argument means lookup
+    "', '.join(parts)",        # str.join, not thread join
+    "time.sleep(0.01)",        # below the 0.05 s stall threshold
+])
+def test_non_blocking_lookalikes_stay_silent(tmp_path, call):
+    findings = _lint_source(tmp_path, f"""\
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {{}}
+
+            def fine(self, parts):
+                with self._lock:
+                    return {call}
+        """)
+    assert findings == []
+
+
+def test_blocking_outside_lock_is_silent(tmp_path):
+    findings = _lint_source(tmp_path, """\
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fine(self, fut):
+                with self._lock:
+                    pass
+                time.sleep(0.5)
+                return fut.result()
+        """)
+    assert findings == []
+
+
+def test_wait_on_held_condition_is_sanctioned(tmp_path):
+    """Waiting on the condition you hold releases it — the canonical
+    pattern must not flag."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self.ready = False  # megba: guarded-by(_cond)
+
+            def wait_ready(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait(0.1)
+        """)
+    assert findings == []
+
+
+def test_module_lock_blocking_fires(tmp_path):
+    """Module-level locks participate: blocking under one flags too."""
+    findings = _lint_source(tmp_path, """\
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def fetch(fut):
+            with _LOCK:
+                return fut.result()
+        """)
+    assert len(findings) == 1
+    assert findings[0].rule == "blocking-under-lock"
+    assert "_LOCK" in findings[0].message
+
+
+# ------------------------------------------------------ seeded fixtures
+
+
+def test_bad_fixture_pinned_counts():
+    """Pin exact per-rule hit counts in the seeded fixture, so both
+    silent pass decay and over-matching regress loudly."""
+    from collections import Counter
+
+    counts = Counter(f.rule for f in _lint(BAD))
+    assert counts == {
+        "guarded-by": 3,           # racy write, racy read, inferred read
+        "lock-order": 2,           # AB/BA cycle, Condition re-acquire
+        "blocking-under-lock": 6,  # wait stall, Future.result, queue
+                                   # get, thread join, long sleep, recv
+    }
+
+
+def test_bad_fixture_witness_path_details():
+    cycles = [f for f in _lint(BAD, rules=["lock-order"])]
+    assert len(cycles) == 2
+    texts = sorted(f.message for f in cycles)
+    assert "Condition.wait re-acquire" in texts[0]
+    assert "Deadlock._a" in texts[1] and "Deadlock._b" in texts[1]
+
+
+def test_good_fixture_is_silent():
+    findings = _lint(GOOD)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_package_has_zero_findings():
+    """THE acceptance gate: the serving tier itself carries no
+    concurrency-contract violations."""
+    findings = _lint(PACKAGE)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_no_allow_unguarded_pragmas_in_serving():
+    """The escape hatch exists but the serving tier must not use it."""
+    serving = os.path.join(PACKAGE, "serving")
+    hits = []
+    for name in sorted(os.listdir(serving)):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(serving, name)
+        with open(path) as fh:
+            for ln, line in enumerate(fh, 1):
+                if "allow-unguarded" in line:
+                    hits.append(f"{path}:{ln}")
+    assert hits == []
+
+
+# ------------------------------------------------------------------ CLI
+
+
+@pytest.mark.parametrize("rule", CONCURRENCY_RULES)
+def test_cli_exits_nonzero_per_rule(rule, capsys):
+    from megba_tpu.analysis.lint import run_lint
+
+    rc = run_lint(["--rule", rule, BAD])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert rule in out
+
+
+def test_cli_exits_zero_on_good(capsys):
+    from megba_tpu.analysis.lint import run_lint
+
+    rc = run_lint(["--rule", "guarded-by", "--rule", "lock-order",
+                   "--rule", "blocking-under-lock", GOOD])
+    capsys.readouterr()
+    assert rc == 0
