@@ -20,6 +20,11 @@ The model is deliberately simple and conservative:
   a linter: functions passed to `lax.while_loop` / `lax.cond` / `vmap`
   inside a jitted body are traced even though they are never "called"
   by name.
+- Each function additionally carries its raw attribute-read sets
+  (`FunctionInfo.attr_reads`: root name -> full dotted chains read off
+  it) and its simple-alias assignments (`FunctionInfo.assigns`) — the
+  per-function field-read pass the program-identity lane
+  (analysis/identity.py) resolves against named option parameters.
 
 Resolution is lexical: local defs, enclosing defs, module-level defs,
 then imports (`from megba_tpu.algo.lm import lm_solve` and
@@ -65,6 +70,21 @@ class FunctionInfo:
     # resolve to the defining class — the cross-method lock edges the
     # concurrency passes follow.
     classname: Optional[str] = None
+    # Attribute-read sets (raw material for the identity lane, reusable
+    # by any future rule): root Name -> dotted attribute chains read
+    # off it in THIS function's own body (a nested def records its own
+    # reads on its own FunctionInfo, so closure reads resolve through
+    # `parent`).  `option.solver_option.bf16` records
+    # {"solver_option.bf16"} under "option"; only FULL chains are
+    # recorded (never their suffixes), only Load contexts count, and
+    # chains that resolve to indexed functions stay refs, not reads.
+    attr_reads: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    # Simple local aliases: `solver_opt = option.solver_option` records
+    # {"solver_opt": "option.solver_option"} — the single-level
+    # resolution step a consumer needs to root alias reads back at a
+    # named parameter (last assignment wins; only pure Name/Attribute
+    # chain values are recorded).
+    assigns: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -275,6 +295,37 @@ class PackageIndex:
                         if q is not None and q != owner.qualname:
                             owner.refs.add(q)
                             return  # don't double-count the inner Name
+                        # Not a function reference: record the full
+                        # attribute-read chain on its owner — but only
+                        # at the OUTERMOST Attribute of a chain (an
+                        # inner `a.b` of `a.b.c` sees its parent
+                        # Attribute on the stack and is skipped, so
+                        # suffixes are never recorded).
+                        if not (self.stack
+                                and isinstance(self.stack[-1], ast.Attribute)):
+                            dotted = _dotted(node)
+                            if dotted is not None:
+                                root, _, chain = dotted.partition(".")
+                                owner.attr_reads.setdefault(
+                                    root, set()).add(chain)
+                self.generic_visit(node)
+
+            def visit_Assign(self, node):  # noqa: N802
+                owner = owner_of(self.stack)
+                if (owner is not None and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    val = _dotted(node.value)
+                    if val is not None:
+                        owner.assigns[node.targets[0].id] = val
+                self.generic_visit(node)
+
+            def visit_AnnAssign(self, node):  # noqa: N802
+                owner = owner_of(self.stack)
+                if (owner is not None and node.value is not None
+                        and isinstance(node.target, ast.Name)):
+                    val = _dotted(node.value)
+                    if val is not None:
+                        owner.assigns[node.target.id] = val
                 self.generic_visit(node)
 
         Visitor().visit(mod.tree)

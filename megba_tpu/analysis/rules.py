@@ -14,6 +14,9 @@ Rule ids are kebab-case; suppress one finding with an inline
 | raw-clock | no raw `time.time()` / `time.perf_counter()` outside the sanctioned clock homes (`utils/timing.py`, `observability/`) — scattered raw reads fragment the timing story the observability plane narrates (PhaseTimer phases, span timestamps, report `created_unix` all flow from ONE seam); use `utils.timing.monotonic_s()` for durations and `utils.timing.wall_unix()` for epoch stamps.  `time.monotonic()` deadline arithmetic and `time.sleep` are clean — the rule bans the two reads that LOOK interchangeable but are not |
 | guarded-by | shared mutable attributes of lock-owning classes, declared with `# megba: guarded-by(<lockattr>)` on the assignment (or inferred at >= 80% locked accesses in thread-reachable classes), must not be read/written outside a `with <lock>` block — the host serving tier's race detector (analysis/concurrency.py); `# megba: allow-unguarded` is the per-line escape hatch |
 | lock-order | the package-wide acquires-while-holding digraph (nested `with` blocks, cross-method/cross-class edges through the callgraph, `Condition.wait` re-acquires) must be acyclic — a cycle is a deadlock waiting for the right interleaving; the finding prints the witness path |
+| stale-program | every option field READ on the lowering closure (flat_solve / distributed_lm_solve / batched_solve_program / lower_bucket / solve_pgo and everything they reach) must be visible to the program's static key — a strip-listed or key-exempt-declared field read under tracing is a wrong-program hazard, and a builder whose `static_key(...)` omits its option parameter hides every field (analysis/identity.py); consume-and-strip in the same function is the sanctioned shape |
+| cache-split | an option field that reaches the key surfaces (static_key reprs the whole frozen option; artifact fingerprints, warm manifests and bucket keys follow) but is never lowering-read and is not on the observability strip-list silently fragments every cache — declare intent with a field-scoped lowering-relevant pragma (program-family selectors) or key-exempt pragma (true host-only knobs) on the declaration line |
+| key-surface-drift | the strip-list is ONE registry (common.OBSERVABILITY_FIELDS): partial strips, non-conforming strip helpers, hardcoded membership tuples that disagree with it, un-stripped memoised-cache fronts, contradictory/unknown-field pragmas, and operand-declared values branched on in Python inside traced code (operand-as-static; `is None` presence checks sanctioned) all drift a key surface away from the contract |
 | blocking-under-lock | no call from the curated blocking set (`Future.result`, `queue.get`/`join`, socket/pipe `recv*`, `subprocess`-style `.wait`, `time.sleep` above 0.05 s, the RPC `_recv_frame`) while any lock is held — the classic serve-loop stall shape; waiting on a HELD Condition is the sanctioned exception (it releases the lock) |
 """
 
@@ -69,6 +72,9 @@ ALL_RULES = (
     "guarded-by",
     "lock-order",
     "blocking-under-lock",
+    "stale-program",
+    "cache-split",
+    "key-surface-drift",
 )
 
 # Fully-resolved call targets the raw-clock rule bans (time.monotonic,
@@ -417,6 +423,33 @@ def rule_blocking_under_lock(index: PackageIndex) -> Iterator[Finding]:
         yield Finding(path, line, col, "blocking-under-lock", msg)
 
 
+# -------------------------------------------- program-identity rules
+# The analysis lives in analysis/identity.py (same contract as the
+# concurrency lane: plain (path, line, col, message) tuples, memoised
+# on the index); these wrappers stamp the rule ids.
+
+
+def rule_stale_program(index: PackageIndex) -> Iterator[Finding]:
+    from megba_tpu.analysis import identity
+
+    for path, line, col, msg in identity.find_stale_program(index):
+        yield Finding(path, line, col, "stale-program", msg)
+
+
+def rule_cache_split(index: PackageIndex) -> Iterator[Finding]:
+    from megba_tpu.analysis import identity
+
+    for path, line, col, msg in identity.find_cache_split(index):
+        yield Finding(path, line, col, "cache-split", msg)
+
+
+def rule_key_surface_drift(index: PackageIndex) -> Iterator[Finding]:
+    from megba_tpu.analysis import identity
+
+    for path, line, col, msg in identity.find_key_surface_drift(index):
+        yield Finding(path, line, col, "key-surface-drift", msg)
+
+
 RULES = {
     "host-callback": rule_host_callback,
     "np-in-jit": rule_np_in_jit,
@@ -428,4 +461,7 @@ RULES = {
     "guarded-by": rule_guarded_by,
     "lock-order": rule_lock_order,
     "blocking-under-lock": rule_blocking_under_lock,
+    "stale-program": rule_stale_program,
+    "cache-split": rule_cache_split,
+    "key-surface-drift": rule_key_surface_drift,
 }

@@ -294,7 +294,12 @@ class SolverOption:
     (2 all-reduces per S·p) is unchanged.
     """
 
-    solver_kind: SolverKind = SolverKind.PCG
+    # Program-family selector: validate_options pins it to its single
+    # implemented value (PCG), so no lowering code branches on it today;
+    # lowering-relevant BY DECLARATION — a second solver family must key
+    # the program surface, and the pragma documents that intent for the
+    # identity lane (analysis/identity.py cache-split).
+    solver_kind: SolverKind = SolverKind.PCG  # megba: lowering-relevant(solver_option.solver_kind)
     max_iter: int = 100
     tol: float = 1e-1
     refuse_ratio: float = 1.0
@@ -380,7 +385,8 @@ class SolverOption:
 class AlgoOption:
     """Outer (LM) loop options — reference common.h:35-42 defaults."""
 
-    algo_kind: AlgoKind = AlgoKind.LM
+    # Program-family selector (validated to LM; see solver_kind note).
+    algo_kind: AlgoKind = AlgoKind.LM  # megba: lowering-relevant(algo_option.algo_kind)
     max_iter: int = 20
     initial_region: float = 1e3  # "tau"; trust region radius
     epsilon1: float = 1.0
@@ -402,13 +408,21 @@ class ProblemOption:
     """
 
     use_schur: bool = True
-    device: Device = Device.TPU
+    # Backend selector: platform choice IS a different compiled program
+    # by definition, even though no Python lowering code reads it —
+    # lowering-relevant by declaration (analysis/identity.py).
+    device: Device = Device.TPU  # megba: lowering-relevant(device)
     world_size: int = 1
-    N: int = -1  # grad width (cameraDim + pointDim); derived if -1
-    n_item: int = -1  # number of edges/observations; derived if -1
+    # Derived host-side shape hints, never read on any path: operand
+    # SHAPES key every jit cache independently, so keying these would
+    # only fragment the caches — key-exempt (analysis/identity.py).
+    N: int = -1  # grad width (cameraDim + pointDim); derived if -1  # megba: key-exempt(N)
+    n_item: int = -1  # number of edges/observations; derived if -1  # megba: key-exempt(n_item)
     dtype: np.dtype = np.float64
-    algo_kind: AlgoKind = AlgoKind.LM
-    linear_system_kind: LinearSystemKind = LinearSystemKind.SCHUR
+    # Program-family selectors (validated to their single implemented
+    # value — see SolverOption.solver_kind note).
+    algo_kind: AlgoKind = AlgoKind.LM  # megba: lowering-relevant(algo_kind)
+    linear_system_kind: LinearSystemKind = LinearSystemKind.SCHUR  # megba: lowering-relevant(linear_system_kind)
     compute_kind: ComputeKind = ComputeKind.IMPLICIT
     jacobian_mode: JacobianMode = JacobianMode.AUTODIFF
     solver_option: SolverOption = dataclasses.field(default_factory=SolverOption)
@@ -462,6 +476,35 @@ DTYPE_TO_JAX = {
     np.dtype(np.float32): "float32",
     np.dtype(np.float64): "float64",
 }
+
+
+# The observability strip-list — THE single registry of ProblemOption
+# fields that are host-side sinks and must be cleared off an option
+# before it reaches any program-identity surface: the jit/program
+# caches, retrace static keys, artifact fingerprints, warm-manifest
+# option configs, and bucket keys.  Every strip site in the package
+# (solve.flat_solve, serving.batcher._strip_telemetry,
+# serving.compile_pool._sans_telemetry, models.pgo.solve_pgo,
+# serving.federation worker setup) routes through strip_observability
+# below, and compile_pool._config_mismatches derives its comparison
+# exclusions from this tuple — so the strip set cannot drift per
+# surface (analysis/identity.py `key-surface-drift` enforces the
+# agreement statically).
+OBSERVABILITY_FIELDS = ("telemetry", "metrics")
+
+
+def strip_observability(option: ProblemOption) -> ProblemOption:
+    """Clear the observability sinks (`OBSERVABILITY_FIELDS`) off an
+    option before it reaches a program-identity surface.
+
+    Returns `option` ITSELF when nothing is armed: cache fronts call
+    this unconditionally, and the identity pass-through keeps
+    already-clean options hitting the exact same lru/dict cache slots
+    they always did (no key churn for the common case).
+    """
+    if option.telemetry is not None or option.metrics:
+        return dataclasses.replace(option, telemetry=None, metrics=False)
+    return option
 
 
 def validate_options(option: ProblemOption) -> None:

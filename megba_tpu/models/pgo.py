@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from megba_tpu.common import ProblemOption
+from megba_tpu.common import ProblemOption, strip_observability
 from megba_tpu.core.fm import segsum_fm
 from megba_tpu.core.host_se3 import compose, relative
 from megba_tpu.core.types import pad_edges
@@ -229,11 +229,14 @@ def solve_pgo(
     analysis/program_audit.py; single-process only).
     """
     option = option or ProblemOption()
-    if option.telemetry is not None:
-        # The PGO family records no SolveReport yet (README "Telemetry &
-        # profiling" scopes the sink to the BA pipeline); strip the
-        # host-only knob so it cannot fragment _pgo_program's lru cache.
-        option = dataclasses.replace(option, telemetry=None)
+    # The PGO family records no SolveReport yet (README "Telemetry &
+    # profiling" scopes the sink to the BA pipeline); strip BOTH
+    # observability knobs (common.OBSERVABILITY_FIELDS) so neither can
+    # fragment _pgo_program's lru cache or its static key.  (This
+    # previously cleared only `telemetry`, so `metrics=True` silently
+    # split the PGO program cache — the identity lane's cache-split /
+    # key-surface-drift finding, fixed at the source.)
+    option = strip_observability(option)
     # Registry dispatch (lazy import: factors/pose_graph.py imports
     # THIS module at registration time).
     from megba_tpu.factors import get_factor

@@ -32,7 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from megba_tpu.algo.lm import LMResult, lm_solve
 from megba_tpu.analysis.retrace import static_key, traced
-from megba_tpu.common import ProblemOption
+from megba_tpu.common import ProblemOption, strip_observability
 from megba_tpu.core.types import pad_edges
 
 # jax.shard_map graduated from jax.experimental between jax releases;
@@ -348,6 +348,16 @@ def distributed_lm_solve(
             f"edge count {n_edge} not divisible by mesh size "
             f"{mesh.devices.size}; pad with shard_edge_arrays first"
         )
+
+    # Program-identity surface: _cached_sharded_solve and the
+    # caller-owned jit_cache both key on `option`, so strip the
+    # observability sinks (common.OBSERVABILITY_FIELDS) on this PUBLIC
+    # entry — the internal flat_solve path arrives pre-stripped
+    # (identity pass-through, same cache slots), but a direct caller
+    # with a telemetry/metrics-armed option previously split the
+    # program cache (the identity lane's key-surface-drift finding,
+    # fixed at the source).
+    option = strip_observability(option)
 
     # Feature-major edge arrays [F, nE] split on the MINOR axis; 1-D
     # index/mask arrays on their only axis; parameters replicated.

@@ -28,7 +28,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from megba_tpu import observability as _obs
-from megba_tpu.common import ProblemOption, status_name, validate_options
+from megba_tpu.common import (
+    ProblemOption,
+    status_name,
+    strip_observability,
+    validate_options,
+)
 from megba_tpu.observability.trace import SolveTrace
 from megba_tpu.serving.compile_pool import CompilePool
 from megba_tpu.serving.shape_class import (
@@ -146,8 +151,7 @@ def _strip_telemetry(option: ProblemOption) -> Tuple[ProblemOption, Optional[str
     what instrumentation sites gate on."""
     telemetry = option.telemetry or os.environ.get("MEGBA_TELEMETRY") or None
     report_option = option
-    if option.telemetry is not None or option.metrics:
-        option = dataclasses.replace(option, telemetry=None, metrics=False)
+    option = strip_observability(option)
     return option, telemetry, report_option
 
 

@@ -89,18 +89,21 @@ def _flatten_config(d: Any, prefix: str = "") -> Dict[str, Any]:
 
 
 def _sans_telemetry(option):
-    """Strip the observability knobs (telemetry sink AND the metrics
-    flag): programs (and therefore pool keys, artifact fingerprints and
-    manifests) are observability-agnostic by the serving layer's
-    contract — the dispatch path strips them before every cache
+    """Strip the observability knobs (common.OBSERVABILITY_FIELDS:
+    telemetry sink AND the metrics flag): programs (and therefore pool
+    keys, artifact fingerprints and manifests) are
+    observability-agnostic by the serving layer's contract — the
+    dispatch path strips them before every cache
     (batcher._strip_telemetry), so the warm/export paths must key the
     same way or a sink-carrying option would warm programs dispatch can
-    never hit."""
+    never hit.  The getattr guard keeps this total over the duck-typed
+    option stand-ins some pool tests pass; real options delegate to the
+    canonical common.strip_observability."""
     if (getattr(option, "telemetry", None) is not None
             or getattr(option, "metrics", False)):
-        import dataclasses as _dc
+        from megba_tpu.common import strip_observability
 
-        return _dc.replace(option, telemetry=None, metrics=False)
+        return strip_observability(option)
     return option
 
 
@@ -108,13 +111,18 @@ def _config_mismatches(recorded: Dict[str, Any],
                        current: Dict[str, Any]) -> List[str]:
     a, b = _flatten_config(recorded), _flatten_config(current)
     # The observability knobs never reach a program (the serving layer
-    # strips telemetry AND metrics before every cache/build —
-    # batcher._strip_telemetry), so two services differing only in
-    # where they log / whether they count warmed the SAME programs:
-    # not a mismatch.  "metrics" also covers manifests recorded before
-    # the knob existed (absent vs default-False is not drift).
+    # strips them before every cache/build — batcher._strip_telemetry),
+    # so two services differing only in where they log / whether they
+    # count warmed the SAME programs: not a mismatch.  The exclusion
+    # set is DERIVED from the one strip registry
+    # (common.OBSERVABILITY_FIELDS) rather than spelled here, so this
+    # comparison surface cannot drift from what the strip sites clear
+    # ("metrics" in the registry also covers manifests recorded before
+    # the knob existed — absent vs default-False is not drift).
+    from megba_tpu.common import OBSERVABILITY_FIELDS
+
     return sorted(k for k in set(a) | set(b)
-                  if k not in ("telemetry", "metrics")
+                  if k not in OBSERVABILITY_FIELDS
                   and a.get(k) != b.get(k))
 
 # (engine, option, shape, lanes, cd, pd, od) -> jax.stages.Compiled
@@ -239,8 +247,16 @@ def batched_solve_program(residual_jac_fn, option, faulted=False):
     same double-cache footgun make_residual_jacobian_fn fixed in PR 6,
     now the shared utils/memo.normalized_lru_cache — two entries would
     mean two jit wrappers and a duplicate trace).  `faulted` is coerced
-    to bool here so truthy ints cannot split the key either."""
-    return _cached_batched_solve(residual_jac_fn, option, bool(faulted))
+    to bool here so truthy ints cannot split the key either.
+
+    This PUBLIC cache front also strips the observability knobs
+    (common.OBSERVABILITY_FIELDS, via _sans_telemetry): the pool/batcher
+    paths arrive pre-stripped (identity pass-through, same lru slots),
+    but a DIRECT caller with a sink-carrying option must hit the same
+    compiled program — previously it silently split the cache (the
+    identity lane's key-surface-drift finding, fixed at the source)."""
+    return _cached_batched_solve(residual_jac_fn, _sans_telemetry(option),
+                                 bool(faulted))
 
 
 def _abstract_args(shape: ShapeClass, lanes: int, cd: int, pd: int,

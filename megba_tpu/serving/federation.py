@@ -389,11 +389,19 @@ def _worker_main() -> int:
     from megba_tpu.serving.stats import FleetStats
     from megba_tpu.utils.timing import PhaseTimer
 
-    # `option` (telemetry-STRIPPED) feeds warmup and fingerprints — the
-    # program caches are telemetry-agnostic by contract; `solve_option`
-    # carries this worker's sink into solve_many, which strips it again
-    # before touching any cache, so warm and dispatch agree on keys.
-    option = dataclasses.replace(cfg["option"], telemetry=None)
+    # `option` (observability-STRIPPED: telemetry AND metrics,
+    # common.OBSERVABILITY_FIELDS) feeds warmup and fingerprints — the
+    # program caches are observability-agnostic by contract; previously
+    # only `telemetry` was cleared here, so a metrics-armed fleet config
+    # warmed programs dispatch could never hit (the identity lane's
+    # key-surface-drift finding, fixed at the source).  `solve_option`
+    # carries this worker's sink AND the config's metrics flag into
+    # solve_many, which strips both again before touching any cache, so
+    # warm and dispatch agree on keys.
+    from megba_tpu.common import strip_observability
+
+    base_option = cfg["option"]
+    option = strip_observability(base_option)
     ladder = cfg.get("ladder")
     stats = FleetStats()
     timer = PhaseTimer()
@@ -401,8 +409,8 @@ def _worker_main() -> int:
                        timer=timer)
     engine = make_residual_jacobian_fn(mode=option.jacobian_mode)
     telemetry = cfg.get("telemetry")
-    solve_option = (dataclasses.replace(option, telemetry=telemetry)
-                    if telemetry else option)
+    solve_option = dataclasses.replace(base_option,
+                                       telemetry=telemetry or None)
 
     # Heartbeat: PR 9's liveness board, beaten from a daemon thread.
     hb = cfg.get("heartbeat")

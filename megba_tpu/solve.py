@@ -22,7 +22,12 @@ import numpy as np
 
 from megba_tpu.algo.lm import LMResult, lm_solve
 from megba_tpu.analysis.retrace import static_key, traced
-from megba_tpu.common import PrecondKind, ProblemOption, validate_options
+from megba_tpu.common import (
+    PrecondKind,
+    ProblemOption,
+    strip_observability,
+    validate_options,
+)
 from megba_tpu.core.fm import EDGE_QUANTUM
 from megba_tpu.core.types import is_cam_sorted, pad_edges
 from megba_tpu.io.bal import BALFile, load_bal
@@ -245,13 +250,12 @@ def flat_solve(
             "flat_solve needs residual_jac_fn or a registered factor= "
             "to resolve one from")
     # Resolve the telemetry target here (knob wins over env), then strip
-    # the observability knobs (`telemetry` AND `metrics`): program
+    # the observability knobs (common.OBSERVABILITY_FIELDS): program
     # caches are keyed on `option` and must stay observability-agnostic
     # — turning telemetry or metrics on can never recompile.
     telemetry = option.telemetry or os.environ.get("MEGBA_TELEMETRY") or None
     report_option = option
-    if option.telemetry is not None or option.metrics:
-        option = dataclasses.replace(option, telemetry=None, metrics=False)
+    option = strip_observability(option)
     timer = PhaseTimer() if timer is None else timer
     # Touch the span recorder up front when MEGBA_TRACE is armed: its
     # first creation installs the PhaseTimer hook, so even a bare

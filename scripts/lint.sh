@@ -1,7 +1,7 @@
 #!/bin/bash
 # Static-analysis + sanitizer lane (megba_tpu/analysis/).
 #
-# Six gates, all required (scripts/run_tests.sh invokes this, so
+# Seven gates, all required (scripts/run_tests.sh invokes this, so
 # tier-1 cannot pass with a violation in any of them):
 #
 #   1. the JAX-contract linter runs CLEAN on the package;
@@ -25,7 +25,14 @@
 #      lock-order deadlock analysis, and blocking-under-lock checks
 #      over the host serving tier, plus must-fire / must-stay-silent
 #      checks on the seeded concurrency fixtures (each of the three
-#      rule ids must appear in the bad fixture's findings).
+#      rule ids must appear in the bad fixture's findings);
+#   7. the program-identity contract lane: stale-program fingerprint
+#      coverage (every lowering-read option field reaches the static
+#      key), cache-split detection (keyed-but-never-lowering-read
+#      fields), and key-surface drift analysis (strip helpers,
+#      hardcoded exclusion tuples, un-stripped cache fronts,
+#      operand-as-static branches) over the whole package, with the
+#      same must-fire / must-stay-silent fixture gates as lane 6.
 set -e -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -73,5 +80,37 @@ done
 echo "[lint] concurrency rules must stay silent on the good fixture"
 python -m megba_tpu.analysis.lint --rule guarded-by --rule lock-order \
     --rule blocking-under-lock tests/data/lint_fixtures/good_concurrency.py
+
+echo "[lint] program-identity contract lane (lane 7)"
+python -m megba_tpu.analysis.lint --rule stale-program --rule cache-split \
+    --rule key-surface-drift megba_tpu/
+
+echo "[lint] identity rules must fire on the seeded bad fixture"
+IDENT_BAD=tests/data/lint_fixtures/bad_identity.py
+if ident_out=$(python -m megba_tpu.analysis.lint --rule stale-program \
+    --rule cache-split --rule key-surface-drift "$IDENT_BAD" 2>&1); then
+    echo "ERROR: identity linter exited 0 on $IDENT_BAD" >&2
+    exit 1
+fi
+for rule in stale-program cache-split key-surface-drift; do
+    if ! grep -q " $rule " <<< "$ident_out"; then
+        echo "ERROR: rule $rule produced no finding on $IDENT_BAD" >&2
+        echo "$ident_out" >&2
+        exit 1
+    fi
+done
+
+echo "[lint] each identity rule must fire standalone (per-rule exit codes)"
+for rule in stale-program cache-split key-surface-drift; do
+    if python -m megba_tpu.analysis.lint --rule "$rule" "$IDENT_BAD" \
+        > /dev/null 2>&1; then
+        echo "ERROR: rule $rule alone exited 0 on $IDENT_BAD" >&2
+        exit 1
+    fi
+done
+
+echo "[lint] identity rules must stay silent on the good fixture"
+python -m megba_tpu.analysis.lint --rule stale-program --rule cache-split \
+    --rule key-surface-drift tests/data/lint_fixtures/good_identity.py
 
 echo "lint lane OK"

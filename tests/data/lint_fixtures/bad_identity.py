@@ -1,0 +1,97 @@
+"""Seeded BAD program-identity patterns for the lint lane's must-fire
+gate (scripts/lint.sh lane 7).
+
+NOT executed anywhere: this module exists purely as linter input for
+analysis/identity.py — each block below is a deliberately broken
+miniature of the repo's option/key machinery, and every class of
+finding the three identity rules detect appears at least once:
+
+- stale-program: a lowering-path read of a strip-listed field with no
+  strip in the same function (flat_solve), and a builder whose static
+  key omits its option (_build_single_solve);
+- cache-split: declared option fields no lowering code ever reads and
+  no pragma declares (debug_port, scratch_limit_mb);
+- key-surface-drift: a partial strip + non-conforming helper
+  (_sans_telemetry), a hardcoded exclusion tuple disagreeing with the
+  registry (_config_mismatches), an un-stripped memoised cache front
+  (flat_solve), and an operand branched on inside traced code (fn).
+
+tests/test_identity.py pins the exact finding counts per rule, so a
+rule that silently stops matching is itself a regression.
+"""
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+
+OBSERVABILITY_FIELDS = ("telemetry", "metrics")
+
+
+def static_key(*parts):
+    return "|".join(repr(p) for p in parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverOption:
+    max_iter: int = 100
+    bf16: bool = False
+    # cache-split: never lowering-read, not stripped, no declared
+    # intent — fragments every key surface for nothing.
+    scratch_limit_mb: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ProblemOption:
+    dtype: str = "float32"
+    # cache-split: host-only debug knob nobody reads and nobody
+    # declared.
+    debug_port: int = 0
+    solver_option: SolverOption = dataclasses.field(
+        default_factory=SolverOption)
+    telemetry: Optional[str] = None
+    metrics: bool = False
+
+
+def _sans_telemetry(option):
+    # key-surface-drift: partial strip (clears telemetry, leaves
+    # metrics) — and as a declared strip helper it conforms to nothing.
+    return dataclasses.replace(option, telemetry=None)
+
+
+def _config_mismatches(recorded, current):
+    # key-surface-drift: hardcoded exclusion tuple disagreeing with
+    # OBSERVABILITY_FIELDS.
+    return sorted(k for k in set(recorded) | set(current)
+                  if k not in ("telemetry",)
+                  and recorded.get(k) != current.get(k))
+
+
+def _build_single_solve(residual_jac_fn, option):
+    # stale-program: the static key omits `option`, hiding every field
+    # the traced body reads from the program's identity.
+    key = static_key(residual_jac_fn, "solve.single")
+
+    def fn(x, mask):
+        scale = 2.0 if option.solver_option.bf16 else 1.0
+        steps = option.solver_option.max_iter
+        if option.dtype == "float32":  # static branch: legal
+            scale = scale + steps
+        if mask:  # key-surface-drift: operand-as-static branch
+            return x * scale
+        return x
+
+    return jax.jit(fn), key
+
+
+_cached_single_solve = functools.lru_cache(maxsize=8)(_build_single_solve)
+
+
+def flat_solve(residual_jac_fn, x, option: ProblemOption):
+    # stale-program: reads the strip-listed sink on the lowering path
+    # and never strips it; key-surface-drift: fronts the memoised
+    # program cache with the un-stripped option.
+    sink = option.telemetry
+    prog, key = _cached_single_solve(residual_jac_fn, option)
+    return prog(x, None), key, sink

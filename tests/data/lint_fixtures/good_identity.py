@@ -1,0 +1,105 @@
+"""Seeded GOOD program-identity patterns: the must-stay-silent half of
+lint lane 7 (scripts/lint.sh).
+
+NOT executed anywhere: linter input only.  This module mirrors
+bad_identity.py with every contract honoured, and deliberately
+exercises the sanctioned shapes and declared-intent hatches so a rule
+that over-matches fails the gate:
+
+- the consume-and-strip shape (flat_solve resolves the sink, then
+  routes through the canonical strip helper before the cache front);
+- a conforming strip-helper delegation chain (_sans_telemetry ->
+  strip_observability);
+- an exclusion test derived from the registry (_config_mismatches)
+  AND a hardcoded tuple that exactly EQUALS it (_legacy_mismatches —
+  agreement is not drift);
+- both field-scoped pragmas (a lowering-relevant program-family
+  selector, a key-exempt host-only knob);
+- a static key that includes the option, and an operand used only
+  through the sanctioned `is None` presence check.
+"""
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+
+OBSERVABILITY_FIELDS = ("telemetry", "metrics")
+
+
+def static_key(*parts):
+    return "|".join(repr(p) for p in parts)
+
+
+def strip_observability(option):
+    if option.telemetry is not None or option.metrics:
+        return dataclasses.replace(option, telemetry=None, metrics=False)
+    return option
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverOption:
+    # Program-family selector no lowering code branches on yet:
+    # declared lowering-relevant, so cache-split stays quiet.
+    solver_kind: int = 0  # megba: lowering-relevant(solver_option.solver_kind)
+    max_iter: int = 100
+    bf16: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ProblemOption:
+    dtype: str = "float32"
+    # True host-only knob: declared key-exempt.
+    trace_dir: Optional[str] = None  # megba: key-exempt(trace_dir)
+    solver_option: SolverOption = dataclasses.field(
+        default_factory=SolverOption)
+    telemetry: Optional[str] = None
+    metrics: bool = False
+
+
+def _sans_telemetry(option):
+    # Conforming helper: routes through the canonical strip helper.
+    return strip_observability(option)
+
+
+def _config_mismatches(recorded, current):
+    # The exclusion test derives from the one registry: cannot drift.
+    return sorted(k for k in set(recorded) | set(current)
+                  if k not in OBSERVABILITY_FIELDS
+                  and recorded.get(k) != current.get(k))
+
+
+def _legacy_mismatches(recorded):
+    # Hardcoded tuple that EQUALS the registry: agreement, not drift.
+    return sorted(k for k in recorded
+                  if k not in ("telemetry", "metrics"))
+
+
+def _build_single_solve(residual_jac_fn, option):
+    # The static key carries the (stripped) option: every field the
+    # traced body reads is part of the program's identity.
+    key = static_key(residual_jac_fn, option, "solve.single")
+
+    def fn(x, mask):
+        scale = 2.0 if option.solver_option.bf16 else 1.0
+        steps = option.solver_option.max_iter
+        if mask is not None:  # sanctioned presence check
+            x = x * scale
+        return x + 0.0 * steps
+
+    return jax.jit(fn), key
+
+
+_cached_single_solve = functools.lru_cache(maxsize=8)(_build_single_solve)
+
+
+def flat_solve(residual_jac_fn, x, option: ProblemOption):
+    # Consume-and-strip: resolve the sink, clear the observability
+    # fields in this same function, THEN hit the memoised cache front.
+    sink = option.telemetry
+    option = strip_observability(option)
+    if option.dtype == "float32":
+        x = x
+    prog, key = _cached_single_solve(residual_jac_fn, option)
+    return prog(x, None), key, sink

@@ -1,0 +1,474 @@
+"""Program-identity contract lane: stale-program / cache-split /
+key-surface-drift.
+
+Compile-free tier-1 units — every finding class the identity analyzer
+knows gets a positive (fires on a handwritten fixture) AND a negative
+(silent on the sanctioned variant), so a pass that silently stops
+matching — or starts over-matching — breaks this suite rather than the
+compile/artifact/bucket caches.  The seeded lint fixtures are pinned to
+exact per-rule counts, and the package itself must stay at zero
+findings.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "lint_fixtures")
+BAD = os.path.join(FIXTURES, "bad_identity.py")
+GOOD = os.path.join(FIXTURES, "good_identity.py")
+PACKAGE = os.path.join(os.path.dirname(__file__), "..", "megba_tpu")
+
+IDENTITY_RULES = ["stale-program", "cache-split", "key-surface-drift"]
+
+# Shared miniature of the repo's option/key machinery for inline
+# fixtures.  Each test appends only the shape under scrutiny.
+PRELUDE = """\
+    import dataclasses
+    import functools
+    from typing import Optional
+
+    import jax
+
+    OBSERVABILITY_FIELDS = ("telemetry", "metrics")
+
+    def static_key(*parts):
+        return "|".join(repr(p) for p in parts)
+
+    def strip_observability(option):
+        if option.telemetry is not None or option.metrics:
+            return dataclasses.replace(
+                option, telemetry=None, metrics=False)
+        return option
+
+    @dataclasses.dataclass(frozen=True)
+    class SolverOption:
+        max_iter: int = 100
+        bf16: bool = False
+
+    @dataclasses.dataclass(frozen=True)
+    class ProblemOption:
+        dtype: str = "float32"
+        solver_option: SolverOption = dataclasses.field(
+            default_factory=SolverOption)
+        telemetry: Optional[str] = None
+        metrics: bool = False
+    """
+
+
+def _lint(*paths, rules=IDENTITY_RULES):
+    from megba_tpu.analysis.lint import lint_paths
+
+    return lint_paths(list(paths), rules=list(rules))
+
+
+def _index(*paths):
+    from megba_tpu.analysis.callgraph import PackageIndex
+
+    return PackageIndex.build(list(paths))
+
+
+def _src_index(tmp_path, source):
+    """Write an inline fixture module (PRELUDE + `source`) and index it."""
+    mod = tmp_path / "fixture_mod.py"
+    mod.write_text(textwrap.dedent(PRELUDE) + textwrap.dedent(source))
+    return _index(str(mod))
+
+
+def _src_lint(tmp_path, source, rules=IDENTITY_RULES):
+    mod = tmp_path / "fixture_mod.py"
+    mod.write_text(textwrap.dedent(PRELUDE) + textwrap.dedent(source))
+    return _lint(str(mod), rules=rules)
+
+
+@pytest.fixture(scope="module")
+def pkg_summary():
+    from megba_tpu.analysis.identity import identity_summary
+
+    return identity_summary(_index(PACKAGE))
+
+
+@pytest.fixture(scope="module")
+def bad_findings():
+    return _lint(BAD)
+
+
+# -------------------------------------------------- callgraph read sets
+
+
+def test_attr_reads_records_full_dotted_chains(tmp_path):
+    idx = _src_index(tmp_path, """\
+
+        def reader(option):
+            a = option.solver_option.bf16
+            b = option.dtype
+            return a, b
+        """)
+    info = idx.functions["fixture_mod.reader"]
+    # chains are keyed by root name, stored relative to it
+    assert "solver_option.bf16" in info.attr_reads["option"]
+    assert "dtype" in info.attr_reads["option"]
+    # outermost chain only — no suffix entries for the inner Attribute
+    assert "solver_option" not in info.attr_reads["option"]
+
+
+def test_assigns_records_dotted_aliases(tmp_path):
+    idx = _src_index(tmp_path, """\
+
+        def alias(option):
+            solver_opt = option.solver_option
+            return solver_opt.max_iter
+        """)
+    info = idx.functions["fixture_mod.alias"]
+    assert info.assigns["solver_opt"] == "option.solver_option"
+    assert "max_iter" in info.attr_reads["solver_opt"]
+
+
+def test_read_resolution_through_alias_closure_and_cache(tmp_path):
+    """flat_solve -> lru_cache alias -> builder -> nested closure: the
+    closure's aliased sub-option read resolves to a dotted leaf path."""
+    from megba_tpu.analysis.identity import identity_summary
+
+    idx = _src_index(tmp_path, """\
+
+        def _build(residual_jac_fn, option):
+            solver_opt = option.solver_option
+
+            def step(x):
+                return x if solver_opt.bf16 else x * 2.0
+
+            return jax.jit(step), static_key(residual_jac_fn, option)
+
+        _cached_build = functools.lru_cache(8)(_build)
+
+        def flat_solve(residual_jac_fn, x, option: ProblemOption):
+            option = strip_observability(option)
+            prog, key = _cached_build(residual_jac_fn, option)
+            return prog(x), key
+        """)
+    s = identity_summary(idx)
+    assert "fixture_mod.flat_solve" in s["entries"]
+    assert s["cache_aliases"] == {
+        "fixture_mod._cached_build": "fixture_mod._build"}
+    assert "fixture_mod._build.step" in s["closure"]
+    assert "fixture_mod._build.step" in s["reads"]["solver_option.bf16"]
+
+
+# --------------------------------------------------- registry extraction
+
+
+def test_registry_from_good_fixture():
+    from megba_tpu.analysis.identity import identity_summary
+
+    s = identity_summary(_index(GOOD))
+    assert s["strip_fields"] == ("telemetry", "metrics")
+    for leaf in ("dtype", "trace_dir", "telemetry", "metrics",
+                 "solver_option.solver_kind", "solver_option.bf16"):
+        assert leaf in s["leaf_paths"], leaf
+    assert "solver_option" not in s["leaf_paths"]  # container, not leaf
+    assert s["pragmas"]["lowering-relevant"] == [
+        "solver_option.solver_kind"]
+    assert s["pragmas"]["key-exempt"] == ["trace_dir"]
+
+
+def test_strip_list_falls_back_to_helper_cleared_kwargs(tmp_path):
+    """No OBSERVABILITY_FIELDS tuple: the strip-list is recovered from
+    the declared strip helper's cleared replace kwargs."""
+    from megba_tpu.analysis.identity import identity_summary
+
+    mod = tmp_path / "fixture_mod.py"
+    mod.write_text(textwrap.dedent("""\
+        import dataclasses
+
+        def _strip_telemetry(option):
+            return dataclasses.replace(
+                option, telemetry=None, metrics=False)
+        """))
+    s = identity_summary(_index(str(mod)))
+    assert s["strip_fields"] == ("metrics", "telemetry")
+
+
+# -------------------------------------------------------- stale-program
+
+
+def test_stale_program_fires_on_bad_fixture(bad_findings):
+    stale = [f for f in bad_findings if f.rule == "stale-program"]
+    assert len(stale) == 2
+    msgs = " | ".join(f.message for f in stale)
+    assert "`telemetry` is read on the lowering path" in msgs
+    assert "omits its option parameter `option`" in msgs
+
+
+def test_stale_read_positive_and_consume_and_strip_negative(tmp_path):
+    """Two lowering-path readers of the sink: the one that strips in
+    the same function is exempt, the other flags at the read site."""
+    findings = _src_lint(tmp_path, """\
+
+        def flat_solve(residual_jac_fn, x, option: ProblemOption):
+            sink = option.telemetry
+            return x, sink
+
+        def lower_bucket(residual_jac_fn, x, option: ProblemOption):
+            sink = option.telemetry
+            option = strip_observability(option)
+            return x, sink
+        """, rules=["stale-program"])
+    assert len(findings) == 1
+    assert "flat_solve" in findings[0].message
+    assert "`telemetry`" in findings[0].message
+
+
+def test_stale_key_omission_and_taint_fixpoint(tmp_path):
+    """A static key fed only a derived local still counts as carrying
+    the option (taint through `compare = strip_observability(option)`);
+    a key omitting the option entirely flags."""
+    findings = _src_lint(tmp_path, """\
+
+        def good_key(residual_jac_fn, option):
+            compare = strip_observability(option)
+            return static_key(residual_jac_fn, compare)
+
+        def bad_key(residual_jac_fn, option):
+            return static_key(residual_jac_fn, "site")
+        """, rules=["stale-program"])
+    assert len(findings) == 1
+    assert "bad_key" in findings[0].message
+
+
+# ---------------------------------------------------------- cache-split
+
+
+def test_cache_split_fires_on_bad_fixture(bad_findings):
+    split = [f for f in bad_findings if f.rule == "cache-split"]
+    assert len(split) == 2
+    fields = " | ".join(f.message for f in split)
+    assert "`debug_port`" in fields
+    assert "`solver_option.scratch_limit_mb`" in fields
+    # strip-listed fields are never flagged as split hazards
+    assert "`telemetry`" not in fields and "`metrics`" not in fields
+
+
+def test_cache_split_pragma_hatches(tmp_path):
+    """An unread field flags; the same shape under either declared-
+    intent pragma is silent."""
+    body = """\
+
+        @dataclasses.dataclass(frozen=True)
+        class AlgoOption:
+            quiet_knob: int = 0{pragma}
+
+        def flat_solve(x, option: ProblemOption):
+            return x if option.dtype else x
+        """
+    flagged = _src_lint(tmp_path, body.format(pragma=""),
+                        rules=["cache-split"])
+    assert any("`algo_option.quiet_knob`" in f.message for f in flagged)
+    for hatch in ("  # megba: lowering-relevant(algo_option.quiet_knob)",
+                  "  # megba: key-exempt(algo_option.quiet_knob)"):
+        silent = _src_lint(tmp_path, body.format(pragma=hatch),
+                           rules=["cache-split"])
+        assert not any("quiet_knob" in f.message for f in silent), hatch
+
+
+# --------------------------------------------------- key-surface-drift
+
+
+def test_drift_partial_strip_on_bad_fixture(bad_findings):
+    msgs = [f.message for f in bad_findings
+            if f.rule == "key-surface-drift"]
+    partial = [m for m in msgs if "partial observability strip" in m]
+    assert len(partial) == 1
+    assert "clears ['telemetry']" in partial[0]
+    assert "['metrics']" in partial[0]
+
+
+def test_drift_nonconforming_helper_on_bad_fixture(bad_findings):
+    msgs = [f.message for f in bad_findings
+            if f.rule == "key-surface-drift"]
+    assert any("strip helper" in m
+               and "clears neither the full strip-list" in m
+               for m in msgs)
+
+
+def test_drift_hardcoded_exclusion_witness(bad_findings):
+    """The drift witness names both disagreeing surfaces AND the
+    registry to derive from."""
+    msgs = [f.message for f in bad_findings
+            if f.rule == "key-surface-drift"]
+    hard = [m for m in msgs if "hardcoded key-exclusion" in m]
+    assert len(hard) == 1
+    assert "['telemetry']" in hard[0]
+    assert "['metrics', 'telemetry']" in hard[0]
+    assert "OBSERVABILITY_FIELDS" in hard[0]
+
+
+def test_drift_exclusion_equal_to_registry_is_silent(tmp_path):
+    findings = _src_lint(tmp_path, """\
+
+        def _config_mismatches(recorded):
+            return [k for k in recorded
+                    if k not in ("telemetry", "metrics")]
+        """, rules=["key-surface-drift"])
+    assert findings == []
+
+
+def test_drift_unstripped_cache_front(bad_findings, tmp_path):
+    msgs = [f.message for f in bad_findings
+            if f.rule == "key-surface-drift"]
+    assert any("fronts the memoised program cache" in m for m in msgs)
+    # the stripped front in the same shape is silent
+    findings = _src_lint(tmp_path, """\
+
+        def _build(residual_jac_fn, option):
+            def fn(x):
+                return x * option.solver_option.max_iter
+            return jax.jit(fn), static_key(residual_jac_fn, option)
+
+        _cached_build = functools.lru_cache(8)(_build)
+
+        def flat_solve(residual_jac_fn, x, option: ProblemOption):
+            option = strip_observability(option)
+            return _cached_build(residual_jac_fn, option)
+        """, rules=["key-surface-drift"])
+    assert not any("fronts the memoised" in f.message for f in findings)
+
+
+def test_drift_operand_branch_positive_and_is_none_negative(tmp_path):
+    findings = _src_lint(tmp_path, """\
+
+        def _build(option):
+            def fn(x, mask, edge_mask):
+                if mask is None:  # sanctioned presence check
+                    return x
+                if edge_mask:  # operand-as-static
+                    return x * 2.0
+                return x
+            return jax.jit(fn)
+        """, rules=["key-surface-drift"])
+    operand = [f for f in findings if "operand" in f.message]
+    assert len(operand) == 1
+    assert "`edge_mask`" in operand[0].message
+    assert "operand-as-static" in operand[0].message
+
+
+def test_drift_pragma_contradiction_and_unknown_field(tmp_path):
+    findings = _src_lint(tmp_path, """\
+
+        @dataclasses.dataclass(frozen=True)
+        class AlgoOption:
+            # megba: lowering-relevant(algo_option.torn) key-exempt(algo_option.torn)
+            torn: int = 0
+            # megba: key-exempt(algo_option.vanished_field)
+            here: int = 1
+        """, rules=["key-surface-drift"])
+    msgs = [f.message for f in findings]
+    assert any("carries BOTH" in m and "`algo_option.torn`" in m
+               for m in msgs)
+    assert any("not a declared option field" in m
+               and "`algo_option.vanished_field`" in m for m in msgs)
+
+
+# ------------------------------------------ fixtures, package, surfaces
+
+
+def test_bad_fixture_pinned_per_rule_counts(bad_findings):
+    by_rule = {r: sum(1 for f in bad_findings if f.rule == r)
+               for r in IDENTITY_RULES}
+    assert by_rule == {"stale-program": 2, "cache-split": 2,
+                       "key-surface-drift": 5}
+
+
+def test_good_fixture_stays_silent():
+    assert _lint(GOOD) == []
+
+
+def test_package_zero_findings():
+    """The contract holds on the real package: all three identity rules
+    are clean on megba_tpu/ (the lane-7 acceptance gate)."""
+    findings = _lint(PACKAGE)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_package_key_surfaces_agree(pkg_summary):
+    """The four keying surfaces derive from ONE registry: the analyzer
+    extracts exactly megba_tpu.common.OBSERVABILITY_FIELDS, and every
+    lowering entry named by the contract is discovered."""
+    from megba_tpu.common import OBSERVABILITY_FIELDS
+
+    assert pkg_summary["strip_fields"] == tuple(OBSERVABILITY_FIELDS)
+    entries = set(pkg_summary["entries"])
+    for q in ("megba_tpu.solve.flat_solve",
+              "megba_tpu.parallel.mesh.distributed_lm_solve",
+              "megba_tpu.serving.compile_pool.batched_solve_program",
+              "megba_tpu.serving.compile_pool.lower_bucket",
+              "megba_tpu.models.pgo.solve_pgo"):
+        assert q in entries, q
+
+
+def test_package_unread_fields_all_declared(pkg_summary):
+    """Every keyed-but-never-lowering-read field carries a declared-
+    intent pragma — the cache-split rule is silent for the RIGHT
+    reason, not because the read-set over-resolves."""
+    strip = set(pkg_summary["strip_fields"])
+    declared = (set(pkg_summary["pragmas"]["lowering-relevant"])
+                | set(pkg_summary["pragmas"]["key-exempt"]))
+    unread = {leaf for leaf in pkg_summary["leaf_paths"]
+              if leaf not in strip
+              and leaf.split(".")[-1] not in strip
+              and leaf not in pkg_summary["reads"]}
+    assert unread == declared
+    # and the declarations are disjoint (no contradictions)
+    assert not (set(pkg_summary["pragmas"]["lowering-relevant"])
+                & set(pkg_summary["pragmas"]["key-exempt"]))
+
+
+def test_no_key_exempt_pragmas_in_serving():
+    """serving/ may not wave fields out of the key surface: key-exempt
+    declarations live with the option definitions (megba_tpu/common.py),
+    each with a stated reason."""
+    serving = os.path.join(PACKAGE, "serving")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(serving):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, start=1):
+                    if "megba:" in line and "key-exempt(" in line:
+                        offenders.append(f"{path}:{lineno}")
+    assert offenders == []
+
+
+def test_cli_exit_codes_per_rule():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    root = os.path.dirname(PACKAGE)
+    for rule in IDENTITY_RULES:
+        res = subprocess.run(
+            [sys.executable, "-m", "megba_tpu.analysis.lint",
+             "--rule", rule, BAD],
+            capture_output=True, text=True, timeout=120, cwd=root,
+            env=env)
+        assert res.returncode == 1, (rule, res.stdout, res.stderr)
+        assert f" {rule} " in res.stdout, (rule, res.stdout)
+    good = subprocess.run(
+        [sys.executable, "-m", "megba_tpu.analysis.lint",
+         "--rule", "stale-program", "--rule", "cache-split",
+         "--rule", "key-surface-drift", GOOD],
+        capture_output=True, text=True, timeout=120, cwd=root, env=env)
+    assert good.returncode == 0, (good.stdout, good.stderr)
+
+
+def test_suppression_comment_respected(tmp_path):
+    """The framework-wide `# megba: allow-<rule>` hatch applies to the
+    identity rules like any other."""
+    findings = _src_lint(tmp_path, """\
+
+        def flat_solve(residual_jac_fn, x, option: ProblemOption):
+            sink = option.telemetry  # megba: allow-stale-program
+            return x, sink
+        """, rules=["stale-program"])
+    assert findings == []
