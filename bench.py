@@ -168,6 +168,10 @@ if _BF16_BENCH:
 # (obs_head_to_head) writing BENCH_obs.json.  Entirely host-side — the
 # plane never touches the jitted programs — so no device floor needed.
 _OBS_BENCH = os.environ.get("MEGBA_BENCH_OBS") == "1"
+# MEGBA_BENCH_FUSED=1: fused Pallas edge-pipeline kernels vs the tiled
+# XLA lowering (fused_head_to_head) writing BENCH_fused.json.
+# Single-device tiled path — no device floor needed.
+_FUSED_BENCH = os.environ.get("MEGBA_BENCH_FUSED") == "1"
 _C = CONFIGS[CONFIG]
 NUM_CAMERAS = max(8, int(_C.cameras * _SCALE))
 NUM_POINTS = max(64, int(_C.points * _SCALE))
@@ -819,6 +823,149 @@ def bf16_head_to_head(s, base_option, timer) -> dict:
     return result
 
 
+def fused_head_to_head(s, base_option, timer) -> dict:
+    """Fused edge-pipeline kernels vs the tiled XLA lowering
+    (MEGBA_BENCH_FUSED=1): the same scene on the SAME tiled edge plans,
+    production inexact-LM config, guards ARMED on both sides — the
+    contract is end-to-end LM cost parity within 1e-5 with ZERO
+    guard/recovery events (a clean fused run must not lean on the
+    containment machinery), plus the structural bytes story: the
+    per-S·p HBM budget with and without the transient gather/product
+    round-trips the fusion deletes, priced live for this scene by
+    analysis/edge_budget and pinned for the canonical programs in
+    ANALYSIS_BUDGET.json.
+
+    HONESTY TAG: off-TPU the Pallas kernels run under INTERPRET mode —
+    wall-clock here measures the Python-level kernel interpreter (orders
+    of magnitude slower than both XLA:CPU and the Mosaic lowering), so
+    the fused side's elapsed time is NOT evidence of the VMEM-residency
+    win and no speedup ratio is reported from this lane.  The
+    transferable evidence is the cost-parity band, the zero-guard
+    certificate, and the analytical bytes_touched_per_sp delta.
+    """
+    import dataclasses as _dc
+    import tempfile
+
+    import jax
+
+    from megba_tpu.common import RobustOption, SolverOption
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    f = make_residual_jacobian_fn(mode=base_option.jacobian_mode)
+    tele = tempfile.NamedTemporaryFile(
+        mode="r", suffix=".jsonl", delete=False)
+    tele.close()
+
+    def opt_for(fused: bool):
+        return _dc.replace(
+            base_option,
+            robust_option=RobustOption(guards=True),
+            telemetry=tele.name,
+            solver_option=SolverOption(
+                max_iter=PCG_ITERS, refuse_ratio=1e30,
+                forcing=True, warm_start=True, fused_kernels=fused))
+
+    def run(label, fused):
+        opt = opt_for(fused)
+        kw = dict(use_tiled=True, timer=timer)
+        with timer.phase(f"fused_warm_{label}"):
+            jax.block_until_ready(
+                flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                           s.pt_idx, opt, **kw).cost)
+        t0 = time.perf_counter()
+        with timer.phase(f"fused_solve_{label}"):
+            res = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                             s.pt_idx, opt, **kw)
+            jax.block_until_ready(res)
+        elapsed = time.perf_counter() - t0
+        iters = int(res.iterations)
+        return res, {
+            "elapsed_s": round(elapsed, 3),
+            "lm_iters": iters,
+            "accepted": int(res.accepted),
+            "pcg_iters_total": int(res.pcg_iterations),
+            "cost": float(res.cost),
+            "status": _status_name(res),
+            "recoveries": int(res.recoveries),
+            "pcg_breakdowns": int(np.asarray(
+                res.trace.pcg_breakdown[:iters]).sum()),
+        }
+
+    res32, side_xla = run("xla", fused=False)
+    resf, side_fused = run("pallas", fused=True)
+    gap = abs(side_fused["cost"] - side_xla["cost"]) / max(
+        abs(side_xla["cost"]), 1e-30)
+
+    # Per-solve tile/reuse metrics ride the telemetry report; the last
+    # line is the fused run.
+    tiles = None
+    try:
+        lines = [ln for ln in open(tele.name) if ln.strip()]
+        if lines:
+            tiles = json.loads(lines[-1]).get("tiles")
+    finally:
+        os.unlink(tele.name)
+
+    # The structural half: price this scene's per-S·p HBM bytes with
+    # the transient gather/product round-trips (tiled XLA lowering) and
+    # without them (fused kernels) — same plan, same dtype surface, so
+    # the delta IS the traffic the fusion deletes.
+    from megba_tpu.analysis import budget as budget_mod
+    from megba_tpu.analysis import edge_budget
+    from megba_tpu.ops.segtiles import cached_dual_plans
+
+    (plan_c, _plans), _ = cached_dual_plans(
+        np.asarray(s.cam_idx), np.asarray(s.pt_idx),
+        len(s.cameras0), len(s.points0))
+    geom = dict(num_cameras=len(s.cameras0), cd=9,
+                num_points=len(s.points0), pd=3, rd=2,
+                edge_slots=plan_c.n_slots)
+    arm_xla = edge_budget.schur_sp_budget(**geom, transient_roundtrips=True)
+    arm_fused = edge_budget.schur_sp_budget(**geom,
+                                            transient_roundtrips=False)
+    committed = budget_mod.load_baseline()
+    committed_axes = {
+        name: {k: committed.get(name, {}).get(k)
+               for k in ("flops_per_sp", "bytes_touched_per_sp")}
+        for name in ("ba_tiled_f32", "ba_bf16_w2_f32")}
+
+    result = {
+        "lane": f"CPU fallback ({jax.default_backend()}): the Pallas "
+                "kernels run under INTERPRET mode here — the fused "
+                "side's wall-clock measures the kernel interpreter, "
+                "not the VMEM-residency win, so no speedup ratio is "
+                "reported; cost parity + zero guard events + the "
+                "analytical bytes delta are the evidence",
+        "config": "inexact-LM (forcing + warm starts), guards armed, "
+                  f"pcg_max_iter={PCG_ITERS}, tiled plans both sides",
+        "scene": {"cameras": len(s.cameras0), "points": len(s.points0),
+                  "edges": int(s.obs.shape[0])},
+        "xla_tiled": side_xla,
+        "fused_pallas": side_fused,
+        "cost_rel_gap": gap,
+        # The ISSUE acceptance band: end-to-end LM cost within 1e-5 of
+        # the unfused lowering with zero guard events.
+        "cost_gap_band": 1e-5,
+        "guard_events_fused": (side_fused["recoveries"]
+                               + side_fused["pcg_breakdowns"]),
+        "tiles": tiles,
+        "bytes_per_sp_with_transients": arm_xla["bytes_touched_per_sp"],
+        "bytes_per_sp_fused": arm_fused["bytes_touched_per_sp"],
+        "transient_bytes_deleted_per_sp": (
+            arm_xla["bytes_touched_per_sp"]
+            - arm_fused["bytes_touched_per_sp"]),
+        "flops_per_sp": arm_fused["flops_per_sp"],
+        "committed_axes": committed_axes,
+    }
+    artifact_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_fused.json")
+    with open(artifact_path, "w") as fh:
+        json.dump(result, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return result
+
+
 def main() -> None:
     import sys
 
@@ -1174,6 +1321,14 @@ def main() -> None:
     bf16_cmp = None
     if _BF16_BENCH:
         bf16_cmp = bf16_head_to_head(s, option, timer)
+    # Fused edge-pipeline head-to-head (MEGBA_BENCH_FUSED=1): Pallas
+    # mega-kernels vs the tiled XLA lowering on the same plans — cost
+    # parity band, zero-guard certificate, tile/reuse geometry, and the
+    # analytical transient-bytes-deleted axis (interpret-mode
+    # honesty-tagged off-TPU).  Also written to BENCH_fused.json.
+    fused_cmp = None
+    if _FUSED_BENCH:
+        fused_cmp = fused_head_to_head(s, option, timer)
     # Observability-plane overhead head-to-head (MEGBA_BENCH_OBS=1):
     # solve_many with the plane off vs metrics+spans on, same warmed
     # fleet, <= 2% acceptance band.  Also written to BENCH_obs.json.
@@ -1307,6 +1462,11 @@ def main() -> None:
                     # cleanliness + halved bytes axes; also lands in
                     # BENCH_bf16.json.
                     "bf16": bf16_cmp,
+                    # Fused edge-pipeline head-to-head
+                    # (MEGBA_BENCH_FUSED=1): Pallas kernels vs tiled
+                    # XLA — cost parity + zero guards + transient-bytes
+                    # delta; also lands in BENCH_fused.json.
+                    "fused": fused_cmp,
                     # Observability-plane overhead (MEGBA_BENCH_OBS=1):
                     # plane off vs metrics+spans on, <= 2% band; also
                     # lands in BENCH_obs.json.

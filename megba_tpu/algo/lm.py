@@ -392,6 +392,7 @@ def lm_solve(
                 mixed_precision=option.mixed_precision_pcg,
                 bf16=solver_opt.bf16,
                 bf16_collectives=solver_opt.bf16_collectives,
+                fused_kernels=solver_opt.fused_kernels,
                 cam_sorted=cam_sorted,
                 preconditioner=solver_opt.preconditioner, plans=plans,
                 x0=s["dx0"] if warm_start else None,

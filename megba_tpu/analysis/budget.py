@@ -41,6 +41,14 @@ TOLERANCES: Dict[str, float] = {
     # an overlap/subgroup win re-baselines with --update and is
     # thereby pinned.
     "collective_bytes_per_sp": 0.0,
+    # Declared analytical edge-pipeline axes (analysis/edge_budget.py):
+    # per-device flops and HBM bytes touched per S·p, priced from the
+    # problem geometry + edge-stream plan + dtype surface with zero
+    # compiler in the loop.  Exact: the same pure function prices both
+    # --update and --check, so a mismatch means the INPUTS drifted —
+    # a plan/quantum/dtype-surface change that must be intentional.
+    "flops_per_sp": 0.0,
+    "bytes_touched_per_sp": 0.0,
 }
 
 

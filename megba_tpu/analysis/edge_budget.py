@@ -1,0 +1,139 @@
+"""Analytical per-CG-step compute/traffic model of the edge pipeline.
+
+The budget gate's `flops` / `bytes_accessed` axes are XLA's cost model:
+they move whenever the compiler fuses differently, which is why they get
+a 15% band.  This module is the other kind of axis — a DECLARED
+structural contract, priced from the problem geometry, the edge-stream
+plan, and the dtype surface, with zero compiler in the loop:
+
+- ``flops_per_sp``    — useful floating-point work one device performs
+  per S·p product (one PCG iteration's matvec), MAC = 2 flops.
+- ``bytes_touched_per_sp`` — HBM bytes one device streams per S·p
+  through the coupling pipeline: coupling-row reads, Krylov
+  gather/scatter traffic, block-diagonal reads, and — on the unfused
+  lowerings — the per-edge transient round-trips (gathered operand
+  tiles, the intermediate u rows, the pre-reduction products) that the
+  fused Pallas kernels (ops/fused.py) keep VMEM-resident.
+
+Both are exact-gated (tolerance 0.0 in budget.TOLERANCES): the same
+pure function prices the axis at ``--update`` and ``--check`` time, so
+the committed number pins the INPUTS — edge-stream length (padding
+included: padded slots ride the MXU too), block dims, compute kind,
+operand dtype.  A plan change, a quantum bump, or a dtype-surface edit
+shows up as an exact-match failure naming the program.  The fused-
+kernel option's whole bytes story is the ``transient_roundtrips=False``
+arm: tests pin that fused pricing is strictly below unfused on the
+same geometry, and the canonical (fused-off) baselines stay priced on
+the unfused arm.
+
+Model assumptions, stated so the numbers are auditable:
+
+- Per-DEVICE accounting, matching ``collective_bytes_per_sp``: the 1-D
+  sharded lowerings replicate the parameter blocks, so the block-
+  diagonal applies are counted at full Nc/Np on every device while the
+  edge stream is the per-device shard.
+- IMPLICIT compute kind (the SolverOption default every canonical
+  program lowers with): per edge and direction the coupling does
+  rd·(cd+pd) MACs through the intermediate u = J_in·p rows.  EXPLICIT
+  W-based programs price cd·pd MACs per edge per direction.
+- Transients are priced as one write + one read (round-trip) of each
+  per-edge intermediate at accumulator width; the fused kernels'
+  pricing drops exactly this term and nothing else.
+
+All stdlib + dataclasses, no jax, no numpy: importable by the audit CLI
+and the cripple-mode tests without touching a backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# Operand storage widths the pricing understands (bytes per element).
+OPERAND_BYTES: Dict[str, int] = {"bf16": 2, "f32": 4, "f64": 8}
+
+
+def coupling_rows_per_edge(cd: int, pd: int, rd: int,
+                           explicit: bool = False) -> int:
+    """Stored coupling-row elements per edge slot (one direction's
+    operand stream): the W block (explicit) or the Jc+Jp row pair
+    (implicit) — the elements a matvec direction must read per slot."""
+    return cd * pd if explicit else rd * (cd + pd)
+
+
+def coupling_macs_per_edge(cd: int, pd: int, rd: int,
+                           explicit: bool = False) -> int:
+    """MACs per edge slot per direction: W·p (explicit) or the two-stage
+    J_outᵀ(J_in·p) contraction (implicit)."""
+    return cd * pd if explicit else rd * (cd + pd)
+
+
+def schur_sp_budget(num_cameras: int, cd: int, num_points: int, pd: int,
+                    rd: int, edge_slots: int, *,
+                    explicit: bool = False,
+                    operand: str = "f32",
+                    param: str = "f32",
+                    acc: str = "f32",
+                    transient_roundtrips: bool = True,
+                    lanes: int = 1) -> Dict[str, float]:
+    """Per-device, per-S·p budget of the Schur-complement matvec
+    S·p = Hpp·p − Hpl·Hll⁻¹·Hlp·p on one edge-stream shard.
+
+    ``edge_slots`` is the PADDED per-device edge-stream length (quantum
+    padding / tile-plan slots included — padding slots do the same MXU
+    work and move the same bytes as real edges).  ``operand`` prices
+    the coupling rows (bf16 under the mixed-precision rung), ``param``
+    the parameter-space vectors and block diagonals, ``acc`` the
+    transient intermediates.  ``transient_roundtrips=False`` is the
+    fused-kernel arm: gather→contract→scatter stays VMEM-resident, so
+    the per-edge intermediates never touch HBM.  ``lanes`` scales
+    everything for the vmapped batched program.
+    """
+    ob = OPERAND_BYTES[operand]
+    pb = OPERAND_BYTES[param]
+    ab = OPERAND_BYTES[acc]
+    macs = coupling_macs_per_edge(cd, pd, rd, explicit)
+    rows = coupling_rows_per_edge(cd, pd, rd, explicit)
+    # Two coupling traversals per S·p (hlp: cam→pt, hpl: pt→cam), plus
+    # the camera block-diagonal apply and the point-block Hll⁻¹ apply.
+    flops = 2.0 * (num_cameras * cd * cd
+                   + num_points * pd * pd
+                   + 2 * edge_slots * macs)
+    # Per-direction traffic: coupling rows read once; gather source and
+    # scatter destination vectors touched once each at param width.
+    vec_elems = num_cameras * cd + num_points * pd
+    bytes_touched = 2.0 * (edge_slots * rows * ob + vec_elems * pb)
+    # Block diagonals read once per apply (Hpp blocks + Hll⁻¹ blocks).
+    bytes_touched += (num_cameras * cd * cd + num_points * pd * pd) * pb
+    if transient_roundtrips:
+        # Unfused lowerings round-trip the per-edge intermediates:
+        # gathered input tiles [d_in, E], the u rows [rd, E] (implicit
+        # only), and the pre-reduction products [d_out, E] — write +
+        # read each, both directions.  This is the exact term the
+        # fused kernels delete.
+        per_dir = cd + pd + (0 if explicit else rd)
+        bytes_touched += 2.0 * 2 * edge_slots * per_dir * ab
+    return {"flops_per_sp": float(flops) * lanes,
+            "bytes_touched_per_sp": float(bytes_touched) * lanes}
+
+
+def pgo_sp_budget(num_poses: int, pose_dim: int, rd: int,
+                  edge_slots: int, *,
+                  param: str = "f64") -> Dict[str, float]:
+    """Per-device, per-H·x budget of PGO's matrix-free Gauss-Newton
+    matvec: one edge-stream traversal computing Jᵀ(J·x) through the
+    rd-row residual blocks (both endpoint Jacobians, pose_dim each),
+    plus the block-Jacobi diagonal apply.  One traversal — PGO's body
+    has a single reduction site, not the Schur pair."""
+    pb = OPERAND_BYTES[param]
+    macs = rd * (2 * pose_dim)  # J·x per edge; same again for Jᵀu
+    flops = 2.0 * (num_poses * pose_dim * pose_dim
+                   + 2 * edge_slots * macs)
+    rows = rd * 2 * pose_dim  # stored endpoint Jacobian pair per edge
+    vec_elems = num_poses * pose_dim
+    bytes_touched = (edge_slots * rows * pb + 2.0 * vec_elems * pb
+                     + num_poses * pose_dim * pose_dim * pb)
+    # Transient round-trips (gathered endpoint pair, u rows, products):
+    # PGO has no fused lowering, so the term is unconditional.
+    bytes_touched += 2.0 * edge_slots * (2 * pose_dim + rd + 2 * pose_dim) * pb
+    return {"flops_per_sp": float(flops),
+            "bytes_touched_per_sp": float(bytes_touched)}

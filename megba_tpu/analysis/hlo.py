@@ -390,6 +390,24 @@ def collective_ops(ops: Iterable[HloOp]) -> List[HloOp]:
     return [op for op in ops if op.kind in COLLECTIVE_KINDS]
 
 
+def custom_call_census(ops: Iterable[HloOp]) -> Dict[str, int]:
+    """target -> count of every custom_call in the op stream.
+
+    The transfer pass answers "does this program leave the device?";
+    this census answers "what OPAQUE code does it run?".  A Pallas
+    kernel lowers to a custom_call (`tpu_custom_call` on TPU; interpret
+    mode on the CPU lane lowers to plain HLO and leaves no trace here),
+    so the canonical fused-OFF programs pin an empty/kernel-free census
+    — a Pallas call leaking into a default-option lowering is a dark-
+    launch violation, caught by name."""
+    out: Dict[str, int] = {}
+    for op in ops:
+        if op.kind == "custom_call":
+            t = op.target or "<unknown>"
+            out[t] = out.get(t, 0) + 1
+    return out
+
+
 def _walk_stablehlo_lines(text: str):
     """Yield (lineno, raw, kind-or-None, while_depth, brace_depth) for
     every line of a StableHLO module.

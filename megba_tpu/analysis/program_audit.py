@@ -174,6 +174,15 @@ class ProgramSpec:
     # (native bf16 collectives) moves the declared payload, and the
     # surface pass pins that the declaration exists.
     bf16_surface: Optional[Bf16Surface] = None
+    # Declared analytical per-S·p budget (analysis/edge_budget.py):
+    # (metric, value) pairs — `flops_per_sp` / `bytes_touched_per_sp` —
+    # priced from the problem geometry, the edge-stream plan (padding
+    # included) and the dtype surface, with zero compiler in the loop.
+    # Exact-gated in ANALYSIS_BUDGET.json: the committed number pins
+    # the INPUTS, so a plan change, a quantum bump, or a dtype-surface
+    # edit fails `--check` naming the program.  Spec-carried, not
+    # measured, so the axes survive a backend without cost analysis.
+    sp_budget: Optional[Tuple[Tuple[str, float], ...]] = None
 
 
 @dataclasses.dataclass
@@ -500,6 +509,12 @@ class ProgramAudit:
         # subgroup win is PINNED, not anecdotal — and a fatter
         # collective sneaking into the body fails audit --check.
         out["collective_bytes_per_sp"] = self.pcg_body_collective_bytes()
+        # Declared analytical axes (analysis/edge_budget.py): priced
+        # from the spec, not measured from the backend, so they are
+        # present — and exact-gated — even when cost_analysis is not.
+        if self.spec.sp_budget is not None:
+            for k, v in self.spec.sp_budget:
+                out[k] = float(v)
         return out
 
     def violations(self) -> List[str]:
@@ -517,6 +532,12 @@ class ProgramAudit:
             "pcg_body_all_reduces": sum(
                 1 for op in pcg if op.kind == "all_reduce"),
             "pcg_body_census": self.pcg_body_kind_census(),
+            # Opaque-code census: every custom_call target in the
+            # StableHLO, counted.  A Pallas kernel lowers to one
+            # (tpu_custom_call) on TPU; the canonical fused-OFF
+            # programs must stay kernel-free here (dark-launch pin,
+            # tests/test_fused.py).
+            "custom_calls": hlo.custom_call_census(self.stablehlo_ops),
             "collectives": [
                 {"kind": op.kind, "elems": op.result_elems,
                  "dtype": op.result_dtype, "scope": op.op_name,
@@ -720,16 +741,102 @@ def _pgo_sharded_donation() -> Tuple[int, ...]:
     return (0,) if SHARD_MAP_NATIVE else ()
 
 
+# --------------------------------------------------------------------------
+# Declared per-S·p budgets (edge_budget.py pricing over the SAME host
+# planning the lowering runs — the lru caches make the later build a
+# plan-cache hit, so the audit never plans twice).  Everything is
+# derived live: if the quantum, a tile plan, or the bucket ladder
+# changes, the priced number moves WITH the program and the committed
+# ANALYSIS_BUDGET.json entry fails exact-match, naming the drift.
+# --------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _sp_budget_ba(world: int, use_tiled: bool, mesh2d: bool = False,
+                  bf16: bool = False, multilevel: bool = False,
+                  lanes: int = 1,
+                  factor: Optional[str] = None,
+                  ) -> Tuple[Tuple[str, float], ...]:
+    from megba_tpu.analysis import edge_budget
+    from megba_tpu.core.fm import EDGE_QUANTUM
+
+    fam = "f32"
+    rd = 2  # BAL / rig / radial pinhole residual rows
+    if factor == "rig":
+        from megba_tpu.factors.rig import make_synthetic_rig
+
+        s = make_synthetic_rig(num_bodies=4, num_points=24, seed=0,
+                               dtype=np.float32)
+    elif factor == "pinhole_radial":
+        from megba_tpu.factors.radial import make_synthetic_radial
+
+        s = make_synthetic_radial(num_cameras=4, num_points=24, seed=0,
+                                  dtype=np.float32)
+    elif factor == "pose_prior":
+        from megba_tpu.factors.priors import make_synthetic_priors
+
+        s = make_synthetic_priors(num_poses=8, seed=0, dtype=np.float64)
+        fam, rd = "f64", 6
+    else:
+        s = _ba_ml_problem() if multilevel else _ba_problem()
+    nc, cd = s.cameras0.shape
+    npts, pd = s.points0.shape
+    ne = s.obs.shape[0]
+    if lanes > 1:
+        # The batched program solves at its BUCKET shape (the compile
+        # pool's ladder), not the raw problem shape.
+        from megba_tpu.serving.shape_class import BucketLadder, classify
+
+        shape = classify(nc, npts, ne, np.float32, BucketLadder())
+        nc, npts, ne = shape.n_cam, shape.n_pt, shape.n_edge
+    if mesh2d:
+        from megba_tpu.ops.segtiles import cached_camera_tile_plan
+        from megba_tpu.parallel.mesh import factor_mesh_2d
+
+        n_shards, n_blocks = factor_mesh_2d(world, 2)
+        (tplan, _), _ = cached_camera_tile_plan(
+            s.cam_idx, s.pt_idx, nc, npts, n_shards, n_blocks)
+        slots = tplan.perm.shape[0] // world  # one (shard, block) cell
+    elif use_tiled:
+        from megba_tpu.ops.segtiles import cached_dual_plans
+
+        (plan_c, _), _ = cached_dual_plans(s.cam_idx, s.pt_idx, nc, npts)
+        slots = plan_c.n_slots
+    else:
+        q = world * EDGE_QUANTUM
+        slots = (-(-ne // q) * q) // world
+    b = edge_budget.schur_sp_budget(
+        nc, cd, npts, pd, rd, slots,
+        operand="bf16" if bf16 else fam, param=fam, acc=fam, lanes=lanes)
+    return tuple(sorted(b.items()))
+
+
+@functools.lru_cache(maxsize=None)
+def _sp_budget_pgo(world: int,
+                   pose_dim: int = 6) -> Tuple[Tuple[str, float], ...]:
+    # The canonical pose graphs: 16 poses, 15 odometry + 4 loop edges,
+    # padded to a multiple of world (models/pgo.py pads by world, not
+    # by EDGE_QUANTUM); residual rows = pose_dim for both SE(3) and
+    # Sim(3).
+    ne = 19
+    slots = (ne + (-ne) % world) // world
+    from megba_tpu.analysis import edge_budget
+
+    b = edge_budget.pgo_sp_budget(16, pose_dim, pose_dim, slots)
+    return tuple(sorted(b.items()))
+
+
 def program_specs() -> Dict[str, ProgramSpec]:
     """name -> spec for every canonical audited program."""
     return {
         "ba_single_f32": ProgramSpec(
             name="ba_single_f32", float_family="f32", world=1, pcg_psums=0,
             donate_leaves=(0, 1),
+            sp_budget=_sp_budget_ba(world=1, use_tiled=False),
             build=lambda: _lower_ba(world=1, use_tiled=False)),
         "ba_tiled_f32": ProgramSpec(
             name="ba_tiled_f32", float_family="f32", world=1, pcg_psums=0,
             donate_leaves=(0, 1),
+            sp_budget=_sp_budget_ba(world=1, use_tiled=True),
             build=lambda: _lower_ba(world=1, use_tiled=True)),
         "ba_sharded_w2_f32": ProgramSpec(
             name="ba_sharded_w2_f32", float_family="f32", world=2,
@@ -737,6 +844,7 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # in hpl — exactly two reductions per CG step (solver/pcg.py).
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
+            sp_budget=_sp_budget_ba(world=2, use_tiled=False),
             build=lambda: _lower_ba(world=2, use_tiled=False)),
         "ba_forcing_w2_f32": ProgramSpec(
             name="ba_forcing_w2_f32", float_family="f32", world=2,
@@ -749,6 +857,7 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # this spec pins against.
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
+            sp_budget=_sp_budget_ba(world=2, use_tiled=False),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     forcing=True)),
         "ba_guarded_w2_f32": ProgramSpec(
@@ -762,6 +871,7 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # the regression this spec pins against.
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
+            sp_budget=_sp_budget_ba(world=2, use_tiled=False),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     guarded=True)),
         "ba_twolevel_w2_f32": ProgramSpec(
@@ -776,6 +886,7 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # precisely the regression this spec pins against.
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
+            sp_budget=_sp_budget_ba(world=2, use_tiled=False),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     twolevel=True)),
         "ba_multilevel_w2_f32": ProgramSpec(
@@ -792,6 +903,8 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # this spec pins against.
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
+            sp_budget=_sp_budget_ba(world=2, use_tiled=False,
+                                     multilevel=True),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     multilevel=True)),
         "ba_2d_w4_f32": ProgramSpec(
@@ -815,6 +928,8 @@ def program_specs() -> Dict[str, ProgramSpec]:
             pcg_body_census=(("all_reduce", 2), ("reduce_scatter", 1),
                              ("all_gather", 1), ("collective_permute", 1)),
             pcg_subgroup_only=True,
+            sp_budget=_sp_budget_ba(world=4, use_tiled=False,
+                                     mesh2d=True),
             build=lambda: _lower_ba(world=4, use_tiled=False,
                                     mesh2d=True)),
         "ba_bf16_w2_f32": ProgramSpec(
@@ -833,6 +948,8 @@ def program_specs() -> Dict[str, ProgramSpec]:
             pcg_psums=2,
             donate_leaves=_sharded_donation(),
             bf16_surface=Bf16Surface(collectives=True),
+            sp_budget=_sp_budget_ba(world=2, use_tiled=False,
+                                     bf16=True),
             build=lambda: _lower_ba(world=2, use_tiled=False,
                                     bf16=True)),
         "ba_bf16_2d_w4_f32": ProgramSpec(
@@ -852,6 +969,8 @@ def program_specs() -> Dict[str, ProgramSpec]:
                              ("all_gather", 1), ("collective_permute", 1)),
             pcg_subgroup_only=True,
             bf16_surface=Bf16Surface(collectives=True),
+            sp_budget=_sp_budget_ba(world=4, use_tiled=False,
+                                     mesh2d=True, bf16=True),
             build=lambda: _lower_ba(world=4, use_tiled=False,
                                     mesh2d=True, bf16=True)),
         "ba_batched_b4_f32": ProgramSpec(
@@ -864,6 +983,7 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # The batcher donates the stacked parameter lanes
             # (compile_pool._build_batched_solve donate_argnums=(0, 1)).
             donate_leaves=(0, 1),
+            sp_budget=_sp_budget_ba(world=1, use_tiled=False, lanes=4),
             build=lambda: _lower_batched(lanes=4)),
         # ---- factor-registry canonical programs ----------------------
         # One per new family (ISSUE 13): each is lowered through the
@@ -880,11 +1000,15 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # program.
             pcg_psums=0,
             donate_leaves=(0, 1),
+            sp_budget=_sp_budget_ba(world=1, use_tiled=False,
+                                     factor="rig"),
             build=lambda: _lower_factor("rig")),
         "ba_radial_single_f32": ProgramSpec(
             name="ba_radial_single_f32", float_family="f32", world=1,
             pcg_psums=0,
             donate_leaves=(0, 1),
+            sp_budget=_sp_budget_ba(world=1, use_tiled=False,
+                                     factor="pinhole_radial"),
             build=lambda: _lower_factor("pinhole_radial")),
         "prior_single_f64": ProgramSpec(
             name="prior_single_f64", float_family="f64", world=1,
@@ -894,6 +1018,8 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # the prior residual's rotation chain fails here.
             pcg_psums=0,
             donate_leaves=(0, 1),
+            sp_budget=_sp_budget_ba(world=1, use_tiled=False,
+                                     factor="pose_prior"),
             build=lambda: _lower_factor("pose_prior", np.float64)),
         "pgo_sim3_single_f64": ProgramSpec(
             name="pgo_sim3_single_f64", float_family="f64", world=1,
@@ -902,10 +1028,12 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # exactly like the SE(3) program.
             pcg_psums=0,
             donate_leaves=(0,),
+            sp_budget=_sp_budget_pgo(world=1, pose_dim=7),
             build=lambda: _lower_sim3(world=1)),
         "pgo_single_f64": ProgramSpec(
             name="pgo_single_f64", float_family="f64", world=1, pcg_psums=0,
             donate_leaves=(0,),
+            sp_budget=_sp_budget_pgo(world=1),
             build=lambda: _lower_pgo(world=1)),
         "pgo_sharded_w2_f64": ProgramSpec(
             name="pgo_sharded_w2_f64", float_family="f64", world=2,
@@ -913,6 +1041,7 @@ def program_specs() -> Dict[str, ProgramSpec]:
             # (models/pgo.py matvec) — one reduction per CG step.
             pcg_psums=1,
             donate_leaves=_pgo_sharded_donation(),
+            sp_budget=_sp_budget_pgo(world=2),
             build=lambda: _lower_pgo(world=2)),
     }
 

@@ -379,6 +379,24 @@ class SolverOption:
     # RHS, coarse builds, back-substitution) always stay full
     # precision.
     bf16_collectives: bool = False
+    # Fused Pallas edge-pipeline kernels (ops/fused.py): run the Schur
+    # coupling matvec as ONE gather->contract->scatter kernel per
+    # direction (edge tiles stay VMEM-resident — the per-edge expanded
+    # rows never touch HBM) and the block-diagonal M⁻¹ apply as one
+    # fused kernel pass.  OFF by default — every existing program
+    # lowers byte-identically with it unset (the dark-landing
+    # guarantee, pinned by test_program_audit).  Composes with the
+    # tiled plans, the 2-D mesh ring step, and bf16 (lifting the
+    # tiled+bf16 refusal — the fused kernels ARE the bf16-legal tiled
+    # lowering); refused typed on the non-tiled XLA lowering
+    # (use_tiled=False) and on 1-D multi-device worlds, which keep the
+    # existing paths.  Off-TPU the same kernels run under Pallas
+    # interpret mode (the CPU-lane parity certificate), so this flag
+    # changes PROGRAMS, not semantics.  Stripped by escalation rung 2.
+    # (No declared-intent pragma: the field is READ by the lowering —
+    # flat_solve's plan/refusal branches and the pcg dispatch — so the
+    # identity lane resolves it lowering-relevant from the read-set.)
+    fused_kernels: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -618,6 +636,11 @@ def validate_options(option: ProblemOption) -> None:
                 "are different rungs of the same precision ladder (bf16 "
                 "multiplies in bf16 with f32 accumulation; mixed upcasts "
                 "the stored rows before multiplying) — pick one")
+    if option.solver_option.fused_kernels and not option.use_schur:
+        raise ValueError(
+            "SolverOption.fused_kernels fuses the Schur coupling matvec "
+            "and M⁻¹ apply (use_schur=True); the plain full-system path "
+            "has no edge pipeline to fuse")
     if option.solver_option.bf16_collectives and not option.solver_option.bf16:
         raise ValueError(
             "bf16_collectives compresses the in-body collective payloads "

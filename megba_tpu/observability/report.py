@@ -134,6 +134,13 @@ class SolveReport:
     trace_id: Optional[str] = None
     span_id: Optional[str] = None
     worker: Optional[str] = None
+    # Optional tile-plan metrics (solve.flat_solve): the streaming
+    # reuse_factor / tile-occupancy statistics of the planned edge
+    # stream — and, under SolverOption.fused_kernels, the per-direction
+    # fused bucket-plan summaries — so a fused-kernel win (or the lack
+    # of one on a reuse-poor scene) is attributable per solve.  None on
+    # the non-tiled lowerings and on pre-existing report lines.
+    tiles: Optional[Dict[str, Any]] = None
     schema: str = SCHEMA
     created_unix: float = 0.0
 
@@ -187,7 +194,8 @@ def build_report(option, result, phases: Dict[str, Any],
                  audit: Optional[Dict[str, Any]] = None,
                  fleet: Optional[Dict[str, Any]] = None,
                  elastic: Optional[Dict[str, Any]] = None,
-                 health: Optional[Dict[str, Any]] = None) -> SolveReport:
+                 health: Optional[Dict[str, Any]] = None,
+                 tiles: Optional[Dict[str, Any]] = None) -> SolveReport:
     """Assemble a SolveReport from a finished solve.
 
     `result` is an LMResult (trace included when the solve populated
@@ -246,6 +254,7 @@ def build_report(option, result, phases: Dict[str, Any],
         fleet=fleet,
         elastic=elastic,
         health=health,
+        tiles=tiles,
         trace_id=None if span_ctx is None else span_ctx["trace_id"],
         span_id=None if span_ctx is None else span_ctx["span_id"],
         worker=os.environ.get("MEGBA_FEDERATION_WORKER") or None,

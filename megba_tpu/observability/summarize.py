@@ -97,6 +97,27 @@ def format_report(rep: SolveReport, index: int = 0) -> str:
             f"   precond fallback: {fb.get('block', 0)} block / "
             f"{fb.get('coarse', 0)} coarse iters{per}")
 
+    tiles = getattr(rep, "tiles", None) or {}
+    if tiles:
+        # Tile-plan attribution (solve.flat_solve): streaming reuse of
+        # the planned edge stream + slot occupancy, and the fused
+        # bucket-plan summaries when SolverOption.fused_kernels ran.
+        rf = tiles.get("reuse_factor")
+        occ = tiles.get("occupancy")
+        line = f"   tiles[{tiles.get('plan', '?')}]:"
+        if rf is not None:
+            line += f" reuse_factor={rf:.1f}"
+        if occ is not None:
+            line += f" occupancy={occ:.3f}"
+        lines.append(line)
+        for dname in ("fused_to_pt", "fused_to_cam"):
+            fp = tiles.get(dname)
+            if fp:
+                lines.append(
+                    f"     fused {dname}: {fp.get('tiles')} tiles x "
+                    f"{fp.get('tile')} slots, "
+                    f"occupancy={fp.get('occupancy'):.3f}")
+
     if rep.trace and rep.trace.get("cost"):
         t = rep.trace
         lines.append("   iter  cost          log10    region     rho"

@@ -44,6 +44,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from megba_tpu.ops import fused as fused_ops
+
 # Defaults chosen for v5e VMEM (~128 MB) and MXU tile shapes:
 # onehot [T, B] f32 must stay a few MB.  The camera axis is short
 # (thousands), so narrow blocks waste nothing; the point axis is long
@@ -757,11 +759,20 @@ class DualPlans:
     `pt.inv[s_pt]` is the cam slot holding pt-slot s_pt's edge, and
     `cam.inv[s_cam]` the reverse.  `use_kernels` selects the Pallas
     kernels (real TPU) vs the XLA fallback (CPU tests, interpret-free).
+
+    `fused_to_pt`/`fused_to_cam` are the OPTIONAL bucket-structured
+    plans of the fused edge-pipeline kernels (ops/fused.py), expressed
+    over the SAME cam-slot edge stream; None (the default) keeps the
+    pytree — and every lowered program — byte-identical to the
+    pre-fused layout, so attaching them only under
+    `SolverOption(fused_kernels=True)` is the dark-landing guarantee.
     """
 
     cam: DevicePlan
     pt: DevicePlan
     use_kernels: bool
+    fused_to_pt: Optional["fused_ops.DeviceFusedPlan"] = None
+    fused_to_cam: Optional["fused_ops.DeviceFusedPlan"] = None
 
     # -- conversions between the two slot orders (per-edge rows) --
     def to_pt(self, rows_cam: jax.Array) -> jax.Array:
@@ -774,7 +785,9 @@ class DualPlans:
 
 
 jax.tree_util.register_dataclass(
-    DualPlans, data_fields=["cam", "pt"], meta_fields=["use_kernels"])
+    DualPlans,
+    data_fields=["cam", "pt", "fused_to_pt", "fused_to_cam"],
+    meta_fields=["use_kernels"])
 
 
 def make_dual_plans(

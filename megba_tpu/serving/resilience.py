@@ -122,7 +122,7 @@ class EscalationPolicy:
     |---|---|---|
     | 0 | as submitted | — |
     | 1 | `RobustOption(guards=True)` + initial trust region divided by `damping_deflation` | one compile per bucket (option changed), damping is an operand |
-    | 2 | conservative solver: `precond=JACOBI`, `preconditioner=HPP`, no forcing / warm-start / mixed precision, 2x PCG budget | one compile per bucket |
+    | 2 | conservative solver: `precond=JACOBI`, `preconditioner=HPP`, no forcing / warm-start / mixed precision, fused kernels off, 2x PCG budget | one compile per bucket |
     | 3 | f64 re-solve (dtype=float64) | new shape class (dtype is part of it) — its own bucket program |
     """
 
@@ -174,7 +174,8 @@ class EscalationPolicy:
             # Conservative rung: every precision shortcut off — the
             # mixed rung AND the bf16 MXU pipeline (its collective
             # compression rides along; bf16_collectives without bf16 is
-            # refused by validate_options).
+            # refused by validate_options) — and the fused edge-pipeline
+            # kernels (back to the battle-tested XLA/segtiles lowering).
             option = dataclasses.replace(
                 option, mixed_precision_pcg=False,
                 solver_option=dataclasses.replace(
@@ -183,6 +184,7 @@ class EscalationPolicy:
                     preconditioner=PreconditionerKind.HPP,
                     forcing=False, warm_start=False,
                     bf16=False, bf16_collectives=False,
+                    fused_kernels=False,
                     max_iter=2 * option.solver_option.max_iter))
         if rung >= 3:
             option = dataclasses.replace(option, dtype=np.float64)

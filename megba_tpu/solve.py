@@ -320,6 +320,7 @@ def flat_solve(
     ws = option.world_size
     mesh2d = bool(ws > 1 and option.use_schur
                   and option.solver_option.mesh_2d)
+    fused = bool(option.use_schur and option.solver_option.fused_kernels)
     if mesh2d:
         if use_tiled:
             raise ValueError(
@@ -327,18 +328,40 @@ def flat_solve(
                 "(use_tiled=True); the 2-D lowering has its own "
                 "camera-tile plan — pass use_tiled=False/None")
         use_tiled = False
-    if option.use_schur and option.solver_option.bf16:
+    if fused and ws > 1 and not mesh2d:
+        raise ValueError(
+            "SolverOption.fused_kernels is implemented for the "
+            "single-device tiled lowering and the 2-D mesh ring step; "
+            "the 1-D multi-device lowerings keep the segtiles/XLA "
+            "paths — pass fused_kernels=False, or mesh_2d=True for a "
+            "fused distributed solve")
+    if option.use_schur and option.solver_option.bf16 and not fused:
         # The bf16 MXU pipeline rides the XLA lowering: the tiled
         # coupling kernels (ops/segtiles) have no bf16 operand path, so
         # the default-tiled TPU lane silently measuring f32 kernels
         # would defeat the rung.  Explicit use_tiled=True is refused;
-        # the default resolves to the chunked build.
+        # the default resolves to the chunked build.  The FUSED
+        # edge-pipeline kernels (SolverOption.fused_kernels) DO carry
+        # bf16 operand tiles, so the refusal is lifted when they are
+        # on (the fused-path tiled+bf16 combination is the legal one).
         if use_tiled:
             raise ValueError(
                 "SolverOption.bf16 does not compose with the tiled "
                 "plans (use_tiled=True); the bf16 coupling products "
-                "ride the XLA lowering — pass use_tiled=False/None")
+                "ride the XLA lowering — pass use_tiled=False/None, "
+                "or enable SolverOption(fused_kernels=True), whose "
+                "fused edge-pipeline kernels take bf16 operand tiles")
         use_tiled = False
+    if fused and not mesh2d:
+        # The fused kernels replace the tiled coupling pipeline; the
+        # non-tiled XLA lowering has no edge plan for them to fuse.
+        if use_tiled is not None and not use_tiled:
+            raise ValueError(
+                "SolverOption.fused_kernels needs the tiled edge plans "
+                "(they carry the fused bucket ordering); pass "
+                "use_tiled=True/None, or fused_kernels=False for the "
+                "plain XLA lowering")
+        use_tiled = True
     if use_tiled is None:
         use_tiled = default_use_tiled(dtype)
 
@@ -366,6 +389,7 @@ def flat_solve(
 
     plans = None
     tile_plan_j = None
+    tiles_info = None  # per-solve tile/reuse metrics (SolveReport.tiles)
     if mesh2d:
         # 2-D camera x edge lowering: the cached camera-tile plan
         # assigns every edge to its camera tile's column, orders each
@@ -408,6 +432,13 @@ def flat_solve(
 
                 fault_edge = lower_edge_vector(fault_edge, perm, pmask)
             n_padded = obs.shape[0]
+            tiles_info = {
+                "plan": "mesh2d",
+                "cam_blocks": tplan.cam_blocks,
+                "tile_cams": tplan.tile_cams,
+                "shard_points": tplan.shard_points,
+                **{k: tplan.reuse[k] for k in sorted(tplan.reuse)},
+            }
     elif use_tiled and ws > 1:
         # Sharded tiled lowering: contiguous per-shard edge chunks, each
         # with its own dual plans; the concatenated per-shard slot
@@ -489,6 +520,40 @@ def flat_solve(
 
                 fault_edge = lower_edge_vector(fault_edge, perm, pmask)
             n_padded = obs.shape[0]
+            if fused:
+                # Fused edge-pipeline bucket plans, one per matvec
+                # direction, built over the SAME cam-slot stream the
+                # dual plans just produced (pmask marks its padding;
+                # any soft-delete weights live in the coupling rows,
+                # not the plan).  Host numpy, attached as optional
+                # pytree fields — with fused_kernels off these stay
+                # None and every program lowers byte-identically.
+                import dataclasses as _dc
+
+                from megba_tpu.ops.fused import build_fused_dual_plans
+
+                fp_tp, fp_tc, dfp_tp, dfp_tc = build_fused_dual_plans(
+                    cam_idx, pt_idx, pmask,
+                    cameras.shape[0], points.shape[0])
+                plans = _dc.replace(
+                    plans, fused_to_pt=dfp_tp, fused_to_cam=dfp_tc)
+            # Streaming-reuse + occupancy metrics of the planned stream
+            # (SolveReport.tiles): the honest per-solve attribution of
+            # what the tile ordering — and the fused kernels, when on —
+            # actually have to work with.
+            from megba_tpu.ops.fused import fused_plan_summary
+            from megba_tpu.ops.segtiles import edge_stream_reuse
+
+            tiles_info = {
+                "plan": "tiled_1d",
+                "occupancy": round(
+                    plan_c.n_edges / max(1, plan_c.n_slots), 4),
+                **edge_stream_reuse(cam_idx, pt_idx, plan_c.block,
+                                    plans.pt.block, mask=pmask),
+            }
+            if fused:
+                tiles_info["fused_to_pt"] = fused_plan_summary(fp_tp)
+                tiles_info["fused_to_cam"] = fused_plan_summary(fp_tc)
     else:
         with timer.phase("sort"):
             if not is_cam_sorted(cam_idx):
@@ -633,7 +698,7 @@ def flat_solve(
         result = _result_to_edge_major(result)
         _maybe_emit_report(telemetry, report_option, result, timer,
                            problem_shape, elastic=elastic_report,
-                           health=health)
+                           health=health, tiles=tiles_info)
         return result
 
     optional = [("sqrt_info", sqrt_info_j), ("cam_fixed", cam_fixed_j),
@@ -665,12 +730,12 @@ def flat_solve(
     result = _result_to_edge_major(result)
     _maybe_emit_report(telemetry, report_option, result, timer,
                        problem_shape, elastic=elastic_report,
-                       health=health)
+                       health=health, tiles=tiles_info)
     return result
 
 
 def _maybe_emit_report(telemetry, option, result, timer, problem,
-                       elastic=None, health=None) -> None:
+                       elastic=None, health=None, tiles=None) -> None:
     """Append a SolveReport JSONL line when telemetry is on, and feed
     the per-solve metrics observables when the metrics plane is armed;
     no-op (no sink import, no device sync) when both are off."""
@@ -740,7 +805,8 @@ def _maybe_emit_report(telemetry, option, result, timer, problem,
 
     append_report(
         build_report(option, result, timer.as_dict(), problem,
-                     elastic=elastic, health=health), telemetry)
+                     elastic=elastic, health=health, tiles=tiles),
+        telemetry)
 
 
 def _result_to_edge_major(result: LMResult) -> LMResult:

@@ -214,6 +214,7 @@ def make_coupling_matvecs(
     plans: Optional[DualPlans] = None,
     bf16_ops: bool = False,
     bf16_collectives: bool = False,
+    fused_kernels: bool = False,
 ) -> Tuple[Callable[[jax.Array], jax.Array], Callable[[jax.Array], jax.Array]]:
     """Build hpl(q_pt [pd,Np])->[cd,Nc] and hlp(p_cam [cd,Nc])->[pd,Np].
 
@@ -249,12 +250,18 @@ def make_coupling_matvecs(
     products.  Requires the XLA (plans=None) lowering.
     """
     up, vec, acc = _edge_precision(mixed_precision, bf16_ops)
-    if bf16_ops and plans is not None and compute_kind != ComputeKind.EXPLICIT:
+    use_fused = (fused_kernels and plans is not None
+                 and plans.fused_to_pt is not None
+                 and plans.fused_to_cam is not None)
+    if (bf16_ops and plans is not None and not use_fused
+            and compute_kind != ComputeKind.EXPLICIT):
         raise NotImplementedError(
             "SolverOption.bf16 does not compose with the tiled "
             "coupling kernels in IMPLICIT mode (ops/segtiles."
-            "coupling_expand has no bf16 operand path); lower with "
-            "use_tiled=False — flat_solve does this automatically")
+            "coupling_expand has no bf16 operand path); either lower "
+            "with use_tiled=False — flat_solve does this automatically "
+            "— or enable SolverOption(fused_kernels=True), whose fused "
+            "edge-pipeline kernels carry the bf16 operand tiles")
     from megba_tpu.parallel.mesh import collective_payload_cast
 
     wire_down, wire_up = collective_payload_cast(
@@ -264,6 +271,61 @@ def make_coupling_matvecs(
         if axis_name is None:
             return x
         return wire_up(jax.lax.psum(wire_down(x), axis_name))
+
+    if use_fused:
+        # Fused edge-pipeline dispatch (ops/fused.py): ONE kernel per
+        # direction — the Krylov-vector expansion, the coupling
+        # contraction, and the segment reduction happen on the same
+        # VMEM-resident edge tile.  The coupling rows are permuted into
+        # each direction's bucket order ONCE here (outside the matvec
+        # closures, so every CG iteration reuses the copies); padding
+        # columns are zeroed by the permute, so the kernels need no
+        # mask operand.  Off-TPU the same kernel bodies run under
+        # Pallas interpret mode — the CPU-lane parity certificate.
+        from megba_tpu.ops import fused as _fused
+
+        fp_tp = plans.fused_to_pt
+        fp_tc = plans.fused_to_cam
+        interp = not _fused.kernels_supported()
+
+        if compute_kind == ComputeKind.EXPLICIT:
+            W_tp = _fused.permute_rows(W, fp_tp)
+            W_tc = _fused.permute_rows(W, fp_tc)
+
+            def hlp(p_cam: jax.Array) -> jax.Array:
+                return psum(_fused.fused_coupling_apply(
+                    W_tp, p_cam, fp_tp, w_in_major=True,
+                    bf16_operands=bf16_ops, interpret=interp))
+
+            def hpl(q_pt: jax.Array) -> jax.Array:
+                return psum(_fused.fused_coupling_apply(
+                    W_tc, q_pt, fp_tc, w_in_major=False,
+                    bf16_operands=bf16_ops, interpret=interp))
+
+        else:
+            # The tiled lowering stores Jp in PT-slot order (the
+            # coupling_reduce convention); the fused plans index the
+            # CAM-slot stream, so bring Jp over first (one extra row
+            # permute per solve, amortised across CG iterations).  The
+            # dtype is pinned back: cam.mask is f32 and would silently
+            # promote bf16-stored rows.
+            Jp_cam = plans.to_cam(Jp).astype(Jp.dtype)
+            Jc_tp = _fused.permute_rows(Jc, fp_tp)
+            Jp_tp = _fused.permute_rows(Jp_cam, fp_tp)
+            Jc_tc = _fused.permute_rows(Jc, fp_tc)
+            Jp_tc = _fused.permute_rows(Jp_cam, fp_tc)
+
+            def hlp(p_cam: jax.Array) -> jax.Array:
+                return psum(_fused.fused_coupling_apply_implicit(
+                    Jc_tp, Jp_tp, p_cam, fp_tp,
+                    bf16_operands=bf16_ops, interpret=interp))
+
+            def hpl(q_pt: jax.Array) -> jax.Array:
+                return psum(_fused.fused_coupling_apply_implicit(
+                    Jp_tc, Jc_tc, q_pt, fp_tc,
+                    bf16_operands=bf16_ops, interpret=interp))
+
+        return hpl, hlp
 
     if plans is not None:
         uk = plans.use_kernels
@@ -373,6 +435,7 @@ def make_matvec_2d(
     mixed_precision: bool = False,
     bf16_ops: bool = False,
     bf16_collectives: bool = False,
+    fused_kernels: bool = False,
 ):
     """Build the fused 2-D Schur matvec S·p (camera x edge mesh).
 
@@ -433,6 +496,16 @@ def make_matvec_2d(
     all_gather — to bf16 on the wire, halving the already-subgroup-
     scoped `collective_bytes_per_sp` once more.  Both gates off lower
     byte-identically to the PR 14 pipeline.
+
+    `fused_kernels` swaps the RING-STEP contraction (step 4's
+    gather -> per-edge product -> camera segsum) for one fused Pallas
+    kernel call per step (ops/fused.fused_single_block_apply): the
+    rotating point shard is the kernel's single input block and the
+    camera tile its single output block, so the per-edge expanded rows
+    of each bucket stay VMEM-resident.  Steps 1-3 and 5 (the local
+    camera gather, the subgroup collectives, Hll⁻¹) are unchanged —
+    the stage-1 point reduction keeps its XLA segsum, honestly
+    documented as outside the fused surface.
     """
     edge_axis, cam_axis = axis_name
     C = tile_plan.cam_blocks
@@ -448,6 +521,10 @@ def make_matvec_2d(
     from megba_tpu.parallel.mesh import collective_payload_cast
 
     wire_down, wire_up = collective_payload_cast(bf16_collectives)
+    if fused_kernels:
+        from megba_tpu.ops import fused as _fused
+
+        fused_interp = not _fused.kernels_supported()
 
     # Replicated solve quantities, padded once to the tile geometry so
     # tile/shard slices are static-shape.  Zero padding is inert: padded
@@ -501,6 +578,31 @@ def make_matvec_2d(
                 tile_plan.bucket_ptl, s, 1, axis=0)[0]
             mk = jax.lax.dynamic_slice_in_dim(
                 tile_plan.bucket_mask, s, 1, axis=0)[0]
+            cl = jnp.take(tile_plan.cam_local, slot)
+            if fused_kernels:
+                # Fused ring step: the shard gather, the coupling
+                # product and the camera-tile reduction run in ONE
+                # kernel over this step's co-observation bucket.  The
+                # mask moves from the gathered vector onto the coupling
+                # rows (padding pairs get zero rows — same algebra, and
+                # the kernel then needs no mask operand).
+                mkd = mk.astype(W.dtype if W is not None else Jc.dtype)
+                if compute_kind == ComputeKind.EXPLICIT:
+                    Wg = jnp.take(W, slot, axis=1) * mkd
+                    step = _fused.fused_single_block_apply(
+                        Wg, cur, ptl, cl, out_block=Tc,
+                        w_in_major=False, bf16_operands=bf16_ops,
+                        interpret=fused_interp)
+                else:
+                    Jcg = jnp.take(Jc, slot, axis=1)
+                    Jpg = jnp.take(Jp, slot, axis=1) * mkd
+                    step = _fused.fused_single_block_apply(
+                        Jpg, cur, ptl, cl, out_block=Tc,
+                        rows_out=Jcg, bf16_operands=bf16_ops,
+                        interpret=fused_interp)
+                tile_acc = tile_acc + step.astype(p.dtype)
+                cur = nxt
+                continue
             cur_g = vec(gather_fm(cur, ptl))
             qe = cur_g * mk.astype(cur_g.dtype)  # [pd, Lb]
             if compute_kind == ComputeKind.EXPLICIT:
@@ -512,7 +614,6 @@ def make_matvec_2d(
                 Jpg = up(jnp.take(Jp, slot, axis=1))
                 contrib = _edge_pt_to_cam_fwd(
                     Jcg, Jpg, qe, cd, pd, od, _ident, pacc, vec)
-            cl = jnp.take(tile_plan.cam_local, slot)
             tile_acc = tile_acc + segsum_fm(contrib.astype(p.dtype), cl, Tc)
             cur = nxt
         # (5) camera reduction: EDGE-subgroup psum of the tile, one
@@ -937,6 +1038,9 @@ def plain_pcg_solve(
     tile_plan=None,
     bf16: bool = False,
     bf16_collectives: bool = False,
+    fused_kernels: bool = False,  # accepted for call-site symmetry;
+    # validate_options refuses fused_kernels without use_schur, so the
+    # full-system path never sees it True.
 ) -> PCGResult:
     """Solve the damped FULL system H dx = g without Schur reduction.
 
@@ -1033,6 +1137,7 @@ def schur_pcg_solve(
     tile_plan=None,
     bf16: bool = False,
     bf16_collectives: bool = False,
+    fused_kernels: bool = False,
 ) -> PCGResult:
     """Solve the damped Schur system for (dx_cam, dx_pt), feature-major.
 
@@ -1077,7 +1182,8 @@ def schur_pcg_solve(
     note_trace("solver.schur_pcg", system.g_cam, system.g_pt, Jc, Jp,
                static=static_key(compute_kind, axis_name, mixed_precision,
                                  preconditioner, precond, neumann_order,
-                                 smooth_omega, bf16, bf16_collectives))
+                                 smooth_omega, bf16, bf16_collectives,
+                                 fused_kernels))
     num_cameras = system.Hpp.shape[0]
     num_points = system.Hll.shape[1]
     pd = int(round(system.Hll.shape[0] ** 0.5))
@@ -1142,6 +1248,7 @@ def schur_pcg_solve(
         W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
         compute_kind, axis_name, mixed_precision=mixed_precision,
         cam_sorted=cam_sorted, plans=plans, bf16_ops=bf16,
+        fused_kernels=fused_kernels,
     )
 
     if tile_plan is not None:
@@ -1157,7 +1264,8 @@ def schur_pcg_solve(
             W, Jc, Jp, tile_plan, pt_idx, Hpp_d, Hll_inv,
             num_cameras, num_points, compute_kind, axis_name,
             mixed_precision=mixed_precision, bf16_ops=bf16,
-            bf16_collectives=bf16_collectives)
+            bf16_collectives=bf16_collectives,
+            fused_kernels=fused_kernels)
     else:
         if bf16_collectives and axis_name is not None:
             # Compressed coupling pair for the S·p matvec ONLY: the
@@ -1170,7 +1278,7 @@ def schur_pcg_solve(
                 W, Jc, Jp, cam_idx, pt_idx, num_cameras, num_points,
                 compute_kind, axis_name, mixed_precision=mixed_precision,
                 cam_sorted=cam_sorted, plans=plans, bf16_ops=bf16,
-                bf16_collectives=True,
+                bf16_collectives=True, fused_kernels=fused_kernels,
             )
         else:
             hpl_c, hlp_c = hpl, hlp
@@ -1195,7 +1303,8 @@ def schur_pcg_solve(
         cam_idx, pt_idx, num_cameras, compute_kind, axis_name,
         cam_sorted, neumann_order=neumann_order, plans=plans,
         cluster_plan=cluster_plan, cam_fixed=cam_fixed,
-        s_matvec=s_matvec, smooth_omega=smooth_omega, bf16=bf16)
+        s_matvec=s_matvec, smooth_omega=smooth_omega, bf16=bf16,
+        fused_kernels=fused_kernels)
 
     # Reduced RHS v = g_cam - Hpl Hll^-1 g_pt    [1 psum]
     v = g_cam - hpl(block_matvec_fm(Hll_inv, g_pt))

@@ -892,6 +892,7 @@ def make_schur_preconditioner(
     s_matvec: Optional[Callable[[jax.Array], jax.Array]] = None,
     smooth_omega: float = 0.0,
     bf16: bool = False,
+    fused_kernels: bool = False,
 ) -> Tuple[Callable[[jax.Array], jax.Array], jax.Array]:
     """Build the reduced-system preconditioner apply for one solve.
 
@@ -916,6 +917,15 @@ def make_schur_preconditioner(
     every coarse solve) is still COMPUTED in f32; only the apply's
     stored operand narrows — the allowed-surface contract the HLO
     auditor pins.
+
+    `fused_kernels` (SolverOption.fused_kernels) replaces the base
+    apply's einsum with the fused block-diagonal Pallas kernel
+    (ops/fused.fused_block_diag_apply): M⁻¹ is laid out ONCE as
+    feature-major [cd², Nc] rows and the apply runs as one kernel pass
+    over camera blocks — same bf16-operand / f32-accumulation contract
+    as `cam_block_matvec_bf16` when `bf16` is also set.  Every family
+    smooths with the fused base apply; coarse builds/solves are
+    untouched.
     """
     if block_kind == PreconditionerKind.SCHUR_DIAG:
         Minv, n_bad = _schur_diag_precond(
@@ -925,7 +935,17 @@ def make_schur_preconditioner(
         Minv = block_inv(Hpp_d)  # reference block-Jacobi (Hpp)
         n_bad = jnp.int32(0)
 
-    if bf16:
+    if fused_kernels:
+        from megba_tpu.ops import fused as _fused
+
+        Hrows = _fused.block_diag_rows(
+            Minv.astype(jnp.bfloat16) if bf16 else Minv)
+        _interp = not _fused.kernels_supported()
+
+        def base_apply(r):
+            return _fused.fused_block_diag_apply(
+                Hrows, r, bf16_operands=bf16, interpret=_interp)
+    elif bf16:
         Minv_bf16 = Minv.astype(jnp.bfloat16)
 
         def base_apply(r):

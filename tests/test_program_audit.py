@@ -99,6 +99,46 @@ def test_clean_tree_matches_committed_budget(audits):
     assert budget_mod.compare(baseline, measured) == []
 
 
+def test_fused_off_canonical_programs_kernel_free(audits):
+    """Dark-landing pin (fused edge-pipeline kernels): with
+    `SolverOption.fused_kernels` at its default (off), no canonical
+    program may carry a Pallas kernel — a Pallas call lowers to a
+    `tpu_custom_call`/mosaic custom_call, so the census catching one
+    here means the fused path leaked into a default-option lowering."""
+    for name, audit in audits.items():
+        census = hlo.custom_call_census(audit.stablehlo_ops)
+        kernels = [t for t in census
+                   if "tpu_custom_call" in t or "mosaic" in t.lower()
+                   or "pallas" in t.lower()]
+        assert kernels == [], (
+            f"{name}: Pallas custom_call in a fused-off canonical "
+            f"program: {kernels}")
+
+
+def test_fused_off_lowering_byte_identical(audits):
+    """Explicitly passing `fused_kernels=False` must produce the SAME
+    program, byte for byte, as leaving the field at its default — the
+    committed ANALYSIS_BUDGET.json entries describe both spellings.
+    (The fused machinery lands dark: DualPlans' optional fused fields
+    stay None and never reach the traced program.)"""
+    import dataclasses as _dc
+
+    from megba_tpu.common import JacobianMode
+    from megba_tpu.ops.residuals import make_residual_jacobian_fn
+    from megba_tpu.solve import flat_solve
+
+    s = program_audit._ba_problem()
+    option = program_audit._ba_option()
+    assert option.solver_option.fused_kernels is False  # the default
+    explicit = _dc.replace(option, solver_option=_dc.replace(
+        option.solver_option, fused_kernels=False))
+    f = make_residual_jacobian_fn(mode=JacobianMode.AUTODIFF)
+    lowered = flat_solve(f, s.cameras0, s.points0, s.obs, s.cam_idx,
+                         s.pt_idx, explicit, use_tiled=True,
+                         lower_only=True)
+    assert lowered.as_text() == audits["ba_tiled_f32"].stablehlo
+
+
 @pytest.mark.slow
 def test_factor_programs_clean_and_on_budget():
     """The factor-registry canonical programs (ISSUE 13): every audit
@@ -598,10 +638,13 @@ def test_budget_gate_degrades_loudly_when_metric_unavailable(audits):
         flops=-1.0, bytes_accessed=-1.0, peak_temp_bytes=-1.0,
         argument_bytes=-1.0, output_bytes=-1.0)
     # The census-derived metrics (counts + bytes-moved) come from the
-    # HLO text, not the cost analysis, so they survive the cripple.
+    # HLO text, and the declared per-S·p axes from the spec itself —
+    # neither needs the cost analysis, so both survive the cripple.
     assert set(crippled.metrics()) == {"all_reduce_count",
                                       "other_collective_count",
-                                      "collective_bytes_per_sp"}
+                                      "collective_bytes_per_sp",
+                                      "flops_per_sp",
+                                      "bytes_touched_per_sp"}
 
 
 def test_audit_cli_check_exits_nonzero_on_broken_budget(
@@ -635,6 +678,53 @@ def test_audit_cli_check_exits_nonzero_on_broken_budget(
 # ---------------------------------------------------------------------------
 # Parser units (pure text, no jax)
 # ---------------------------------------------------------------------------
+
+def test_custom_call_census_counts_targets():
+    text = """\
+module @jit_fn {
+  func.func public @main(%arg0: tensor<4xf32>) -> tensor<4xf32> {
+    %0 = stablehlo.custom_call @tpu_custom_call(%arg0) : (tensor<4xf32>) -> tensor<4xf32>
+    %1 = stablehlo.custom_call @tpu_custom_call(%0) : (tensor<4xf32>) -> tensor<4xf32>
+    %2 = stablehlo.custom_call @Sharding(%1) : (tensor<4xf32>) -> tensor<4xf32>
+    %3 = stablehlo.add %2, %2 : tensor<4xf32>
+    return %3 : tensor<4xf32>
+  }
+}
+"""
+    census = hlo.custom_call_census(hlo.parse_stablehlo_ops(text))
+    assert census == {"tpu_custom_call": 2, "Sharding": 1}
+
+
+def test_custom_call_census_in_summary(audits):
+    doc = json.loads(json.dumps(audits["ba_single_f32"].summary()))
+    assert "custom_calls" in doc
+    assert all(isinstance(v, int) for v in doc["custom_calls"].values())
+
+
+def test_sp_budget_axes_priced_and_gated():
+    """The declared analytical axes: present for every canonical
+    program, exact-gated (tolerance 0.0), and the fused pricing arm
+    strictly undercuts the unfused one on identical geometry (the
+    transient round-trips are the only difference)."""
+    from megba_tpu.analysis import edge_budget
+
+    for name, spec in program_audit.program_specs().items():
+        d = dict(spec.sp_budget or ())
+        assert d.get("flops_per_sp", 0) > 0, name
+        assert d.get("bytes_touched_per_sp", 0) > 0, name
+    assert budget_mod.TOLERANCES["flops_per_sp"] == 0.0
+    assert budget_mod.TOLERANCES["bytes_touched_per_sp"] == 0.0
+    unfused = edge_budget.schur_sp_budget(4, 9, 24, 3, 2, 2048)
+    fused = edge_budget.schur_sp_budget(4, 9, 24, 3, 2, 2048,
+                                        transient_roundtrips=False)
+    assert fused["flops_per_sp"] == unfused["flops_per_sp"]
+    assert fused["bytes_touched_per_sp"] < unfused["bytes_touched_per_sp"]
+    # bf16 operand tiles halve the coupling-row traffic, never the flops.
+    bf16 = edge_budget.schur_sp_budget(4, 9, 24, 3, 2, 2048,
+                                       operand="bf16")
+    assert bf16["flops_per_sp"] == unfused["flops_per_sp"]
+    assert bf16["bytes_touched_per_sp"] < unfused["bytes_touched_per_sp"]
+
 
 def test_stablehlo_while_depth_tracking():
     text = """\
