@@ -11,7 +11,7 @@ Rule ids are kebab-case; suppress one finding with an inline
 | scalar-promotion | no strongly-typed scalar constructors (`np.float64(x)`, `jnp.int32(k)`, ...) as operands of array arithmetic in jit-reachable code — unlike weak Python scalars they promote the whole expression's dtype |
 | donated-reuse | an argument passed at a `donate_argnums` position of a locally-built `jax.jit` program must not be read after the call — the buffer is deleted by the call |
 | weak-literal | no BARE float literal as a `jnp.where` branch or `jnp.clip` bound in jit-reachable code — probed on this jaxlib: under x64 those positions materialise a `tensor<f64>` constant (plus a convert) in f32 programs, the dtype-census leak hand-fixed in PRs 3 and 6 (`jnp.where(safe, θ², 1.0)`, `jnp.where(..., 0.0, ...)`); use `zeros_like`/`ones_like`/`jnp.asarray(c, x.dtype)`.  Plain arithmetic (`2.0 * x`) and `jnp.maximum/minimum` literals promote weakly and are clean — the rule matches only the probed leaky positions |
-| raw-clock | no raw `time.time()` / `time.perf_counter()` outside the sanctioned clock homes (`utils/timing.py`, `observability/`) — scattered raw reads fragment the timing story the observability plane narrates (PhaseTimer phases, span timestamps, report `created_unix` all flow from ONE seam); use `utils.timing.monotonic_s()` for durations and `utils.timing.wall_unix()` for epoch stamps.  `time.monotonic()` deadline arithmetic and `time.sleep` are clean — the rule bans the two reads that LOOK interchangeable but are not |
+| raw-clock | no raw `time.time()` / `time.perf_counter()` outside the sanctioned clock homes (`utils/timing.py`, `observability/`) — scattered raw reads fragment the timing story the observability plane narrates (PhaseTimer phases, span timestamps, report `created_unix` all flow from ONE seam); use `utils.timing.monotonic_s()` for durations and `utils.timing.wall_unix()` for epoch stamps.  `time.monotonic()` deadline arithmetic and `time.sleep` are clean — the rule bans the two reads that LOOK interchangeable but are not.  STRICT lane (`serving/transport.py`, `robustness/netfaults.py`): `time.monotonic()` is banned there too — transport deadlines ride `monotonic_s()` exclusively, and a second monotonic epoch would be compared against it |
 | guarded-by | shared mutable attributes of lock-owning classes, declared with `# megba: guarded-by(<lockattr>)` on the assignment (or inferred at >= 80% locked accesses in thread-reachable classes), must not be read/written outside a `with <lock>` block — the host serving tier's race detector (analysis/concurrency.py); `# megba: allow-unguarded` is the per-line escape hatch |
 | lock-order | the package-wide acquires-while-holding digraph (nested `with` blocks, cross-method/cross-class edges through the callgraph, `Condition.wait` re-acquires) must be acyclic — a cycle is a deadlock waiting for the right interleaving; the finding prints the witness path |
 | stale-program | every option field READ on the lowering closure (flat_solve / distributed_lm_solve / batched_solve_program / lower_bucket / solve_pgo and everything they reach) must be visible to the program's static key — a strip-listed or key-exempt-declared field read under tracing is a wrong-program hazard, and a builder whose `static_key(...)` omits its option parameter hides every field (analysis/identity.py); consume-and-strip in the same function is the sanctioned shape |
@@ -81,6 +81,14 @@ ALL_RULES = (
 # time.sleep etc. stay legal — only the two reads that masquerade as
 # each other are fenced into the clock homes).
 _RAW_CLOCK_TARGETS = {"time.time", "time.perf_counter"}
+
+# Modules on the STRICT clock lane: deadline arithmetic here rides
+# `utils.timing.monotonic_s` exclusively, so even `time.monotonic()` is
+# banned — a second monotonic epoch in the transport/chaos layer would
+# let a deadline computed on one clock be compared against the other
+# (they share no epoch, only a rate).
+_STRICT_CLOCK_MODULES = ("serving.transport", "robustness.netfaults")
+_STRICT_CLOCK_TARGETS = _RAW_CLOCK_TARGETS | {"time.monotonic"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -303,20 +311,33 @@ def rule_raw_clock(index: PackageIndex) -> Iterator[Finding]:
     for mod in index.modules.values():
         if _is_clock_home(mod):
             continue
+        strict = mod.name.endswith(_STRICT_CLOCK_MODULES)
+        targets = _STRICT_CLOCK_TARGETS if strict else _RAW_CLOCK_TARGETS
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
             dotted = _dotted(node.func)
             full = _alias_target(mod, dotted)
-            if full in _RAW_CLOCK_TARGETS:
+            if full in targets:
                 helper = ("wall_unix()" if full == "time.time"
                           else "monotonic_s()")
-                yield Finding(
-                    mod.path, node.lineno, node.col_offset, "raw-clock",
-                    f"raw `{dotted}()` outside the clock homes "
-                    "(utils/timing.py, observability/): use "
-                    f"megba_tpu.utils.timing.{helper} so durations and "
-                    "epoch stamps flow from one seam")
+                if strict and full == "time.monotonic":
+                    yield Finding(
+                        mod.path, node.lineno, node.col_offset,
+                        "raw-clock",
+                        f"raw `{dotted}()` in a strict-clock module "
+                        "(transport/netfaults deadline arithmetic): use "
+                        "megba_tpu.utils.timing.monotonic_s() — a "
+                        "second monotonic epoch here would be compared "
+                        "against monotonic_s() deadlines")
+                else:
+                    yield Finding(
+                        mod.path, node.lineno, node.col_offset,
+                        "raw-clock",
+                        f"raw `{dotted}()` outside the clock homes "
+                        "(utils/timing.py, observability/): use "
+                        f"megba_tpu.utils.timing.{helper} so durations "
+                        "and epoch stamps flow from one seam")
 
 
 def rule_donated_reuse(index: PackageIndex) -> Iterator[Finding]:

@@ -35,6 +35,13 @@ Three pieces, spanning the solver stack:
   guards above would contain at runtime, triage catches in host
   milliseconds.
 
+- **Network fault injection** (`robustness.netfaults`): a
+  deterministic in-process TCP proxy (`ChaosTcpProxy`) between a
+  `FleetRouter` and its workers, injecting drop / delay / truncate /
+  reorder / partition by seeded `NetFaultPlan` — every typed failure
+  the federation transport promises (serving/transport.py) is
+  exercised by a replayable fault sequence, not a flaky network.
+
 - **Elastic distribution** (`robustness.elastic`): liveness detection
   (per-rank heartbeat files + injected-clock state machines), a
   collective watchdog bounding every chunk dispatch, typed
@@ -71,6 +78,10 @@ from megba_tpu.robustness.elastic import (  # noqa: F401
     RankState,
     WorkerLost,
     resume_elastic,
+)
+from megba_tpu.robustness.netfaults import (  # noqa: F401
+    ChaosTcpProxy,
+    NetFaultPlan,
 )
 from megba_tpu.robustness.harness import (  # noqa: F401
     WorldKillOutcome,

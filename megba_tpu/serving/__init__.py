@@ -32,6 +32,15 @@ problems do it:
   shape class (occupancy-aware), idle workers stealing hot buckets
   they have warm, dead workers detected (PR 9 heartbeats + pipe EOF)
   and their problems rerouted to survivors, typed and counted.
+- transport.py — the frame wire under the RPC: magic + length +
+  blake2b-digest framed pickles over pipes (`PipeTransport`) or
+  sockets (`TcpTransport`), typed `FrameError`s for every corruption
+  mode, the register/ack token handshake, `ReconnectPolicy` backoff,
+  and the worker-side `DedupCache` that makes resends idempotent.
+- worker.py — the worker half of the federation RPC: `WorkerRuntime`
+  (transport-free solver state + request handling) plus the
+  `python -m megba_tpu.serving.worker` TCP bootstrap CLI
+  (dial/listen, re-registration after connection loss).
 """
 
 from megba_tpu.serving.artifacts import ArtifactKey, ArtifactStore
@@ -42,6 +51,7 @@ from megba_tpu.serving.compile_pool import (
     lower_bucket,
 )
 from megba_tpu.serving.federation import (
+    ColdDispatchWarning,
     FederationStats,
     FleetRouter,
     RoutingTable,
@@ -66,6 +76,19 @@ from megba_tpu.serving.shape_class import (
     pad_to_class,
 )
 from megba_tpu.serving.stats import FleetStats
+from megba_tpu.serving.transport import (
+    DedupCache,
+    FrameDigestError,
+    FrameError,
+    FrameLengthError,
+    FrameMagicError,
+    FrameTruncatedError,
+    HandshakeError,
+    PipeTransport,
+    ReconnectPolicy,
+    TcpTransport,
+)
+from megba_tpu.serving.worker import WorkerRuntime
 
 __all__ = [
     "ArtifactKey",
@@ -75,8 +98,10 @@ __all__ = [
     "BucketLadder",
     "BucketTripped",
     "CircuitBreaker",
+    "ColdDispatchWarning",
     "CompilePool",
     "DeadlineExceeded",
+    "DedupCache",
     "EscalationPolicy",
     "FederationStats",
     "FleetProblem",
@@ -84,13 +109,23 @@ __all__ = [
     "FleetResult",
     "FleetRouter",
     "FleetStats",
+    "FrameDigestError",
+    "FrameError",
+    "FrameLengthError",
+    "FrameMagicError",
+    "FrameTruncatedError",
+    "HandshakeError",
     "ManifestMismatch",
     "PaddedProblem",
+    "PipeTransport",
     "QueueRejected",
+    "ReconnectPolicy",
     "RejectPolicy",
     "RoutingTable",
     "ShapeClass",
+    "TcpTransport",
     "WorkerLostError",
+    "WorkerRuntime",
     "classify",
     "lower_bucket",
     "pad_to_class",

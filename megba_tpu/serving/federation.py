@@ -47,6 +47,19 @@ collectives, no device code; the workers' solve programs are byte-wise
 the single-host ones, so a federated fleet's results are BITWISE the
 `solve_many` results at the same shape classes (padding exactness,
 PR 6) no matter how routing, stealing or rerouting scattered them.
+
+Transports (PR 20, serving/transport.py): the frame stream runs over
+subprocess pipes (`transport="pipe"`, the single-host default) or TCP
+(`transport="tcp"`): the router binds a listening socket, workers dial
+in (or the router dials bind-mode workers via `connect=`) and
+register through a token/version/fingerprint handshake.  A dropped TCP
+connection is NOT a worker loss: the handle enters a
+capped-exponential-backoff reconnect window (`ReconnectPolicy`,
+deterministic seeded jitter) during which the worker's buckets detour
+to warm peers while its assignment survives; in-flight requests are
+resent idempotently by sequence id (the worker's reply cache dedups),
+and only window exhaustion or process death converts to the
+`WorkerLostError` reroute path.
 """
 
 from __future__ import annotations
@@ -54,28 +67,44 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
-import pickle
-import select
-import struct
+import socket
 import subprocess
 import sys
 import tempfile
 import threading
 import time
 import uuid
+import warnings
+import zlib
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from megba_tpu import observability as _obs
 from megba_tpu.serving.resilience import DeadlineExceeded
+from megba_tpu.serving.transport import (
+    FrameError,
+    HandshakeError,
+    PipeTransport,
+    ReconnectPolicy,
+    TcpTransport,
+    is_heartbeat,
+    parse_address,
+    refusal_frame,
+    ack_frame,
+    verify_register,
+)
 from megba_tpu.utils.timing import monotonic_s, wall_unix
 
-_LEN = struct.Struct(">Q")
-_MAX_FRAME = 1 << 34  # 16 GiB: a corrupted length header fails fast
+# Back-compat alias: the pipe frame channel moved to transport.py and
+# grew the integrity-checked frame header; the name stays importable.
+FrameChannel = PipeTransport
 
 
-class FrameError(ConnectionError):
-    """The RPC stream ended or produced a malformed frame."""
+class ColdDispatchWarning(UserWarning):
+    """A dispatch targeted a worker with no ready program for its
+    (bucket, lanes, rung) key — a compile-on-dispatch latency cliff the
+    artifact manifest should have covered.  Warned ONCE per missing
+    key; every occurrence counts (`fed_cold_dispatch`)."""
 
 
 class WorkerLostError(RuntimeError):
@@ -94,69 +123,30 @@ class WorkerLostError(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# Length-prefixed pickle frames over pipes
+# Connection supervision primitives
 # ---------------------------------------------------------------------------
 
 
-class FrameChannel:
-    """One duplex frame stream over a (read fd, write file) pair.
+class _ConnSuspect(Exception):
+    """Internal: the connection looks dead (heartbeat silence) but the
+    worker may be fine behind it — enter the reconnect window rather
+    than the loss path."""
 
-    Frames are `>Q` length + pickle.  `recv` reads the UNDERLYING fd
-    directly (private buffer, never a BufferedReader) so the
-    select-based timeout/poll path can never stall on bytes hidden in a
-    Python-level buffer.  `poll` is called between read slices and may
-    raise to abort the wait (the router's liveness hook)."""
 
-    def __init__(self, rfile, wfile) -> None:
-        self._rfd = rfile.fileno()
-        self._rfile = rfile  # owned: kept for close()
-        self._wfile = wfile
-        self._buf = bytearray()
-        self._slice_s = 0.05
+class _NeverTransport:
+    """Placeholder transport for a TCP handle awaiting its first
+    registration: every operation reports 'not connected', which the
+    reconnect machinery treats like any other dropped link."""
 
     def send(self, obj: Any) -> None:
-        body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        self._wfile.write(_LEN.pack(len(body)) + body)
-        self._wfile.flush()
-
-    def _fill(self, need: int, deadline: Optional[float],
-              poll: Optional[Callable[[], None]]) -> None:
-        while len(self._buf) < need:
-            if poll is not None:
-                poll()
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("no complete frame within the budget")
-            ready, _, _ = select.select([self._rfd], [], [], self._slice_s)
-            if not ready:
-                continue
-            chunk = os.read(self._rfd, 1 << 20)
-            if not chunk:
-                raise FrameError("stream closed mid-frame"
-                                 if self._buf else "stream closed")
-            self._buf.extend(chunk)
+        raise BrokenPipeError("worker not yet connected")
 
     def recv(self, timeout_s: Optional[float] = None,
              poll: Optional[Callable[[], None]] = None) -> Any:
-        # ONE deadline spans header + body: a worker stalling between
-        # the two must not double the effective watchdog budget.
-        deadline = None if timeout_s is None else (
-            time.monotonic() + timeout_s)
-        self._fill(_LEN.size, deadline, poll)
-        (length,) = _LEN.unpack(bytes(self._buf[:_LEN.size]))
-        if length > _MAX_FRAME:
-            raise FrameError(f"frame length {length} exceeds sanity cap")
-        del self._buf[:_LEN.size]
-        self._fill(length, deadline, poll)
-        body = bytes(self._buf[:length])
-        del self._buf[:length]
-        return pickle.loads(body)
+        raise FrameError("worker not yet connected")
 
     def close(self) -> None:
-        for f in (self._rfile, self._wfile):
-            try:
-                f.close()
-            except OSError:
-                pass
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -166,11 +156,17 @@ class FrameChannel:
 
 @dataclasses.dataclass
 class WorkerView:
-    """What the routing policy may know about one worker."""
+    """What the routing policy may know about one worker.
+
+    `alive` is the terminal flag (process dead / reconnect budget
+    exhausted — buckets re-home); `connected` is the transient one (the
+    TCP link dropped but the worker may return inside its reconnect
+    window — buckets DETOUR to warm peers, the assignment survives)."""
 
     worker_id: str
     warm: set  # bucket strs with a ready (artifact/compiled) program
     alive: bool = True
+    connected: bool = True
     assigned: set = dataclasses.field(default_factory=set)  # bucket strs
     routed: int = 0  # problems ever routed here (load tiebreak)
 
@@ -202,8 +198,27 @@ class RoutingTable:
               workers: Dict[str, WorkerView]) -> Optional[str]:
         homed = self.assignment.get(bucket)
         if homed is not None and workers[homed].alive:
+            if workers[homed].connected:
+                return homed
+            # Home is inside its reconnect window: DETOUR this pick to
+            # a connected peer that already holds the program, without
+            # re-homing — the assignment survives the flap, but work
+            # keeps flowing (routable-away).  No warm peer: wait for
+            # the home to return rather than compile elsewhere.
+            detour = [w for w in workers.values()
+                      if w.alive and w.connected and bucket in w.warm]
+            if detour:
+                best = min(detour, key=lambda w: (len(w.assigned),
+                                                  w.routed, w.worker_id))
+                return best.worker_id
             return homed
-        alive = [w for w in workers.values() if w.alive]
+        alive = [w for w in workers.values()
+                 if w.alive and w.connected]
+        if not alive:
+            # Every survivor is mid-reconnect: fall back to any live
+            # worker so routing still lands somewhere (the dispatch
+            # will ride that handle's reconnect window).
+            alive = [w for w in workers.values() if w.alive]
         if not alive:
             return None
         warm = [w for w in alive if bucket in w.warm]
@@ -262,6 +277,8 @@ class FederationStats:
         self.stolen_problems = 0  # megba: guarded-by(_lock)
         self.reroutes = 0  # megba: guarded-by(_lock); requeued off a loss
         self.reroute_failures = 0  # megba: guarded-by(_lock); max_reroutes hit
+        self.escalations = 0  # megba: guarded-by(_lock); ladder consults past max_reroutes
+        self.cold_dispatches = 0  # megba: guarded-by(_lock); dispatches with no warm program on target
         self.workers_lost = 0  # megba: guarded-by(_lock)
         self.sheds = 0  # megba: guarded-by(_lock); shed before dispatch
         self.deadline_misses = 0  # megba: guarded-by(_lock); delivered late
@@ -285,6 +302,14 @@ class FederationStats:
     def record_reroute_failure(self, n: int = 1) -> None:
         with self._lock:
             self.reroute_failures += n
+
+    def record_escalation(self, n: int = 1) -> None:
+        with self._lock:
+            self.escalations += n
+
+    def record_cold_dispatch(self, n: int = 1) -> None:
+        with self._lock:
+            self.cold_dispatches += n
 
     def record_worker_lost(self, worker_id: str) -> None:
         with self._lock:
@@ -319,6 +344,8 @@ class FederationStats:
                 "stolen_problems": self.stolen_problems,
                 "reroutes": self.reroutes,
                 "reroute_failures": self.reroute_failures,
+                "escalations": self.escalations,
+                "cold_dispatches": self.cold_dispatches,
                 "workers_lost": self.workers_lost,
                 "lost_workers": list(self.lost_workers),
                 "sheds": self.sheds,
@@ -355,201 +382,12 @@ class FederationStats:
 
 
 def _worker_main() -> int:
-    """Run one federation worker: frames in on fd 0, frames out on the
-    ORIGINAL fd 1; fd 1 is then pointed at stderr so any stray print
-    from a library can never corrupt the frame stream."""
-    rpc_in = os.fdopen(os.dup(0), "rb", buffering=0)
-    rpc_out = os.fdopen(os.dup(1), "wb", buffering=0)
-    os.dup2(2, 1)
-    chan = FrameChannel(rpc_in, rpc_out)
+    """Pipe-worker entry (the `-c` spawn string imports this name);
+    the serve loop itself lives in serving/worker.py, shared with the
+    TCP bootstrap CLI."""
+    from megba_tpu.serving.worker import pipe_worker_main
 
-    cfg = chan.recv()
-    if cfg.get("op") != "config":
-        chan.send({"ok": False, "error": f"expected config, got {cfg!r}"})
-        return 2
-    worker_id = cfg["worker_id"]
-    # Tag this process's fleet telemetry with the worker id BEFORE any
-    # serving import reads it (batcher reads it per report).
-    os.environ["MEGBA_FEDERATION_WORKER"] = worker_id
-    # CPU pinning (router `pin_cpus=`): restrict this worker to its core
-    # slice BEFORE the first dispatch, so the lazily-built XLA:CPU
-    # thread pool's threads inherit the affinity — N workers then run
-    # true data-parallel instead of thrashing one shared pool.
-    affinity = cfg.get("cpu_affinity")
-    if affinity:
-        try:
-            os.sched_setaffinity(0, set(int(c) for c in affinity))
-        except (AttributeError, OSError):  # non-Linux / restricted
-            pass
-
-    from megba_tpu.analysis import retrace
-    from megba_tpu.ops.residuals import make_residual_jacobian_fn
-    from megba_tpu.serving.batcher import solve_many
-    from megba_tpu.serving.compile_pool import CompilePool
-    from megba_tpu.serving.stats import FleetStats
-    from megba_tpu.utils.timing import PhaseTimer
-
-    # `option` (observability-STRIPPED: telemetry AND metrics,
-    # common.OBSERVABILITY_FIELDS) feeds warmup and fingerprints — the
-    # program caches are observability-agnostic by contract; previously
-    # only `telemetry` was cleared here, so a metrics-armed fleet config
-    # warmed programs dispatch could never hit (the identity lane's
-    # key-surface-drift finding, fixed at the source).  `solve_option`
-    # carries this worker's sink AND the config's metrics flag into
-    # solve_many, which strips both again before touching any cache, so
-    # warm and dispatch agree on keys.
-    from megba_tpu.common import strip_observability
-
-    base_option = cfg["option"]
-    option = strip_observability(base_option)
-    ladder = cfg.get("ladder")
-    stats = FleetStats()
-    timer = PhaseTimer()
-    pool = CompilePool(stats=stats, artifacts=cfg.get("artifacts"),
-                       timer=timer)
-    engine = make_residual_jacobian_fn(mode=option.jacobian_mode)
-    telemetry = cfg.get("telemetry")
-    solve_option = dataclasses.replace(base_option,
-                                       telemetry=telemetry or None)
-
-    # Heartbeat: PR 9's liveness board, beaten from a daemon thread.
-    hb = cfg.get("heartbeat")
-    if hb:
-        from megba_tpu.robustness.elastic import HeartbeatBoard
-
-        board = HeartbeatBoard(hb["dir"], int(hb["rank"]),
-                               int(hb["world"]))
-        interval = float(hb.get("interval_s", 0.25))
-
-        def _beat() -> None:
-            while True:
-                board.beat()
-                time.sleep(interval)
-
-        threading.Thread(target=_beat, daemon=True,
-                         name="megba-fed-heartbeat").start()
-
-    # Cold start: warm the manifest's buckets (artifact-load when the
-    # store holds them, compile otherwise) and report the split.
-    t0 = monotonic_s()
-    warmed = 0
-    try:
-        if cfg.get("manifest"):
-            warmed = pool.warm_from_manifest(
-                cfg["manifest"], engine, option,
-                strict=bool(cfg.get("strict_manifest", False)))
-    except Exception as exc:
-        chan.send({"ok": False, "error": repr(exc),
-                   "worker_id": worker_id})
-        return 3
-    warm_s = monotonic_s() - t0
-    loads = stats.artifact_loads
-    # Store-less warms compile without touching the artifact counters
-    # (they describe a store that must exist) — the timer's phase count
-    # is the mode signal either way.
-    compiles = timer.counts.get("warm_compile", 0)
-    mode = ("artifact" if loads and not compiles
-            else "compile" if compiles else "cold")
-    warm_set = sorted({str(_shape_of(e)) for e in pool.entries()})
-    chan.send({
-        "ok": True, "op": "hello", "worker_id": worker_id,
-        "pid": os.getpid(), "warm": warm_set, "warmed": warmed,
-        "cold_start": {
-            "mode": mode, "warm_s": warm_s, "buckets": warmed,
-            "artifact_loads": loads, "artifact_compiles": compiles,
-            "phases": timer.as_dict(),
-        },
-    })
-
-    first_solve: Optional[Dict[str, Any]] = None
-    try:
-        while True:
-            try:
-                req = chan.recv()
-            except FrameError:
-                return 0  # router went away: no work without it
-            op = req.get("op")
-            if op == "shutdown":
-                chan.send({"ok": True})
-                return 0
-            if op == "stats":
-                chan.send({"ok": True, "stats": stats.as_dict(),
-                           "phases": timer.as_dict()})
-                continue
-            if op == "metrics":
-                # Observability harvesting seam: the router merges these
-                # per-worker registry snapshots (metrics_snapshot()).
-                registry = _obs.metrics_registry()
-                chan.send({"ok": True, "metrics": (
-                    None if registry is None else registry.snapshot())})
-                continue
-            if op != "solve":
-                chan.send({"ok": False, "error": f"unknown op {op!r}"})
-                continue
-            problems = req["problems"]
-            recorder = _obs.span_recorder()
-            try:
-                base = retrace.snapshot()
-                t0 = monotonic_s()
-                # The router's trace context rides the solve frame; the
-                # worker's whole solve joins it as a child span and the
-                # spans recorded under it ship back in the reply.
-                scope = (contextlib.nullcontext() if recorder is None
-                         else recorder.adopt(
-                             "worker_solve", req.get("trace"),
-                             worker=worker_id, problems=len(problems)))
-                with scope:
-                    results = solve_many(problems, solve_option,
-                                         ladder=ladder, pool=pool,
-                                         stats=stats, timer=timer)
-                wall = monotonic_s() - t0
-                if first_solve is None:
-                    traces = sum(
-                        v - base.get(k, 0)
-                        for k, v in retrace.snapshot().items()
-                        if k[0].startswith("serving.batched")
-                        and v > base.get(k, 0))
-                    first_solve = {"traces": int(traces), "wall_s": wall,
-                                   "problems": len(problems)}
-                # Traces are per-iteration device history — large, and
-                # the router's callers read costs/params/status;
-                # telemetry (the per-problem SolveReports written ABOVE,
-                # worker-side) already persisted them for whoever wants
-                # forensics.
-                slim = [dataclasses.replace(r, trace=None)
-                        for r in results]
-                chan.send({
-                    "ok": True, "results": slim,
-                    "warm": sorted({str(_shape_of(e))
-                                    for e in pool.entries()}),
-                    "first_solve": first_solve,
-                    "spans": (None if recorder is None
-                              else recorder.drain()),
-                })
-            except Exception as exc:  # solve failed: typed reply, serve on
-                import traceback
-
-                flight = _obs.flight_recorder()
-                if flight is not None:
-                    flight.record("solve_error", worker=worker_id,
-                                  problems=len(problems),
-                                  error=repr(exc))
-                chan.send({"ok": False, "error": repr(exc),
-                           "traceback": traceback.format_exc(),
-                           "spans": (None if recorder is None
-                                     else recorder.drain())})
-    except BaseException:
-        # Worker is crashing out of the serve loop (router still thinks
-        # it is alive): dump the flight ring before dying so the last
-        # ~256 events survive the process.  SIGKILL deaths cannot run
-        # this — the ROUTER's recorder covers those (_on_worker_lost).
-        flight = _obs.flight_recorder()
-        if flight is not None:
-            flight.record("worker_crash", worker=worker_id)
-            from megba_tpu.observability import flight as _flight
-
-            _flight.dump_default("worker_crash")
-        raise
+    return pipe_worker_main()
 
 
 def _shape_of(entry: Dict[str, Any]):
@@ -574,11 +412,15 @@ class WorkerHandle:
     whose turn it is owns the pipe with every lock released, so an
     out-of-band `metrics` pull never stalls a lock behind a whole solve
     RPC (the blocking-under-lock shape lint lane 6 polices).  Every
+    request carries a sequence id; the reader skims heartbeat frames
+    and drops stale duplicate replies, matching on its own seq.  Every
     death signal — pipe EOF, process exit, heartbeat DEAD — converts
-    into a typed `WorkerLostError`."""
+    into a typed `WorkerLostError`, and the FIRST observed death is
+    recorded so every later waiter fails FAST instead of re-spending a
+    full watchdog budget on a connection already known dead."""
 
-    def __init__(self, worker_id: str, proc: subprocess.Popen,
-                 chan: FrameChannel, log_path: str,
+    def __init__(self, worker_id: str, proc: Optional[subprocess.Popen],
+                 chan, log_path: str,
                  liveness: Optional[Callable[[], Optional[str]]] = None,
                  ) -> None:
         self.worker_id = worker_id
@@ -593,13 +435,19 @@ class WorkerHandle:
         # metrics_snapshot().
         self.warm: set = set()
         self.alive = True
-        self.pid = proc.pid
+        self.pid = proc.pid if proc is not None else None
         self.rank = 0  # heartbeat-board rank, set by the router at spawn
+        # First observed death reason: write-once latch (benign racing
+        # writers would record equivalent reasons); readers fail fast
+        # without waiting on a channel that can never answer.
+        self._death: Optional[str] = None
+        self.last_rx = monotonic_s()  # any frame (incl. heartbeats)
         # Serializes SENDS (the channel is strictly lockstep, so two
         # concurrent writers would interleave frames) and hands out
         # reply tickets; never held across a read.
         self._req_lock = threading.Lock()
         self._next_send = 0  # megba: guarded-by(_req_lock)
+        self._seq = 0  # megba: guarded-by(_req_lock); request sequence ids
         # Orders reply reads: replies arrive in send order (the worker
         # serve loop is single-threaded FIFO), so ticket n reads the
         # n-th reply — exclusivity without holding anything during the
@@ -607,11 +455,22 @@ class WorkerHandle:
         self._turn = threading.Condition()
         self._next_recv = 0  # megba: guarded-by(_turn)
 
-    def _poll(self) -> None:
-        rc = self.proc.poll()
-        if rc is not None:
+    def _record_death(self, reason: str) -> None:
+        if self._death is None:
+            self._death = reason
+
+    def _check_death(self) -> None:
+        death = self._death
+        if death is not None:
             raise WorkerLostError(self.worker_id,
-                                  f"process exited rc={rc}")
+                                  f"{death} (fail-fast: recorded death)")
+
+    def _poll(self) -> None:
+        if self.proc is not None:
+            rc = self.proc.poll()
+            if rc is not None:
+                raise WorkerLostError(self.worker_id,
+                                      f"process exited rc={rc}")
         if self.liveness is not None:
             reason = self.liveness()
             if reason:
@@ -619,8 +478,13 @@ class WorkerHandle:
 
     def request(self, msg: Dict[str, Any],
                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        self._check_death()
         try:
             with self._req_lock:
+                seq = self._seq
+                self._seq += 1
+                msg = dict(msg)
+                msg["seq"] = seq
                 self.chan.send(msg)
                 ticket = self._next_send
                 self._next_send += 1
@@ -630,20 +494,43 @@ class WorkerHandle:
             try:
                 # Our turn: ticket order makes this thread the sole
                 # reader, with no lock held across the blocking recv.
-                return self.chan.recv(timeout_s=timeout_s,
-                                      poll=self._poll)
+                self._check_death()
+                return self._recv_reply(seq, timeout_s)
             finally:
                 # Always pass the turn — even on a broken pipe the next
-                # ticket holder must wake (its own recv then raises).
+                # ticket holder must wake (its fail-fast check or its
+                # own recv then raises).
                 with self._turn:
                     self._next_recv += 1
                     self._turn.notify_all()
+        except WorkerLostError as exc:
+            self._record_death(exc.reason)
+            raise
         except (FrameError, BrokenPipeError, OSError) as exc:
-            rc = self.proc.poll()
-            raise WorkerLostError(
-                self.worker_id,
-                f"rpc stream broke ({type(exc).__name__}: {exc}); "
-                f"process rc={rc}") from exc
+            rc = self.proc.poll() if self.proc is not None else None
+            reason = (f"rpc stream broke ({type(exc).__name__}: {exc}); "
+                      f"process rc={rc}")
+            self._record_death(reason)
+            raise WorkerLostError(self.worker_id, reason) from exc
+
+    def _recv_reply(self, seq: int,
+                    timeout_s: Optional[float]) -> Dict[str, Any]:
+        """Read frames until this request's reply: heartbeats update
+        liveness and are skimmed; a reply with an older seq is a stale
+        duplicate (post-reconnect resend race) and is dropped."""
+        deadline = None if timeout_s is None else (
+            monotonic_s() + timeout_s)
+        while True:
+            remaining = None if deadline is None else max(
+                deadline - monotonic_s(), 0.0)
+            frame = self.chan.recv(timeout_s=remaining, poll=self._poll)
+            self.last_rx = monotonic_s()
+            if is_heartbeat(frame):
+                continue
+            fseq = frame.get("seq") if isinstance(frame, dict) else None
+            if fseq is not None and fseq != seq:
+                continue
+            return frame
 
     def log_tail(self, max_bytes: int = 8192) -> str:
         try:
@@ -658,12 +545,197 @@ class WorkerHandle:
     def terminate(self) -> None:
         self.alive = False
         self.chan.close()
-        if self.proc.poll() is None:
+        if self.proc is not None and self.proc.poll() is None:
             self.proc.kill()
             try:
                 self.proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 pass
+
+
+class TcpWorkerHandle(WorkerHandle):
+    """A worker reached over TCP: the channel can DROP without the
+    worker dying.
+
+    On a connection failure the reader does not raise `WorkerLostError`
+    — it enters the reconnect window: wait (on the router's own clock)
+    for the accept/dial machinery to `adopt` a fresh transport, then
+    RESEND its request with the SAME sequence id.  The worker's reply
+    cache makes the resend idempotent: work it already did is answered
+    from cache, never re-executed.  Only window exhaustion or process
+    death converts to the typed loss path."""
+
+    def __init__(self, worker_id: str, chan, *,
+                 proc: Optional[subprocess.Popen] = None,
+                 log_path: str = "",
+                 reconnect: Optional[ReconnectPolicy] = None,
+                 conn_dead_after_s: float = 5.0,
+                 on_event: Optional[Callable[..., None]] = None) -> None:
+        super().__init__(worker_id, proc, chan, log_path, liveness=None)
+        self.reconnect = reconnect or ReconnectPolicy()
+        self.conn_dead_after_s = float(conn_dead_after_s)
+        self.incarnation = 0
+        self._on_event = on_event
+        # Transport generation: bumped by adopt(); readers stranded on
+        # a dead connection wait here for the replacement.
+        self._tlock = threading.Condition()
+        self._epoch = 0  # megba: guarded-by(_tlock)
+
+    def _emit(self, event: str, **fields: Any) -> None:
+        if self._on_event is not None:
+            self._on_event(event, worker=self.worker_id, **fields)
+
+    def adopt(self, transport, incarnation: int) -> None:
+        """Install a freshly-registered connection (accept/dial thread)
+        and wake every reader waiting out the reconnect window."""
+        with self._tlock:
+            old = self.chan
+            self.chan = transport
+            self.incarnation = int(incarnation)
+            self._epoch += 1
+            epoch = self._epoch
+            self.last_rx = monotonic_s()
+            self._tlock.notify_all()
+        try:
+            old.close()
+        except OSError:
+            pass
+        # Epoch 1 is the worker's FIRST registration — that is a
+        # connect, not a reconnect (the metric must count recoveries).
+        self._emit("reconnect" if epoch > 1 else "connect",
+                   incarnation=int(incarnation))
+
+    def _poll(self) -> None:
+        if self.proc is not None:
+            rc = self.proc.poll()
+            if rc is not None:
+                raise WorkerLostError(self.worker_id,
+                                      f"process exited rc={rc}")
+        if (self.conn_dead_after_s > 0
+                and monotonic_s() - self.last_rx > self.conn_dead_after_s):
+            raise _ConnSuspect(
+                f"no frames or heartbeats for {self.conn_dead_after_s:.1f}s")
+
+    def request(self, msg: Dict[str, Any],
+                timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        self._check_death()
+        try:
+            with self._req_lock:
+                seq = self._seq
+                self._seq += 1
+                msg = dict(msg)
+                msg["seq"] = seq
+                with self._tlock:
+                    sent_epoch = self._epoch
+                try:
+                    self.chan.send(msg)
+                except OSError:
+                    # Connection already down: take the ticket anyway;
+                    # the reader resends once a transport is adopted.
+                    sent_epoch -= 1
+                ticket = self._next_send
+                self._next_send += 1
+            with self._turn:
+                while self._next_recv != ticket:
+                    self._turn.wait()
+            try:
+                self._check_death()
+                return self._reply_with_reconnect(
+                    msg, seq, sent_epoch, timeout_s)
+            finally:
+                with self._turn:
+                    self._next_recv += 1
+                    self._turn.notify_all()
+        except WorkerLostError as exc:
+            self._record_death(exc.reason)
+            raise
+
+    def _reply_with_reconnect(self, msg: Dict[str, Any], seq: int,
+                              sent_epoch: int,
+                              timeout_s: Optional[float],
+                              ) -> Dict[str, Any]:
+        deadline = None if timeout_s is None else (
+            monotonic_s() + timeout_s)
+        # The staleness clock starts when we BEGIN listening: nobody
+        # drains heartbeats while the handle is idle, so a healthy
+        # worker's beats sit unread in the socket buffer and last_rx
+        # goes stale — an idle gap must not read as silence.
+        self.last_rx = max(self.last_rx, monotonic_s())
+        while True:
+            self._check_death()
+            with self._tlock:
+                cur_epoch = self._epoch
+                chan = self.chan
+            if cur_epoch > sent_epoch:
+                # Reconnected since this request went out: resend with
+                # the same seq (idempotent — the worker's dedup cache
+                # answers anything it already executed from cache).
+                try:
+                    chan.send(msg)
+                except OSError:
+                    self._await_reconnect(cur_epoch, deadline)
+                    continue
+                sent_epoch = cur_epoch
+                self._emit("resend", seq=seq, op=msg.get("op"))
+            remaining = None if deadline is None else max(
+                deadline - monotonic_s(), 0.0)
+            try:
+                frame = chan.recv(timeout_s=remaining, poll=self._poll)
+            except _ConnSuspect as exc:
+                self._emit("conn_lost", reason=str(exc))
+                self._await_reconnect(cur_epoch, deadline)
+                continue
+            except TimeoutError:
+                raise  # the watchdog budget: the serve loop types it
+            except (FrameError, OSError) as exc:
+                self._emit("conn_lost",
+                           reason=f"{type(exc).__name__}: {exc}")
+                self._await_reconnect(cur_epoch, deadline)
+                continue
+            self.last_rx = monotonic_s()
+            if is_heartbeat(frame):
+                continue
+            fseq = frame.get("seq") if isinstance(frame, dict) else None
+            if fseq is not None and fseq != seq:
+                continue  # stale duplicate from before the reconnect
+            return frame
+
+    def _await_reconnect(self, seen_epoch: int,
+                         watchdog_deadline: Optional[float]) -> None:
+        """Wait out the reconnect window on the router's own clock:
+        returns once a NEWER transport than `seen_epoch` is adopted;
+        raises typed on window exhaustion, process death, or watchdog
+        expiry.  The Condition wait releases the lock (the sanctioned
+        blocking-under-lock shape)."""
+        window_end = monotonic_s() + self.reconnect.window_s
+        with self._tlock:
+            while self._epoch <= seen_epoch:
+                self._check_death()
+                if self.proc is not None:
+                    rc = self.proc.poll()
+                    if rc is not None:
+                        raise WorkerLostError(
+                            self.worker_id,
+                            f"process exited rc={rc} during the "
+                            "reconnect window")
+                now = monotonic_s()
+                if watchdog_deadline is not None and now >= watchdog_deadline:
+                    raise TimeoutError(
+                        "watchdog budget expired inside the reconnect "
+                        "window")
+                if now >= window_end:
+                    raise WorkerLostError(
+                        self.worker_id,
+                        "reconnect window exhausted "
+                        f"({self.reconnect.window_s:.1f}s without "
+                        "re-registration)")
+                self._tlock.wait(timeout=0.05)
+
+    def terminate(self) -> None:
+        self._record_death("terminated by router")
+        with self._tlock:
+            self._tlock.notify_all()  # readers fail fast, not time out
+        super().terminate()
 
 
 # ---------------------------------------------------------------------------
@@ -680,6 +752,9 @@ class _Routed:
     enqueued: float
     deadline: Optional[float] = None
     reroutes: int = 0
+    seq: int = 0  # submission sequence (escalation backoff seed)
+    escalated: bool = False  # ladder consulted once past max_reroutes
+    not_before: Optional[float] = None  # escalation backoff gate
 
 
 class FleetRouter:
@@ -721,6 +796,16 @@ class FleetRouter:
         worker_env: Optional[Dict[str, str]] = None,
         pin_cpus: bool = False,
         workers: Optional[Sequence[Any]] = None,
+        transport: str = "pipe",
+        bind: Optional[str] = None,
+        advertise: Optional[str] = None,
+        connect: Sequence[str] = (),
+        token: Optional[str] = None,
+        reconnect: Optional[ReconnectPolicy] = None,
+        conn_dead_after_s: float = 5.0,
+        hb_interval_s: float = 0.25,
+        accept_new: bool = False,
+        escalation=None,
     ) -> None:
         from megba_tpu.common import ProblemOption
         from megba_tpu.serving.batcher import _check_option
@@ -729,7 +814,16 @@ class FleetRouter:
 
         option = option or ProblemOption()
         _check_option(option)
-        if n_workers < 1 and workers is None:
+        if transport not in ("pipe", "tcp"):
+            raise ValueError(
+                f"transport must be 'pipe' or 'tcp', got {transport!r}")
+        if transport == "pipe" and (bind or advertise or connect
+                                    or accept_new):
+            raise ValueError(
+                "bind/advertise/connect/accept_new require "
+                "transport='tcp'")
+        allow_zero = transport == "tcp" and (connect or accept_new)
+        if n_workers < 1 and workers is None and not allow_zero:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
         if max_reroutes < 0:
             raise ValueError(
@@ -740,11 +834,34 @@ class FleetRouter:
         self.steal = bool(steal)
         self.max_reroutes = int(max_reroutes)
         self.watchdog_s = float(watchdog_s)
+        self.warm_timeout_s = float(warm_timeout_s)
         self.stats = stats or FederationStats()
         self.timer = PhaseTimer() if timer is None else timer
         self.telemetry = telemetry
+        self.transport = transport
+        self.escalation = escalation
+        self.reconnect = reconnect or ReconnectPolicy()
+        self._token = token
+        self._conn_dead_after_s = float(conn_dead_after_s)
+        self._hb_interval_s = float(hb_interval_s)
+        self._accept_new = bool(accept_new)
+        self.address: Optional[str] = None  # tcp: the bound host:port
+        self._lsock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._dial_threads: List[threading.Thread] = []
+        self._env_fp: Dict[str, str] = {}
+        self._artifacts = artifacts
+        self._manifest = manifest
+        self._strict_manifest = bool(strict_manifest)
+        self._slices: Dict[str, Any] = {}  # wid -> cpu affinity slice
 
         self._lock = threading.Condition()
+        self._nsubmitted = 0  # megba: guarded-by(_lock)
+        self._cold_warned: set = set()  # megba: guarded-by(_lock); warned (bucket, lanes, rung)
+        self._hello: Dict[str, Dict[str, Any]] = {}  # megba: guarded-by(_lock); tcp registration rendezvous
+        self._closing_accept = False  # megba: guarded-by(_lock)
+        self._redial: Dict[str, threading.Event] = {}  # megba: guarded-by(_lock); dial addr -> wake event
+        self._wid_addr: Dict[str, str] = {}  # megba: guarded-by(_lock); wid -> dialed addr
         self._pending: Dict[Tuple, List[_Routed]] = {}  # megba: guarded-by(_lock)
         self._npending = 0  # megba: guarded-by(_lock)
         self._closed = False  # megba: guarded-by(_lock)
@@ -767,20 +884,27 @@ class FleetRouter:
 
         if workers is not None:
             self.workers: Dict[str, Any] = {w.worker_id: w for w in workers}
+        elif transport == "tcp":
+            self.workers = self._spawn_workers_tcp(
+                n_workers, warm_timeout_s, worker_env or {}, pin_cpus,
+                bind, advertise, connect)
         else:
             self.workers = self._spawn_workers(
                 n_workers, artifacts, manifest, strict_manifest,
                 heartbeat_dir, dead_after_s, warm_timeout_s,
                 worker_env or {}, pin_cpus)
-        for w in self.workers.values():
-            self._views[w.worker_id] = WorkerView(
-                worker_id=w.worker_id, warm=set(w.warm),
-                alive=w.alive)
-        self._threads = [
-            threading.Thread(target=self._serve, args=(w,),
-                             name=f"megba-fed-{w.worker_id}", daemon=True)
-            for w in self.workers.values()
-        ]
+        with self._lock:
+            for w in self.workers.values():
+                if w.worker_id not in self._views:  # tcp path pre-filled
+                    self._views[w.worker_id] = WorkerView(
+                        worker_id=w.worker_id, warm=set(w.warm),
+                        alive=w.alive)
+            self._threads = [
+                threading.Thread(target=self._serve, args=(w,),
+                                 name=f"megba-fed-{w.worker_id}",
+                                 daemon=True)
+                for w in self.workers.values()
+            ]
         for t in self._threads:
             t.start()
 
@@ -801,34 +925,7 @@ class FleetRouter:
             env["JAX_ENABLE_X64"] = "1"
         env.update(worker_env)
 
-        # `pin_cpus`: split the host's cores into contiguous slices, one
-        # per worker — each XLA:CPU thread pool then owns its slice
-        # instead of all workers thrashing one shared set (the
-        # data-parallel deployment shape, one host's cores = one
-        # worker's world).  True = cores // n each; an int = exactly
-        # that many cores per worker (the bench's equal-resource
-        # scaling sweeps pin fed_1 and fed_n to the SAME per-worker
-        # slice so the 1→N curve compares like with like).
-        slices: List[Optional[List[int]]] = [None] * n
-        if pin_cpus:
-            try:
-                cores = sorted(os.sched_getaffinity(0))
-            except (AttributeError, OSError):
-                cores = []
-            per = (int(pin_cpus) if pin_cpus is not True
-                   else (len(cores) // n if cores else 0))
-            if per >= 1 and len(cores) >= per * n:
-                slices = [cores[i * per:(i + 1) * per] for i in range(n)]
-            else:
-                import warnings as _warnings
-
-                _warnings.warn(
-                    f"pin_cpus={pin_cpus!r} needs {per or 1} core(s) x "
-                    f"{n} workers but only {len(cores)} are available; "
-                    "workers run UNPINNED (a benchmark reading "
-                    "equal-resource scaling from this run would be "
-                    "comparing asymmetric configurations)", stacklevel=3)
-        self.pinned = slices[0] is not None if slices else False
+        slices = self._compute_cpu_slices(n, pin_cpus)
 
         if heartbeat_dir is None:
             heartbeat_dir = tempfile.mkdtemp(prefix="megba_fed_hb_")
@@ -907,6 +1004,365 @@ class FleetRouter:
             raise
         return handles
 
+    def _compute_cpu_slices(self, n: int, pin_cpus) -> List[Any]:
+        # `pin_cpus`: split the host's cores into contiguous slices, one
+        # per worker — each XLA:CPU thread pool then owns its slice
+        # instead of all workers thrashing one shared set (the
+        # data-parallel deployment shape, one host's cores = one
+        # worker's world).  True = cores // n each; an int = exactly
+        # that many cores per worker (the bench's equal-resource
+        # scaling sweeps pin fed_1 and fed_n to the SAME per-worker
+        # slice so the 1→N curve compares like with like).
+        slices: List[Optional[List[int]]] = [None] * n
+        if pin_cpus and n:
+            try:
+                cores = sorted(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = []
+            per = (int(pin_cpus) if pin_cpus is not True
+                   else (len(cores) // n if cores else 0))
+            if per >= 1 and len(cores) >= per * n:
+                slices = [cores[i * per:(i + 1) * per] for i in range(n)]
+            else:
+                warnings.warn(
+                    f"pin_cpus={pin_cpus!r} needs {per or 1} core(s) x "
+                    f"{n} workers but only {len(cores)} are available; "
+                    "workers run UNPINNED (a benchmark reading "
+                    "equal-resource scaling from this run would be "
+                    "comparing asymmetric configurations)", stacklevel=4)
+        self.pinned = slices[0] is not None if slices else False
+        return slices
+
+    # -- TCP fabric ------------------------------------------------------
+    def _spawn_workers_tcp(self, n, warm_timeout_s, worker_env, pin_cpus,
+                           bind, advertise, connect) -> Dict[str, Any]:
+        """Bind the fleet socket, spawn n workers that dial (back) in,
+        start the accept/dial supervision threads, and block until
+        every spawned worker has registered and said hello."""
+        import jax
+
+        from megba_tpu.serving.artifacts import current_environment
+
+        env = dict(os.environ)
+        # Workers must land on the parent's backend/precision: the
+        # conftest-style in-process config flips don't propagate to
+        # children, the env vars do.
+        env.setdefault("JAX_PLATFORMS", jax.default_backend())
+        if jax.config.jax_enable_x64:
+            env["JAX_ENABLE_X64"] = "1"
+        env.update(worker_env)
+        if self._token:
+            env["MEGBA_FED_TOKEN"] = self._token
+        self._env_fp = current_environment()
+
+        slices = self._compute_cpu_slices(n, pin_cpus)
+        host, port = parse_address(bind or "127.0.0.1:0")
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind((host, port))
+        lsock.listen(64)
+        lsock.settimeout(0.2)  # accept slices re-check the closing flag
+        self._lsock = lsock
+        bound = lsock.getsockname()
+        self.address = f"{bound[0]}:{bound[1]}"
+        # `advertise` is what the spawned workers DIAL — normally the
+        # bound address, but a chaos proxy (robustness/netfaults.py) or
+        # a NAT sits between in tests and real deployments.
+        dial_addr = advertise or self.address
+
+        handles: Dict[str, Any] = {}
+        self.workers = handles  # accept thread resolves handles here
+        expected: List[str] = []
+        for i in range(n):
+            wid = f"w{i}"
+            self._slices[wid] = slices[i]
+            log = tempfile.NamedTemporaryFile(
+                prefix=f"megba_fed_{wid}_", suffix=".log", delete=False)
+            # -c entry rather than -m: runpy would re-execute the
+            # module it had already imported via the package __init__,
+            # a known double-module footgun.
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import sys; from megba_tpu.serving.worker import "
+                 "main; sys.exit(main(sys.argv[1:]))",
+                 "--connect", dial_addr, "--worker-id", wid,
+                 "--hb-interval", str(self._hb_interval_s),
+                 "--reconnect-attempts", str(self.reconnect.max_attempts),
+                 "--reconnect-base", str(self.reconnect.base_s),
+                 "--reconnect-cap", str(self.reconnect.cap_s),
+                 "--reconnect-window", str(self.reconnect.window_s),
+                 "--reconnect-jitter", str(self.reconnect.jitter),
+                 "--reconnect-seed", str(self.reconnect.seed)],
+                stdin=subprocess.DEVNULL, stdout=log,
+                stderr=subprocess.STDOUT, env=env)
+            log.close()
+            handle = TcpWorkerHandle(
+                wid, _NeverTransport(), proc=proc, log_path=log.name,
+                reconnect=self.reconnect,
+                conn_dead_after_s=self._conn_dead_after_s,
+                on_event=self._transport_event)
+            handle.rank = i + 1
+            with self._lock:
+                handles[wid] = handle
+                # Disconnected until the register+hello lands.
+                self._views[wid] = WorkerView(
+                    worker_id=wid, warm=set(), alive=True,
+                    connected=False)
+            expected.append(wid)
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="megba-fed-accept")
+        self._accept_thread.start()
+        for addr in connect:
+            t = threading.Thread(target=self._dial_loop,
+                                 args=(str(addr),), daemon=True,
+                                 name=f"megba-fed-dial-{addr}")
+            t.start()
+            self._dial_threads.append(t)
+
+        # Rendezvous: the accept thread fills _hello as registrations
+        # complete; fail fast on worker death, typed on timeout.
+        fail_msg: Optional[str] = None
+        deadline = monotonic_s() + warm_timeout_s
+        with self._lock:
+            while fail_msg is None:
+                missing = [w for w in expected if w not in self._hello]
+                bad = [(w, h) for w, h in self._hello.items()
+                       if not h.get("ok")]
+                if bad:
+                    wid, h = bad[0]
+                    fail_msg = (
+                        f"federation worker {wid} refused config: "
+                        f"{h.get('error')}\n--- worker log ---\n"
+                        f"{handles[wid].log_tail()}")
+                    break
+                if not missing:
+                    break
+                for wid in missing:
+                    proc = handles[wid].proc
+                    if proc is not None and proc.poll() is not None:
+                        fail_msg = (
+                            f"federation worker {wid} exited "
+                            f"rc={proc.returncode} before registering"
+                            f"\n--- worker log ---\n"
+                            f"{handles[wid].log_tail()}")
+                        break
+                if fail_msg is None and monotonic_s() > deadline:
+                    fail_msg = (
+                        f"federation workers {missing} failed to "
+                        f"register within {warm_timeout_s:.0f}s")
+                if fail_msg is None:
+                    self._lock.wait(timeout=0.2)
+        if fail_msg is not None:
+            self._teardown_tcp()
+            raise RuntimeError(fail_msg)
+        return handles
+
+    def _config_for(self, wid: str) -> Dict[str, Any]:
+        return {
+            "op": "config", "worker_id": wid,
+            "option": self.option, "ladder": self.ladder,
+            "artifacts": self._artifacts, "manifest": self._manifest,
+            "strict_manifest": self._strict_manifest,
+            "cpu_affinity": self._slices.get(wid),
+            "telemetry": (None if self.telemetry is None
+                          else f"{self.telemetry}.{wid}"),
+        }
+
+    def _accept_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closing_accept:
+                    return
+            try:
+                sock, _peer = self._lsock.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return  # listener closed: router shutting down
+            self._register_connection(sock)
+
+    def _register_connection(self, sock) -> Optional[str]:
+        """Run the register handshake on one fresh connection; on
+        success adopt the transport into the worker's handle (waking
+        any reader stuck in its reconnect window).  Returns the worker
+        id, or None when the connection was refused/dropped."""
+        t = TcpTransport(sock)
+        reg: Any = None
+        try:
+            reg = t.recv(timeout_s=10.0)
+            wid = verify_register(reg, self._token, self._env_fp)
+        except HandshakeError as exc:
+            self._transport_event(
+                "handshake_refused",
+                worker=str((reg or {}).get("worker_id", "?")
+                           if isinstance(reg, dict) else "?"),
+                field=exc.field)
+            with contextlib.suppress(OSError):
+                t.send(refusal_frame(exc))
+            t.close()
+            return None
+        except (FrameError, TimeoutError, OSError):
+            t.close()
+            return None
+
+        with self._lock:
+            handle = self.workers.get(wid)
+            view = self._views.get(wid)
+            was_alive = bool(view.alive) if view is not None else False
+        if handle is None and not self._accept_new:
+            exc = HandshakeError("worker_id", wid,
+                                 "a registered worker id")
+            with contextlib.suppress(OSError):
+                t.send(refusal_frame(exc))
+            t.close()
+            return None
+
+        fresh = handle is None or not was_alive
+        needs_config = fresh or bool(reg.get("needs_config", True))
+        try:
+            if needs_config:
+                t.send(ack_frame("config", self._token, wid,
+                                 config=self._config_for(wid)))
+            else:
+                t.send(ack_frame("resume", self._token, wid))
+            hello = t.recv(timeout_s=self.warm_timeout_s)
+        except (FrameError, TimeoutError, OSError):
+            t.close()
+            return None
+        if not isinstance(hello, dict) or not hello.get("ok"):
+            with self._lock:
+                self._hello[wid] = (hello if isinstance(hello, dict)
+                                    else {"ok": False,
+                                          "error": repr(hello)})
+                self._lock.notify_all()
+            t.close()
+            return None
+
+        if fresh and (handle is None or not was_alive):
+            # Unknown id (accept_new) or a worker previously declared
+            # LOST re-registering after a restart: the old handle's
+            # death latch is permanent, so it gets a replacement (and a
+            # fresh serve thread below).
+            handle = TcpWorkerHandle(
+                wid, _NeverTransport(), proc=None,
+                reconnect=self.reconnect,
+                conn_dead_after_s=self._conn_dead_after_s,
+                on_event=self._transport_event)
+        warm = set(hello.get("warm", ()))
+        handle.warm = set(warm)
+        handle.adopt(t, int(reg.get("incarnation", 0)))
+        serve_thread: Optional[threading.Thread] = None
+        with self._lock:
+            self.workers[wid] = handle
+            view = self._views.get(wid)
+            if view is None or not view.alive:
+                self._views[wid] = WorkerView(
+                    worker_id=wid, warm=set(warm), alive=True,
+                    connected=True)
+                if view is not None:
+                    self._transport_event("revived", worker=wid)
+                serve_thread = threading.Thread(
+                    target=self._serve, args=(handle,),
+                    name=f"megba-fed-{wid}", daemon=True)
+                self._threads.append(serve_thread)
+            else:
+                view.connected = True
+                view.warm = set(warm)
+            self._hello[wid] = hello
+            self._lock.notify_all()
+        if hello.get("cold_start"):
+            self.stats.record_cold_start(wid, hello["cold_start"])
+        if serve_thread is not None:
+            serve_thread.start()
+        return wid
+
+    def _dial_loop(self, addr: str) -> None:
+        """Router-initiated connections for bind-mode workers: dial,
+        hand the socket to the register flow, then sleep until the
+        connection drops (conn_lost wakes us) and redial under the
+        reconnect policy's deterministic backoff."""
+        key = zlib.crc32(addr.encode())
+        attempt = 0
+        ev = threading.Event()
+        while True:
+            with self._lock:
+                if self._closing_accept:
+                    return
+                self._redial[addr] = ev
+            try:
+                sock = socket.create_connection(parse_address(addr),
+                                                timeout=5.0)
+                sock.settimeout(None)
+            except OSError:
+                attempt += 1
+                if attempt > self.reconnect.max_attempts:
+                    self._transport_event("dial_exhausted", worker=addr)
+                    return
+                time.sleep(self.reconnect.backoff_s(key, attempt))
+                continue
+            wid = self._register_connection(sock)
+            if wid is None:
+                attempt += 1
+                if attempt > self.reconnect.max_attempts:
+                    self._transport_event("dial_exhausted", worker=addr)
+                    return
+                time.sleep(self.reconnect.backoff_s(key, attempt))
+                continue
+            attempt = 0
+            with self._lock:
+                self._wid_addr[wid] = addr
+            ev.clear()
+            ev.wait()  # conn_lost (or close) wakes the redial
+
+    def _transport_event(self, event: str, worker: str = "?",
+                         **fields: Any) -> None:
+        """Every transport event lands in all three observability
+        planes (metrics counter, zero-duration span, flight record) +
+        the phase timer; conn_lost additionally flips the routing view
+        to detour mode and wakes the redial thread."""
+        self.timer.count_event(f"transport_{event}")
+        registry = _obs.metrics_registry()
+        if registry is not None:
+            registry.counter(
+                f"megba_transport_{event}_total",
+                f"Federation transport events: {event}").inc(
+                    worker=worker)
+        recorder = _obs.span_recorder()
+        if recorder is not None:
+            with recorder.span(f"transport_{event}", worker=worker,
+                               **fields):
+                pass
+        flight = _obs.flight_recorder()
+        if flight is not None:
+            flight.record(f"transport_{event}", worker=worker, **fields)
+        if event == "conn_lost":
+            ev = None
+            with self._lock:
+                view = self._views.get(worker)
+                if view is not None:
+                    view.connected = False
+                ev = self._redial.get(self._wid_addr.get(worker, ""))
+                self._lock.notify_all()
+            if ev is not None:
+                ev.set()
+
+    def _teardown_tcp(self) -> None:
+        with self._lock:
+            self._closing_accept = True
+            events = list(self._redial.values())
+            self._lock.notify_all()
+        for ev in events:
+            ev.set()
+        if self._lsock is not None:
+            with contextlib.suppress(OSError):
+                self._lsock.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        for w in list(self.workers.values()):
+            w.terminate()
+
     def _liveness_for(self, rank: int, wid: str):
         def check() -> Optional[str]:
             if self._board is None:
@@ -953,6 +1409,8 @@ class FleetRouter:
                 raise RuntimeError("FleetRouter is closed")
             if not any(v.alive for v in self._views.values()):
                 raise WorkerLostError("*", "no surviving workers")
+            item.seq = self._nsubmitted
+            self._nsubmitted += 1
             self._pending.setdefault(key, []).append(item)
             self._npending += 1
             if item.deadline is not None:
@@ -986,6 +1444,8 @@ class FleetRouter:
             if not any(v.alive for v in self._views.values()):
                 raise WorkerLostError("*", "no surviving workers")
             for item in items:
+                item.seq = self._nsubmitted
+                self._nsubmitted += 1
                 self._pending.setdefault(item.key, []).append(item)
             self._npending += len(items)
             self._ndeadline += sum(
@@ -1015,9 +1475,11 @@ class FleetRouter:
             self._lock.notify_all()
         if already:
             return
-        for t in self._threads:
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join()
-        for w in self.workers.values():
+        for w in list(self.workers.values()):
             if w.alive:
                 try:
                     w.request({"op": "shutdown"}, timeout_s=30.0)
@@ -1038,6 +1500,22 @@ class FleetRouter:
                     os.unlink(log_path)
                 except OSError:
                     pass
+        if self._lsock is not None:
+            # TCP fabric: stop accepting/redialing AFTER the shutdown
+            # handshakes above (they ride the live connections), then
+            # reap the supervision threads.
+            with self._lock:
+                self._closing_accept = True
+                redial_events = list(self._redial.values())
+                self._lock.notify_all()
+            for ev in redial_events:
+                ev.set()
+            with contextlib.suppress(OSError):
+                self._lsock.close()
+            if self._accept_thread is not None:
+                self._accept_thread.join(timeout=5.0)
+            for t in self._dial_threads:
+                t.join(timeout=5.0)
         if self._own_hb_dir is not None:
             import shutil
 
@@ -1077,9 +1555,12 @@ class FleetRouter:
         # handles' `alive` flags: a serve thread declaring a loss writes
         # the flag concurrently with this pull, and the router lock is
         # the only ordering the two threads share (guarded-by contract).
+        # A disconnected (reconnect-window) worker is skipped too: a
+        # metrics pull over a dead link would burn the 60s budget.
         with self._lock:
             live = [w for w in self.workers.values()
-                    if self._views[w.worker_id].alive]
+                    if self._views[w.worker_id].alive
+                    and self._views[w.worker_id].connected]
         for w in live:
             try:
                 reply = w.request({"op": "metrics"}, timeout_s=60.0)
@@ -1135,48 +1616,71 @@ class FleetRouter:
             self._npending = sum(len(v) for v in self._pending.values())
         return shed
 
-    def _depths_locked(self) -> Dict[str, int]:
+    @staticmethod
+    def _ready(items: List[_Routed], now: float) -> List[_Routed]:
+        # Escalated items park behind a `not_before` backoff gate; they
+        # stay pending (flush-visible) but undispatchable until due.
+        return [it for it in items
+                if it.not_before is None or it.not_before <= now]
+
+    def _depths_locked(self, now: float) -> Dict[str, int]:
         depths: Dict[str, int] = {}
         for (sc, _dims), items in self._pending.items():
-            if items:
-                depths[str(sc)] = depths.get(str(sc), 0) + len(items)
+            n = len(self._ready(items, now))
+            if n:
+                depths[str(sc)] = depths.get(str(sc), 0) + n
         return depths
 
-    def _pick_locked(self, wid: str) -> Tuple[Optional[List[_Routed]], bool]:
-        """(batch, stolen) for worker `wid`, or (None, False)."""
+    def _pick_locked(self, wid: str, now: float) -> Tuple[
+            Optional[List[_Routed]], bool, bool]:
+        """(batch, stolen, cold) for worker `wid`, or (None, False,
+        False).  `cold` flags a dispatch whose bucket has no artifact
+        on the target worker — a compile-on-dispatch latency cliff the
+        coverage-gap satellite surfaces."""
         view = self._views[wid]
-        # 1) buckets homed here (or routable here), oldest first
+        if not view.connected:
+            # Reconnect window: this worker keeps its assignment but
+            # takes no new work; route() detours its buckets meanwhile.
+            return None, False, False
+        # 1) buckets homed here (or routable/detoured here), oldest first
         candidates = []
         for key, items in self._pending.items():
-            if not items:
+            ready = self._ready(items, now)
+            if not ready:
                 continue
             bucket = str(key[0])
-            homed = self._table.assignment.get(bucket)
-            if homed is None:
-                homed = self._table.route(bucket, self._views)
+            # route() (not the raw assignment) so a disconnected home's
+            # buckets detour to warm connected peers for the window.
+            homed = self._table.route(bucket, self._views)
             if homed == wid:
-                candidates.append((min(it.enqueued for it in items), key))
+                candidates.append((min(it.enqueued for it in ready),
+                                   key))
         if candidates:
             # Tiebreak on the bucket string: submit_many stamps a whole
             # fleet with ONE enqueue time, and (ShapeClass, dims) keys
             # do not order.
             _, key = min(candidates, key=lambda c: (c[0], str(c[1][0]),
                                                     c[1][1]))
-            return self._take_locked(key, view), False
+            cold = str(key[0]) not in view.warm
+            return self._take_locked(key, view, now), False, cold
         # 2) steal: deepest warm backlog homed on a live peer
         if self.steal:
             bucket = self._table.steal_candidate(
-                wid, self._views, self._depths_locked())
+                wid, self._views, self._depths_locked(now))
             if bucket is not None:
                 for key, items in self._pending.items():
-                    if str(key[0]) == bucket and items:
-                        return self._take_locked(key, view), True
-        return None, False
+                    if str(key[0]) == bucket and self._ready(items, now):
+                        # Stealing requires warmth, so never cold.
+                        return (self._take_locked(key, view, now),
+                                True, False)
+        return None, False, False
 
-    def _take_locked(self, key: Tuple, view: WorkerView) -> List[_Routed]:
+    def _take_locked(self, key: Tuple, view: WorkerView,
+                     now: float) -> List[_Routed]:
         items = self._pending[key]
-        take = items[:self.max_batch]
-        rest = items[self.max_batch:]
+        take = self._ready(items, now)[:self.max_batch]
+        taken = set(map(id, take))
+        rest = [it for it in items if id(it) not in taken]
         if rest:
             self._pending[key] = rest
         else:
@@ -1192,6 +1696,7 @@ class FleetRouter:
         while True:
             batch: Optional[List[_Routed]] = None
             stolen = False
+            cold = False
             shed_out: Optional[List[_Routed]] = None
             with self._lock:
                 while True:
@@ -1209,7 +1714,7 @@ class FleetRouter:
                         self._inflight += len(shed)
                         shed_out = shed
                         break
-                    batch, stolen = self._pick_locked(wid)
+                    batch, stolen, cold = self._pick_locked(wid, now)
                     if batch is not None:
                         break
                     if (self._closing and self._npending == 0
@@ -1231,6 +1736,36 @@ class FleetRouter:
                     self._inflight -= len(shed_out)
                     self._lock.notify_all()
                 continue
+            if cold:
+                # Coverage-gap satellite: a dispatch whose (bucket,
+                # lanes, rung) has no artifact on the target is a
+                # compile-on-dispatch — count it every time, warn ONCE
+                # per missing key so lane-rung holes surface without
+                # spamming a hot path.
+                self.stats.record_cold_dispatch(len(batch))
+                self.timer.count_event("fed_cold_dispatch", len(batch))
+                registry = _obs.metrics_registry()
+                if registry is not None:
+                    registry.counter(
+                        "megba_fed_cold_dispatch_total",
+                        "Dispatches with no artifact on the target "
+                        "worker (compile-on-dispatch)").inc(
+                            len(batch), bucket=batch[0].bucket,
+                            worker=wid)
+                lanes = len(batch)
+                warn_key = (batch[0].bucket, lanes, 0)
+                first = False
+                with self._lock:
+                    if warn_key not in self._cold_warned:
+                        self._cold_warned.add(warn_key)
+                        first = True
+                if first:
+                    warnings.warn(ColdDispatchWarning(
+                        f"cold dispatch: no artifact for bucket="
+                        f"{batch[0].bucket!r} lanes={lanes} rung=0 on "
+                        f"worker {wid!r} — this batch compiles on "
+                        "dispatch (export artifacts for this key to "
+                        "remove the latency cliff)"), stacklevel=2)
             try:
                 try:
                     msg: Dict[str, Any] = {
@@ -1350,6 +1885,7 @@ class FleetRouter:
         # in-flight until resolved (the caller's finally decrements the
         # batch; _inflight covers it throughout).
         to_fail: List[Tuple[Future, WorkerLostError]] = []
+        escalated = 0
         with self._lock:
             self._views[wid].alive = False
             self._table.reassign_lost(wid, self._views)
@@ -1361,10 +1897,34 @@ class FleetRouter:
                     to_fail.append((it.future, WorkerLostError(
                         wid, f"{exc.reason}; no surviving workers")))
                 elif it.reroutes > self.max_reroutes:
+                    if (self.escalation is not None
+                            and self.escalation.retry_dispatch_errors
+                            and not it.escalated):
+                        # Router-level escalation (ROADMAP 4d): consult
+                        # the EscalationPolicy ladder ONCE before
+                        # failing typed — one extra retry behind the
+                        # policy's deterministic seeded backoff.  The
+                        # same-clock rule applies: `not_before` joins
+                        # enqueued/deadline on time.monotonic(), never
+                        # the handle-side monotonic_s() epoch.
+                        it.escalated = True
+                        it.not_before = (
+                            time.monotonic()
+                            + self.escalation.backoff_s(it.seq,
+                                                        it.reroutes))
+                        self._pending.setdefault(it.key, []).append(it)
+                        self._npending += 1
+                        if it.deadline is not None:
+                            self._ndeadline += 1
+                        escalated += 1
+                        continue
                     self.stats.record_reroute_failure()
                     to_fail.append((it.future, WorkerLostError(
                         wid, f"{exc.reason}; rerouted {it.reroutes - 1} "
-                        f"times (max_reroutes={self.max_reroutes})")))
+                        f"times (max_reroutes={self.max_reroutes}, "
+                        "escalation "
+                        + ("consumed" if it.escalated else "off")
+                        + ")")))
                 else:
                     self._pending.setdefault(it.key, []).append(it)
                     self._npending += 1
@@ -1383,6 +1943,17 @@ class FleetRouter:
                             ).inc(bucket=it.bucket)
                 if flight is not None:
                     flight.record("reroute", worker=wid, n=rerouted)
+            if escalated:
+                self.stats.record_escalation(escalated)
+                self.timer.count_event("fed_escalation", escalated)
+                if registry is not None:
+                    registry.counter(
+                        "megba_fed_escalation_total",
+                        "Problems retried via the escalation ladder "
+                        "after reroute exhaustion").inc(
+                            escalated, worker=wid)
+                if flight is not None:
+                    flight.record("escalation", worker=wid, n=escalated)
             if not survivors:
                 # Nothing can serve the queue: fail it all, typed.
                 for key in list(self._pending):

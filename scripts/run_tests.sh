@@ -1216,3 +1216,207 @@ echo "elastic chaos smoke OK"
 else
 echo "elastic chaos smoke SKIPPED: jaxlib CPU client lacks gloo collectives"
 fi
+
+# Network-chaos federation smoke (ISSUE 20): the multi-host transport
+# under a hostile network.  The SAME 16-problem fleet is solved three
+# ways — single-host solve_many (control), a 2-worker PIPE fleet, and
+# a 2-worker TCP fleet whose workers dial the router THROUGH a
+# deterministic chaos proxy (robustness/netfaults.py) — and all three
+# must agree BITWISE (shape-class padding exactness makes the carrier
+# result-invariant).  Mid-flight the proxy PARTITIONS the fleet while
+# a cold-bucket solve is executing on a worker: the worker's reply
+# send dies, it re-dials under seeded backoff (refused until heal),
+# re-registers with `resume`, and the router's stranded reader resends
+# the SAME sequence id — which the worker answers from its reply cache
+# (the dedup counter is asserted: a resend can never double-solve).
+# One worker is then SIGKILLed — a real host loss, distinct from the
+# connection loss above: its problems must re-route to the survivor,
+# typed and counted, and flush() must return with every future
+# resolved (the no-wedge gate).  Every transport event must land in
+# all three observability planes (metrics, spans, flight ring).
+NETFED_DIR=$(mktemp -d /tmp/megba_netchaos_smoke.XXXXXX)
+trap 'rm -f "$SMOKE" "$FORCING_OUT" "$LOCALITY_OUT" "$CHAOS_SINK" "$TRIAGE_SINK"; rm -rf "$FED_DIR" ${ELASTIC_DIR:+"$ELASTIC_DIR"} "$NETFED_DIR"' EXIT
+JAX_PLATFORMS=cpu MEGBA_NETFED_DIR="$NETFED_DIR" \
+MEGBA_METRICS=1 MEGBA_TRACE=1 MEGBA_FLIGHT="$NETFED_DIR/flight.jsonl" \
+  python - <<'PY'
+import os
+import signal
+import socket
+import time
+
+import numpy as np
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from megba_tpu.utils.backend import enable_persistent_compile_cache
+
+enable_persistent_compile_cache()
+
+from megba_tpu.common import AlgoOption, ProblemOption, SolverOption
+from megba_tpu.io.synthetic import make_fleet
+from megba_tpu.observability import metrics as obs_metrics
+from megba_tpu.ops.residuals import make_residual_jacobian_fn
+from megba_tpu.robustness.netfaults import ChaosTcpProxy
+from megba_tpu.serving.transport import ReconnectPolicy
+from megba_tpu.serving import (
+    CompilePool, FleetProblem, FleetRouter, FleetStats, solve_many)
+
+work = os.environ["MEGBA_NETFED_DIR"]
+OPT = ProblemOption(dtype=np.float64, algo_option=AlgoOption(max_iter=6),
+                    solver_option=SolverOption(max_iter=12, tol=1e-10))
+engine = make_residual_jacobian_fn(mode=OPT.jacobian_mode)
+fleet = [FleetProblem.from_synthetic(s, name=f"net{i}")
+         for i, s in enumerate(make_fleet(16, size_range=(12, 96), seed=3,
+                                          dtype=np.float64))]
+
+# -- single-host control + artifact export (millisecond worker warms) --
+store = os.path.join(work, "artifacts")
+stats = FleetStats()
+pool = CompilePool(stats=stats, artifacts=store)
+control = solve_many(fleet, OPT, pool=pool, stats=stats)
+manifest = os.path.join(work, "manifest.json")
+pool.save_manifest(manifest, option=OPT)
+n_exported = pool.export_artifacts(engine, OPT)
+print(f"network chaos smoke: exported {n_exported} bucket executables")
+
+# A cold bucket the manifest does NOT cover (the 16-fleet's sizes pad
+# to <=128 points; these pad to 256): its compile-on-dispatch runs for
+# seconds on the worker — a deterministic in-flight window for the
+# partition below (and a live ColdDispatchWarning).
+big = [FleetProblem.from_synthetic(s, name=f"cold{i}")
+       for i, s in enumerate(make_fleet(2, size_range=(150, 220), seed=9,
+                                        dtype=np.float64))]
+big_control = solve_many(big, OPT, pool=pool, stats=stats)
+
+# -- pipe fleet: the same-host carrier, bitwise vs control -------------
+with FleetRouter(OPT, n_workers=2, artifacts=store, manifest=manifest,
+                 strict_manifest=True) as pipe_router:
+    pipe_futs = pipe_router.submit_many(fleet)
+    pipe_router.flush()
+    pipe_results = [f.result(timeout=5) for f in pipe_futs]
+for r, c in zip(pipe_results, control):
+    assert r.cameras.tobytes() == c.cameras.tobytes(), r.name
+    assert r.cost.tobytes() == c.cost.tobytes(), r.name
+    assert int(r.status) == int(c.status), r.name
+print("network chaos smoke: pipe fleet 16/16 BITWISE vs solve_many")
+
+# -- TCP fleet, every worker connection through the chaos proxy --------
+# The proxy must exist before the router (workers dial THROUGH it at
+# spawn), but it needs the router's port — so a probe socket picks the
+# port first and the router binds it explicitly.
+probe = socket.socket()
+probe.bind(("127.0.0.1", 0))
+port = probe.getsockname()[1]
+probe.close()
+proxy = ChaosTcpProxy(f"127.0.0.1:{port}")
+sink = os.path.join(work, "telemetry.jsonl")
+t0 = time.perf_counter()
+# The reconnect window must outlive a worker-side cold compile: the
+# worker can only notice the severed link and re-dial AFTER its
+# in-flight solve returns, and the partitioned cold bucket below
+# compiles for tens of seconds on a CPU runner.
+router = FleetRouter(OPT, n_workers=2, artifacts=store, manifest=manifest,
+                     strict_manifest=True, transport="tcp",
+                     bind=f"127.0.0.1:{port}", advertise=proxy.address,
+                     token="netchaos-smoke", telemetry=sink,
+                     reconnect=ReconnectPolicy(window_s=240.0))
+print(f"network chaos smoke: 2 TCP workers registered through the "
+      f"proxy in {time.perf_counter() - t0:.1f}s")
+
+futs = router.submit_many(fleet)
+router.flush()
+results = [f.result(timeout=5) for f in futs]
+for r, c in zip(results, control):
+    assert r.cameras.tobytes() == c.cameras.tobytes(), r.name
+    assert r.cost.tobytes() == c.cost.tobytes(), r.name
+    assert int(r.status) == int(c.status), r.name
+print("network chaos smoke: TCP fleet 16/16 BITWISE vs solve_many "
+      "AND the pipe fleet")
+
+
+def merged_counter(name):
+    snap = router.metrics_snapshot()
+    fam = (snap or {}).get("metrics", {}).get(name)
+    return 0 if fam is None else int(sum(fam["series"].values()))
+
+
+# -- mid-flight partition during a cold-bucket solve -------------------
+futs2 = router.submit_many(big)
+# Partition only once the batch is IN FLIGHT (request sent, reply
+# pending): the worker is then mid-compile for seconds — the reply
+# send must die on the severed connection and the router must resend.
+deadline = time.monotonic() + 30.0
+while router._inflight < 1:
+    assert time.monotonic() < deadline, "cold batch never dispatched"
+    time.sleep(0.005)
+time.sleep(0.3)  # let the request cross the proxy relay
+proxy.partition()
+time.sleep(1.2)
+proxy.heal()
+t0 = time.perf_counter()
+router.flush()
+flush_s = time.perf_counter() - t0
+results2 = [f.result(timeout=5) for f in futs2]
+for r, c in zip(results2, big_control):
+    assert r.cameras.tobytes() == c.cameras.tobytes(), r.name
+    assert r.cost.tobytes() == c.cost.tobytes(), r.name
+n_reconnect = merged_counter("megba_transport_reconnect_total")
+n_resend = merged_counter("megba_transport_resend_total")
+n_conn_lost = merged_counter("megba_transport_conn_lost_total")
+n_dedup = merged_counter("megba_transport_dedup_total")
+assert n_conn_lost >= 1, "partition left no conn_lost event"
+assert n_reconnect >= 1, "no worker re-registered after the heal"
+assert n_resend >= 1, "stranded reader never resent its request"
+assert n_dedup >= 1, ("resend was re-executed, not served from the "
+                      "worker reply cache")
+counts = proxy.event_counts()
+assert counts["partition"] == 1 and counts["heal"] == 1, counts
+assert counts.get("refused", 0) >= 1, counts  # backoff dials hit the wall
+print(f"network chaos smoke: partition healed — flush in {flush_s:.1f}s, "
+      f"{n_conn_lost} conn_lost / {n_reconnect} reconnects / "
+      f"{n_resend} resends / {n_dedup} dedup hits, 2/2 cold-bucket "
+      "results BITWISE (no double-solve), proxy "
+      f"refused {counts.get('refused', 0)} dials while partitioned")
+
+# -- a real host loss: SIGKILL one worker, reroute to the survivor -----
+victim = router.workers["w1"]
+os.kill(victim.pid, signal.SIGKILL)
+futs3 = router.submit_many(fleet)
+t0 = time.perf_counter()
+router.flush()  # the no-wedge gate: pending==0 and inflight==0
+flush_s = time.perf_counter() - t0
+results3 = [f.result(timeout=5) for f in futs3]
+for r, c in zip(results3, control):
+    assert r.cameras.tobytes() == c.cameras.tobytes(), r.name
+    assert r.cost.tobytes() == c.cost.tobytes(), r.name
+router.close()
+d = router.stats.as_dict()
+assert d["workers_lost"] == 1 and d["lost_workers"] == ["w1"], d
+assert d["reroutes"] >= 1, d
+assert d["cold_dispatches"] >= 2, d  # the unmanifested big bucket
+print(f"network chaos smoke: w1 SIGKILLed — {d['reroutes']} problems "
+      f"rerouted to the survivor, flush returned in {flush_s:.1f}s, "
+      "16/16 BITWISE vs control")
+
+# -- transport events visible in spans + flight ring -------------------
+from megba_tpu.observability import flight as obs_flight
+from megba_tpu.observability import spans as obs_spans
+
+recorded = obs_spans.default_recorder().drain()
+span_names = {s["name"] for s in recorded}
+assert any(n.startswith("transport_") for n in span_names), span_names
+dumps = obs_flight.load_dumps(os.environ["MEGBA_FLIGHT"])
+assert dumps, "no flight dump after the w1 SIGKILL"
+kinds = {e["kind"] for dmp in dumps for e in dmp["events"]}
+assert "worker_lost" in kinds, kinds
+assert any(k.startswith("transport_") for k in kinds), kinds
+print(f"network chaos smoke: transport events in spans "
+      f"({sorted(n for n in span_names if n.startswith('transport_'))}) "
+      f"and flight ring ({sorted(k for k in kinds if k.startswith('transport_'))})")
+proxy.close()
+PY
+echo "network chaos smoke OK"

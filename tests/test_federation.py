@@ -14,6 +14,7 @@ mid-fleet at 16-problem scale.
 import dataclasses
 import json
 import os
+import socket
 import threading
 from concurrent.futures import Future
 import time
@@ -33,6 +34,7 @@ from megba_tpu.serving import (
     ArtifactKey,
     ArtifactStore,
     BucketLadder,
+    ColdDispatchWarning,
     CompilePool,
     FederationStats,
     FleetProblem,
@@ -49,10 +51,19 @@ from megba_tpu.serving import artifacts as artifacts_mod
 from megba_tpu.serving.federation import (
     FrameChannel,
     FrameError,
+    TcpWorkerHandle,
+    WorkerHandle,
     WorkerView,
     append_federation_report,
 )
-from megba_tpu.serving.resilience import DeadlineExceeded
+from megba_tpu.serving.resilience import DeadlineExceeded, EscalationPolicy
+from megba_tpu.serving.transport import (
+    PipeTransport,
+    ReconnectPolicy,
+    TcpTransport,
+    heartbeat_frame,
+)
+from megba_tpu.serving.worker import WorkerRuntime
 
 OPT64 = ProblemOption(dtype=np.float64,
                       algo_option=AlgoOption(max_iter=6),
@@ -757,6 +768,208 @@ def test_fleet_stats_artifact_counters():
     d = st.as_dict()
     assert d["artifact_loads"] == 2 and d["artifact_compiles"] == 1
     assert "artifact store: 2 loaded / 1 compiled" in st.report()
+
+
+# ---------------------------------------------------------------------------
+# Transport supervision (fail-fast, escalation, cold dispatch, reconnect)
+# ---------------------------------------------------------------------------
+
+
+def test_worker_handle_fails_fast_from_recorded_death():
+    """Once ONE waiter observes the death, every later request must
+    fail from the recorded reason immediately — never re-spend a
+    watchdog budget on a channel known dead."""
+    r1, w1 = os.pipe()  # router -> worker (never read; stays open)
+    r2, w2 = os.pipe()  # worker -> router
+    chan = FrameChannel(os.fdopen(r2, "rb", buffering=0),
+                        os.fdopen(w1, "wb", buffering=0))
+    h = WorkerHandle("w0", None, chan, log_path="/nonexistent")
+    os.close(w2)  # worker side gone: the reply read sees EOF
+    with pytest.raises(WorkerLostError):
+        h.request({"op": "stats"}, timeout_s=5.0)
+    t0 = time.monotonic()
+    with pytest.raises(WorkerLostError, match="fail-fast"):
+        h.request({"op": "stats"}, timeout_s=30.0)
+    # Well under the 30s watchdog budget it would otherwise burn.
+    assert time.monotonic() - t0 < 1.0
+    chan.close()
+    os.close(r1)
+
+
+def test_router_escalation_retries_once_then_succeeds():
+    """Past max_reroutes the router consults the EscalationPolicy
+    ladder ONCE: the item requeues behind the policy's backoff and a
+    survivor serves it instead of failing typed."""
+    def dying(stub, problems):
+        raise WorkerLostError(stub.worker_id, "stub death")
+
+    w0 = StubWorker("w0", behavior=dying)
+    w1 = StubWorker("w1")
+    esc = EscalationPolicy(backoff_base_s=0.01)
+    with FleetRouter(OPT64, workers=[w0, w1], max_batch=4, steal=False,
+                     max_reroutes=0, escalation=esc) as router:
+        fut = router.submit(_mk(0, 16))
+        router.flush()
+        assert fut.result(timeout=10).name == "s0_p16"
+    d = router.stats.as_dict()
+    assert d["escalations"] == 1, d
+    assert d["workers_lost"] == 1 and d["reroute_failures"] == 0, d
+
+
+def test_router_escalation_consumed_fails_typed():
+    """The ladder is consulted once per item: a second loss after the
+    escalated retry fails typed, naming the consumed escalation."""
+    def dying(stub, problems):
+        raise WorkerLostError(stub.worker_id, "stub death")
+
+    workers = [StubWorker(f"w{i}", behavior=dying) for i in range(3)]
+    esc = EscalationPolicy(backoff_base_s=0.01)
+    with FleetRouter(OPT64, workers=workers, max_batch=4, steal=False,
+                     max_reroutes=0, escalation=esc) as router:
+        fut = router.submit(_mk(0, 16))
+        router.flush()
+        with pytest.raises(WorkerLostError, match="escalation consumed"):
+            fut.result(timeout=10)
+    assert router.stats.as_dict()["escalations"] == 1
+
+
+def test_router_cold_dispatch_counted_and_warned_once():
+    """A dispatch with no warm program on the target counts EVERY
+    time but warns ONCE per (bucket, lanes, rung) key."""
+    w0 = StubWorker("w0")  # never reports anything warm
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        # max_batch=1 pins every dispatch to lanes=1: one warn key.
+        with FleetRouter(OPT64, workers=[w0], max_batch=1) as router:
+            for wave in range(2):  # same key twice
+                futs = [router.submit(_mk(2 * wave + i, 16))
+                        for i in range(2)]
+                router.flush()
+                for f in futs:
+                    assert f.result(timeout=10) is not None
+    cold = [w for w in rec if issubclass(w.category, ColdDispatchWarning)]
+    assert len(cold) == 1, [str(w.message) for w in rec]
+    msg = str(cold[0].message)
+    assert "lanes=1" in msg and "rung=0" in msg and "w0" in msg
+    assert router.stats.as_dict()["cold_dispatches"] == 4
+
+
+def test_tcp_handle_reconnect_resends_same_seq():
+    """The supervision contract end to end, no sockets faked: a
+    scripted server receives the request and DROPS the connection;
+    adopting a fresh transport makes the stranded reader resend the
+    SAME sequence id and resolve from the second server's reply."""
+    events = []
+
+    def on_event(event, worker="?", **kw):
+        events.append(event)
+
+    a1, b1 = socket.socketpair()
+    h = TcpWorkerHandle(
+        "w0", TcpTransport(a1),
+        reconnect=ReconnectPolicy(window_s=10.0, base_s=0.01),
+        conn_dead_after_s=60.0, on_event=on_event)
+    srv1 = TcpTransport(b1)
+    got = {}
+
+    def server1():
+        got["req1"] = srv1.recv(timeout_s=10.0)
+        srv1.close()  # drop mid-request, no reply
+
+    a2, b2 = socket.socketpair()
+    srv2 = TcpTransport(b2)
+
+    def server2():
+        req = srv2.recv(timeout_s=10.0)
+        got["req2"] = req
+        srv2.send(heartbeat_frame(1, "w0"))  # skimmed by the reader
+        srv2.send({"ok": True, "seq": req["seq"], "answer": 42})
+
+    result = {}
+
+    def do_request():
+        result["reply"] = h.request({"op": "stats"}, timeout_s=30.0)
+
+    t1 = threading.Thread(target=server1)
+    t1.start()
+    rt = threading.Thread(target=do_request)
+    rt.start()
+    t1.join(timeout=10.0)
+    t2 = threading.Thread(target=server2)
+    t2.start()
+    h.adopt(TcpTransport(a2), incarnation=1)
+    rt.join(timeout=10.0)
+    t2.join(timeout=10.0)
+    assert not rt.is_alive()
+    assert result["reply"]["answer"] == 42
+    assert got["req1"]["seq"] == got["req2"]["seq"] == result["reply"]["seq"]
+    assert "conn_lost" in events and "resend" in events
+    # Epoch 1 is the first registration: a connect, not a recovery.
+    assert "connect" in events and "reconnect" not in events
+    h.terminate()
+    srv2.close()
+
+
+def test_tcp_handle_idle_gap_is_not_connection_loss():
+    """last_rx is only refreshed while a reader is listening, so an
+    IDLE handle's heartbeats pile up unread and the clock goes stale.
+    A request after an idle gap longer than conn_dead_after_s must not
+    read that gap as silence: the staleness window starts when the
+    reader starts listening (regression: the false conn_lost stranded
+    the reader in a reconnect window no healthy worker ever ends)."""
+    events = []
+    a, b = socket.socketpair()
+    h = TcpWorkerHandle(
+        "w0", TcpTransport(a), conn_dead_after_s=0.3,
+        on_event=lambda event, **kw: events.append(event))
+    srv = TcpTransport(b)
+
+    def server():
+        req = srv.recv(timeout_s=10.0)
+        srv.send({"ok": True, "seq": req["seq"], "answer": 7})
+
+    t = threading.Thread(target=server)
+    t.start()
+    time.sleep(0.8)  # idle for >2x the staleness threshold
+    reply = h.request({"op": "stats"}, timeout_s=10.0)
+    t.join(timeout=10.0)
+    assert reply["answer"] == 7
+    assert "conn_lost" not in events
+    h.terminate()
+    srv.close()
+
+
+def test_worker_runtime_dedup_serves_cached_reply(monkeypatch):
+    """A resend with an already-answered seq is served from the reply
+    cache — counted, never re-executed."""
+    # The runtime tags the process env; record-and-restore via
+    # monkeypatch so later batcher tests see their own tag.
+    monkeypatch.setenv("MEGBA_FEDERATION_WORKER", "test-orig")
+    runtime = WorkerRuntime("wdedup", {"option": OPT64})
+    r1, w1 = os.pipe()  # router -> worker
+    r2, w2 = os.pipe()  # worker -> router
+    worker_chan = PipeTransport(os.fdopen(r1, "rb", buffering=0),
+                                os.fdopen(w2, "wb", buffering=0))
+    router_chan = PipeTransport(os.fdopen(r2, "rb", buffering=0),
+                                os.fdopen(w1, "wb", buffering=0))
+    t = threading.Thread(target=runtime.serve, args=(worker_chan,))
+    t.start()
+    try:
+        router_chan.send({"op": "stats", "seq": 7})
+        first = router_chan.recv(timeout_s=10.0)
+        router_chan.send({"op": "stats", "seq": 7})  # resend, same seq
+        second = router_chan.recv(timeout_s=10.0)
+        assert first["seq"] == second["seq"] == 7
+        assert second == first  # the cached reply, bit for bit
+        assert runtime.dedup.hit_count() == 1
+        assert runtime.timer.counts.get("transport_dedup_hit") == 1
+        router_chan.send({"op": "shutdown", "seq": 8})
+        assert router_chan.recv(timeout_s=10.0)["ok"]
+    finally:
+        t.join(timeout=10.0)
+        router_chan.close()
+        worker_chan.close()
+    assert not t.is_alive()
 
 
 # ---------------------------------------------------------------------------
